@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Benchmark: flow-records/sec/chip through the L4 rollup hot path.
+
+Measures the steady-state jit ingest step (fanout → fingerprint →
+sort/segment stash merge) on the attached accelerator, replaying the
+BASELINE config-1 workload shape: synthetic accumulated-flow batches over
+10k unique 5-tuples at 1s windows.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline is against the north-star target of 50M records/sec/chip
+(BASELINE.json; the reference publishes no absolute numbers — SURVEY §6).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from deepflow_tpu.aggregator.fanout import FanoutConfig
+from deepflow_tpu.aggregator.pipeline import make_ingest_step
+from deepflow_tpu.aggregator.stash import stash_init
+from deepflow_tpu.datamodel.schema import FLOW_METER, TAG_SCHEMA
+from deepflow_tpu.ingest.replay import SyntheticFlowGen
+
+TARGET = 50e6  # records/sec/chip north star
+
+BATCH = 1 << 14  # flows per step (→ 4x doc rows)
+CAPACITY = 1 << 16
+WARMUP = 3
+ITERS = 20
+
+
+def main():
+    gen = SyntheticFlowGen(num_tuples=10_000, seed=0)
+    fb = gen.flow_batch(BATCH, 1_700_000_000)
+    tags = {k: jnp.asarray(v) for k, v in fb.tags.items()}
+    meters = jnp.asarray(fb.meters)
+    valid = jnp.asarray(fb.valid)
+
+    step_fn = make_ingest_step(FanoutConfig(), interval=1)
+    step = jax.jit(step_fn, donate_argnums=(0,))
+
+    state = stash_init(CAPACITY, TAG_SCHEMA, FLOW_METER)
+    for _ in range(WARMUP):
+        state = step(state, tags, meters, valid)
+    jax.block_until_ready(state)
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        state = step(state, tags, meters, valid)
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+
+    rate = BATCH * ITERS / dt
+    print(
+        json.dumps(
+            {
+                "metric": "flow_records_per_sec_per_chip",
+                "value": round(rate, 1),
+                "unit": "records/s",
+                "vs_baseline": round(rate / TARGET, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

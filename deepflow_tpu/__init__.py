@@ -1,0 +1,10 @@
+"""deepflow_tpu — TPU-native flow-metrics aggregation framework.
+
+A ground-up JAX/XLA re-design of DeepFlow's server-side metrics plane
+(reference: svc-design/deepflow; see /root/repo/SURVEY.md): windowed
+tag-dimension group-by of flow meters via sort + segment-reduce, streaming
+sketches (HyperLogLog, count-min, log-histogram → t-digest) for
+per-service rollups, sharded over device meshes with collective merges.
+"""
+
+__version__ = "0.1.0"

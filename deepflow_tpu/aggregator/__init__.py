@@ -1,0 +1,11 @@
+from .stash import StashState, stash_init, stash_merge, stash_flush
+from .window import WindowConfig, WindowManager
+
+__all__ = [
+    "StashState",
+    "stash_init",
+    "stash_merge",
+    "stash_flush",
+    "WindowConfig",
+    "WindowManager",
+]

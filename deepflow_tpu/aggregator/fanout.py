@@ -1,0 +1,282 @@
+"""Document fanout — vectorized `fill_l4_stats`.
+
+The reference emits up to 4 documents per accumulated flow
+(collector.rs:500-607): one *single-ended* doc per endpoint whose
+direction is known (client view and server view, the server view with a
+tx/rx-reversed meter) and one *edge* doc per known direction (plus a
+rest/edge doc when both directions are unknown). Data-dependent emission
+counts don't exist on TPU, so we always emit a fixed [4, N] block with a
+validity mask — lane 0/1 are the ep0/ep1 single docs, lane 2/3 the ep0/ep1
+edge docs (lane 3 doubles as the both-directions-unknown rest doc).
+
+Tag construction mirrors get_single_tagger / get_edge_tagger
+(collector.rs:882-1095): inactive-IP zeroing, Internet-EPC zeroing,
+vip-interface MAC gating, server-port suppression
+(`ignore_server_port`, collector.rs:877), OTel epc clamping
+(get_l3_epc_id, collector.rs:1097). Columns not covered by the doc's Code
+are zeroed, which is what makes "fingerprint all key columns" equivalent
+to StashKey equality.
+
+Omitted here: the ACL/UsageMeter policy docs (collector.rs:440-487) —
+they depend on the minute-granularity policy id_maps and are emitted by
+the policy module, not the per-flow fanout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..datamodel.code import CodeId, Direction, MeterId, SignalSource
+from ..datamodel.schema import FLOW_METER, TAG_SCHEMA
+
+_T = TAG_SCHEMA
+
+TCP = 6
+UDP = 17
+EPC_INTERNET_U16 = 0xFFFE  # -2 as u16 (EPC_INTERNET, npb_pcap_policy)
+
+_DIR_SIDE_MASK = 0xF8  # document.rs MASK_SIDE
+_DIR_CS_MASK = 0x7
+
+
+@dataclasses.dataclass(frozen=True)
+class FanoutConfig:
+    """CollectorConfig subset (agent/src/config/handler.rs CollectorAccess)."""
+
+    inactive_ip_aggregation: bool = False
+    inactive_server_port_aggregation: bool = False
+    agent_id: int = 1
+    global_thread_id: int = 1
+
+
+def _u32(x):
+    return jnp.asarray(x, dtype=jnp.uint32)
+
+
+@partial(jax.jit, static_argnames=("config",))
+def fanout_l4(tags: dict, meters: jnp.ndarray, valid: jnp.ndarray, config: FanoutConfig):
+    """FlowBatch columns → DocBatch arrays of shape [4N, ...].
+
+    Args:
+      tags: dict of [N] u32 columns named per FLOW_RECORD_TAG_FIELDS.
+      meters: [N, M] f32 FlowMeter rows (client-view).
+      valid: [N] bool.
+    Returns:
+      (doc_tags [4N, T] u32, doc_meters [4N, M] f32, doc_ts [4N] u32,
+       doc_valid [4N] bool)
+    """
+    n = meters.shape[0]
+    zero = jnp.zeros((n,), dtype=jnp.uint32)
+
+    dir0 = tags["direction0"]
+    dir1 = tags["direction1"]
+    sig = tags["signal_source"]
+    is_otel = sig == jnp.uint32(SignalSource.OTEL)
+    is_pkt_or_xflow = (sig == jnp.uint32(SignalSource.PACKET)) | (sig == jnp.uint32(SignalSource.XFLOW))
+    is_v6 = tags["is_ipv6"] != 0
+    proto = tags["protocol"]
+
+    active0 = tags["is_active_host0"] != 0
+    active1 = tags["is_active_host1"] != 0
+    vip0 = tags["is_vip0"] != 0
+    vip1 = tags["is_vip1"] != 0
+
+    # reversed meter for the server-endpoint single doc (meter.rs:169-176)
+    perm = jnp.asarray(FLOW_METER.reverse_perm)
+    zmask = jnp.asarray(~FLOW_METER.reverse_zero_mask, dtype=meters.dtype)
+    meters_rev = meters[:, perm] * zmask[None, :]
+
+    # ignore_server_port (collector.rs:877)
+    inactive_service = tags["is_active_service"] == 0
+    ignore_port = (inactive_service & config.inactive_server_port_aggregation) | (
+        (proto != jnp.uint32(TCP)) & (proto != jnp.uint32(UDP))
+    )
+    dst_port = jnp.where(ignore_port, zero, tags["server_port"])
+
+    # get_l3_epc_id (collector.rs:1097): negative epc + OTel → 0. EPC ids
+    # are i16 semantically — fold to u16 first so a sign-extended u32
+    # (0xFFFFFFFE) and the folded form (0xFFFE) compare equal.
+    def epc_fix(epc):
+        epc = epc & jnp.uint32(0xFFFF)
+        is_neg = epc >= jnp.uint32(0x8000)  # sign-folded i16
+        return jnp.where(is_neg & is_otel, zero, epc)
+
+    epc0 = epc_fix(tags["l3_epc_id"])
+    epc1 = epc_fix(tags["l3_epc_id1"])
+
+    ip0 = [tags[f"ip0_w{w}"] for w in range(4)]
+    ip1 = [tags[f"ip1_w{w}"] for w in range(4)]
+
+    def masked_ip(ip, keep):
+        return [jnp.where(keep, w, zero) for w in ip]
+
+    # ---- single docs (lanes 0, 1) -------------------------------------
+    def single_lane(ep):
+        d = dir0 if ep == 0 else dir1
+        active = active0 if ep == 0 else active1
+        vip = vip0 if ep == 0 else vip1
+        epc = epc0 if ep == 0 else epc1
+        ip = ip0 if ep == 0 else ip1
+        gpid = tags["gpid0"] if ep == 0 else tags["gpid1"]
+        mac = (tags["mac0_hi"], tags["mac0_lo"]) if ep == 0 else (tags["mac1_hi"], tags["mac1_lo"])
+
+        # emission gate (fill_l4_stats + fill_single_l4_stats)
+        no_side = (d & jnp.uint32(_DIR_SIDE_MASK)) == 0
+        lane_valid = valid & (d != 0) & no_side
+        if config.inactive_ip_aggregation:
+            lane_valid = lane_valid & active
+
+        # ip rewrite (get_single_tagger, Managed mode)
+        if config.inactive_ip_aggregation:
+            keep_ip = active
+        else:
+            if ep == 0:
+                keep_ip = (epc0 != jnp.uint32(EPC_INTERNET_U16)) | is_otel
+            else:
+                keep_ip = jnp.ones((n,), dtype=bool)
+        ip_w = masked_ip(ip, keep_ip)
+
+        has_mac = vip | (d == jnp.uint32(Direction.LOCAL_TO_LOCAL))
+        mac_hi = jnp.where(has_mac, mac[0], zero)
+        mac_lo = jnp.where(has_mac, mac[1], zero)
+        code_id = jnp.where(
+            has_mac,
+            jnp.uint32(CodeId.SINGLE_MAC_IP_PORT),
+            jnp.uint32(CodeId.SINGLE_IP_PORT),
+        )
+        # "If the resource is located on the client, the service port is
+        # ignored" (collector.rs:948-955)
+        port = zero if ep == 0 else dst_port
+
+        cols = {
+            "code_id": code_id,
+            "meter_id": jnp.full((n,), MeterId.FLOW, jnp.uint32),
+            "global_thread_id": jnp.full((n,), config.global_thread_id, jnp.uint32),
+            "agent_id": jnp.full((n,), config.agent_id, jnp.uint32),
+            "is_ipv6": tags["is_ipv6"],
+            "ip0_w0": ip_w[0],
+            "ip0_w1": ip_w[1],
+            "ip0_w2": ip_w[2],
+            "ip0_w3": ip_w[3],
+            "l3_epc_id": epc,
+            "mac0_hi": mac_hi,
+            "mac0_lo": mac_lo,
+            "direction": d,
+            "tap_side": _tap_side(d),
+            "protocol": proto,
+            "server_port": port,
+            "tap_type": tags["tap_type"],
+            "gpid0": gpid,
+            "signal_source": sig,
+            "pod_id": tags["pod_id"],
+        }
+        return cols, lane_valid, (meters if ep == 0 else meters_rev)
+
+    # ---- edge docs (lanes 2, 3) ---------------------------------------
+    both_none = (dir0 == 0) & (dir1 == 0)
+
+    def edge_lane(ep):
+        d = dir0 if ep == 0 else dir1
+        if ep == 1:
+            # rest-doc fold: both directions unknown → direction None
+            # (or App for OTel), tap_side Rest (collector.rs:584-607)
+            d = jnp.where(
+                both_none,
+                jnp.where(is_otel, jnp.uint32(Direction.APP), jnp.uint32(Direction.NONE)),
+                d,
+            )
+            lane_valid = valid & ((dir1 != 0) | both_none)
+        else:
+            lane_valid = valid & (d != 0)
+        # L4 edge docs exist only for Packet/XFlow (fill_edge_l4_stats)
+        lane_valid = lane_valid & is_pkt_or_xflow
+
+        # ip rewrite (get_edge_tagger, Managed)
+        if config.inactive_ip_aggregation:
+            keep0, keep1 = active0, active1
+        else:
+            keep0 = (epc0 != jnp.uint32(EPC_INTERNET_U16)) | is_otel
+            keep1 = jnp.ones((n,), dtype=bool)
+        src_ip = masked_ip(ip0, keep0)
+        dst_ip = masked_ip(ip1, keep1)
+
+        # vip gating of macs except local-local (collector.rs:1030-1043)
+        is_ll = d == jnp.uint32(Direction.LOCAL_TO_LOCAL)
+        keep_mac0 = vip0 | is_ll
+        keep_mac1 = vip1 | is_ll
+        mac0_hi = jnp.where(keep_mac0, tags["mac0_hi"], zero)
+        mac0_lo = jnp.where(keep_mac0, tags["mac0_lo"], zero)
+        mac1_hi = jnp.where(keep_mac1, tags["mac1_hi"], zero)
+        mac1_lo = jnp.where(keep_mac1, tags["mac1_lo"], zero)
+        any_mac = (mac0_hi | mac0_lo | mac1_hi | mac1_lo) != 0
+        code_id = jnp.where(
+            any_mac,
+            jnp.uint32(CodeId.EDGE_MAC_IP_PORT),
+            jnp.uint32(CodeId.EDGE_IP_PORT),
+        )
+
+        cols = {
+            "code_id": code_id,
+            "meter_id": jnp.full((n,), MeterId.FLOW, jnp.uint32),
+            "global_thread_id": jnp.full((n,), config.global_thread_id, jnp.uint32),
+            "agent_id": jnp.full((n,), config.agent_id, jnp.uint32),
+            "is_ipv6": tags["is_ipv6"],
+            "ip0_w0": src_ip[0],
+            "ip0_w1": src_ip[1],
+            "ip0_w2": src_ip[2],
+            "ip0_w3": src_ip[3],
+            "ip1_w0": dst_ip[0],
+            "ip1_w1": dst_ip[1],
+            "ip1_w2": dst_ip[2],
+            "ip1_w3": dst_ip[3],
+            "l3_epc_id": epc0,
+            "l3_epc_id1": epc1,
+            "mac0_hi": mac0_hi,
+            "mac0_lo": mac0_lo,
+            "mac1_hi": mac1_hi,
+            "mac1_lo": mac1_lo,
+            "direction": d,
+            "tap_side": _tap_side(d),
+            "protocol": proto,
+            "server_port": dst_port,
+            "tap_port": tags["tap_port"],
+            "tap_type": tags["tap_type"],
+            "gpid0": tags["gpid0"],
+            "gpid1": tags["gpid1"],
+            "signal_source": sig,
+            "pod_id": tags["pod_id"],
+        }
+        return cols, lane_valid, meters
+
+    lanes = [single_lane(0), single_lane(1), edge_lane(0), edge_lane(1)]
+
+    t_count = _T.num_fields
+    doc_tags = jnp.zeros((4, n, t_count), dtype=jnp.uint32)
+    doc_valid = jnp.zeros((4, n), dtype=bool)
+    doc_meters = jnp.zeros((4, n, meters.shape[1]), dtype=meters.dtype)
+    for li, (cols, lv, mt) in enumerate(lanes):
+        lane_tags = jnp.zeros((n, t_count), dtype=jnp.uint32)
+        for name, arr in cols.items():
+            lane_tags = lane_tags.at[:, _T.index(name)].set(_u32(arr))
+        doc_tags = doc_tags.at[li].set(lane_tags)
+        doc_valid = doc_valid.at[li].set(lv)
+        doc_meters = doc_meters.at[li].set(mt)
+
+    ts = jnp.broadcast_to(tags["timestamp"][None, :], (4, n))
+    return (
+        doc_tags.reshape(4 * n, t_count),
+        doc_meters.reshape(4 * n, -1),
+        ts.reshape(4 * n),
+        doc_valid.reshape(4 * n),
+    )
+
+
+def _tap_side(direction: jnp.ndarray) -> jnp.ndarray:
+    # TapSide::from(Direction) (document.rs:243-264): identity on the bit
+    # pattern, with NONE → REST (both 0).
+    return direction

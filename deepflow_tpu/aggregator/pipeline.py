@@ -1,0 +1,104 @@
+"""L4 flow-metrics rollup pipeline — the end-to-end device slice.
+
+Composes: fanout (fill_l4_stats) → key fingerprint → windowed stash
+merge → flush → DocBatch emission. This is the TPU replacement for the
+reference chain QuadrupleGenerator::inject_flow → Collector::collect_l4 →
+Stash::add → flush_stats (SURVEY §3.1), collapsed into one jit step per
+batch plus a host-driven window controller.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..datamodel.batch import DocBatch, FlowBatch
+from ..datamodel.code import DocumentFlag
+from ..datamodel.schema import FLOW_METER, TAG_SCHEMA
+from ..ops.hashing import fingerprint64
+from .fanout import FanoutConfig, fanout_l4
+from .window import FlushedWindow, WindowConfig, WindowManager
+
+_KEY_COLS = np.nonzero(TAG_SCHEMA.key_mask)[0].astype(np.int32)
+
+
+def make_ingest_step(fanout_config: FanoutConfig, interval: int = 1):
+    """Build the pure device step: FlowBatch columns → merged stash.
+
+    state' = step(state, tags, meters, valid). This is the function the
+    benchmark times and the graft entry exposes; L4Pipeline uses the same
+    building blocks but drives window flushes from the host.
+    """
+    sum_cols = tuple(int(i) for i in np.nonzero(FLOW_METER.sum_mask)[0])
+    max_cols = tuple(int(i) for i in np.nonzero(FLOW_METER.max_mask)[0])
+    key_cols = jnp.asarray(_KEY_COLS)
+
+    def step(state, tags, meters, valid):
+        doc_tags, doc_meters, ts, doc_valid = fanout_l4(tags, meters, valid, fanout_config)
+        key_mat = jnp.take(doc_tags, key_cols, axis=1)
+        hi, lo = fingerprint64(key_mat)
+        window = (ts // jnp.uint32(interval)).astype(jnp.uint32)
+        from .stash import _merge_impl
+
+        return _merge_impl(state, window, hi, lo, doc_tags, doc_meters, doc_valid, sum_cols, max_cols)
+
+    return step
+
+
+@dataclasses.dataclass(frozen=True)
+class L4PipelineConfig:
+    fanout: FanoutConfig = FanoutConfig()
+    window: WindowConfig = WindowConfig()
+    batch_size: int = 4096  # static pad size for flow batches
+
+
+class L4Pipeline:
+    """Single-granularity (e.g. 1s) L4 rollup pipeline."""
+
+    def __init__(self, config: L4PipelineConfig = L4PipelineConfig()):
+        self.config = config
+        self.wm = WindowManager(config.window, TAG_SCHEMA, FLOW_METER)
+
+    def ingest(self, batch: FlowBatch) -> list[DocBatch]:
+        """Feed one decoded flow batch; returns any closed windows."""
+        batch = batch.pad_to(self.config.batch_size)
+        tags = {k: jnp.asarray(v) for k, v in batch.tags.items()}
+        meters = jnp.asarray(batch.meters)
+        valid = jnp.asarray(batch.valid)
+
+        doc_tags, doc_meters, ts, doc_valid = fanout_l4(tags, meters, valid, self.config.fanout)
+        key_mat = jnp.take(doc_tags, jnp.asarray(_KEY_COLS), axis=1)
+        hi, lo = fingerprint64(key_mat)
+
+        flushed = self.wm.ingest(ts, hi, lo, doc_tags, doc_meters, doc_valid)
+        return [self._to_docbatch(f) for f in flushed]
+
+    def drain(self) -> list[DocBatch]:
+        return [self._to_docbatch(f) for f in self.wm.flush_all()]
+
+    def _to_docbatch(self, f: FlushedWindow) -> DocBatch:
+        mask = np.asarray(f.out["mask"])
+        tags = np.asarray(f.out["tags"])[mask]
+        meters = np.asarray(f.out["meters"])[mask]
+        n = tags.shape[0]
+        ts = np.full((n,), f.start_time, dtype=np.uint32)
+        return DocBatch(
+            tags=tags,
+            meters=meters,
+            timestamp=ts,
+            valid=np.ones((n,), dtype=bool),
+            tag_schema=TAG_SCHEMA,
+            meter_schema=FLOW_METER,
+        )
+
+    @property
+    def counters(self) -> dict:
+        return self.wm.counters
+
+    @property
+    def flags(self) -> DocumentFlag:
+        if self.config.window.interval == 1:
+            return DocumentFlag.PER_SECOND_METRICS
+        return DocumentFlag.NONE

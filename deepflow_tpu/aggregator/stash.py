@@ -1,0 +1,134 @@
+"""Device-resident document stash.
+
+The TPU analogue of the reference's per-window `HashMap<StashKey, Document>`
+(collector.rs:806-822) and `QuadrupleStash` (quadruple_generator.rs:233):
+a fixed-capacity, HBM-resident table of (window slot, 64-bit key, tag row,
+meter row), kept sorted by (slot, key) as an invariant *by construction* —
+every merge re-sorts the concatenation of stash and batch, reduces
+duplicate keys with the schema's SUM/MAX ops, and keeps the first
+`capacity` segments. Sentinel-keyed rows (empty / flushed) sort to the end
+and are reclaimed by the same compaction.
+
+Overflow policy: segments beyond capacity are dropped and counted
+(`dropped_overflow`). Because the sort is (slot, key)-ordered, drops land
+on the *newest* window's keys — older windows (about to flush) are never
+evicted. This mirrors the reference's backpressure stance of shedding
+newest data under overload (OverwriteQueue, libs/queue/queue.go:139)
+while protecting closing windows.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..datamodel.schema import MeterSchema, TagSchema
+from ..ops.segment import SENTINEL_SLOT, groupby_reduce
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class StashState:
+    slot: jnp.ndarray  # [S] u32 absolute window index (SENTINEL = empty)
+    key_hi: jnp.ndarray  # [S] u32
+    key_lo: jnp.ndarray  # [S] u32
+    tags: jnp.ndarray  # [S, T] u32
+    meters: jnp.ndarray  # [S, M] f32
+    valid: jnp.ndarray  # [S] bool
+    dropped_overflow: jnp.ndarray  # scalar i32, running count of shed segments
+
+    @property
+    def capacity(self) -> int:
+        return self.slot.shape[0]
+
+
+def stash_init(capacity: int, tag_schema: TagSchema, meter_schema: MeterSchema) -> StashState:
+    return StashState(
+        slot=jnp.full((capacity,), SENTINEL_SLOT, dtype=jnp.uint32),
+        key_hi=jnp.zeros((capacity,), dtype=jnp.uint32),
+        key_lo=jnp.zeros((capacity,), dtype=jnp.uint32),
+        tags=jnp.zeros((capacity, tag_schema.num_fields), dtype=jnp.uint32),
+        meters=jnp.zeros((capacity, meter_schema.num_fields), dtype=jnp.float32),
+        valid=jnp.zeros((capacity,), dtype=bool),
+        dropped_overflow=jnp.zeros((), dtype=jnp.int32),
+    )
+
+
+def _merge_impl(state: StashState, slot, key_hi, key_lo, tags, meters, valid, sum_cols_t, max_cols_t):
+    s = state.capacity
+    sum_cols = np.asarray(sum_cols_t, dtype=np.int32)
+    max_cols = np.asarray(max_cols_t, dtype=np.int32)
+
+    all_slot = jnp.concatenate([state.slot, slot])
+    all_hi = jnp.concatenate([state.key_hi, key_hi])
+    all_lo = jnp.concatenate([state.key_lo, key_lo])
+    all_tags = jnp.concatenate([state.tags, tags], axis=0)
+    all_meters = jnp.concatenate([state.meters, meters], axis=0)
+    all_valid = jnp.concatenate([state.valid, valid])
+
+    g = groupby_reduce(all_slot, all_hi, all_lo, all_tags, all_meters, all_valid, sum_cols, max_cols)
+
+    dropped = jnp.maximum(g.num_segments - s, 0)
+    new_state = StashState(
+        slot=g.slot[:s],
+        key_hi=g.key_hi[:s],
+        key_lo=g.key_lo[:s],
+        tags=g.tags[:s],
+        meters=g.meters[:s],
+        valid=g.seg_valid[:s],
+        dropped_overflow=state.dropped_overflow + dropped,
+    )
+    return new_state
+
+
+_merge = partial(
+    jax.jit, static_argnames=("sum_cols_t", "max_cols_t"), donate_argnums=(0,)
+)(_merge_impl)
+
+
+def stash_merge(
+    state: StashState,
+    slot,
+    key_hi,
+    key_lo,
+    tags,
+    meters,
+    valid,
+    meter_schema: MeterSchema,
+) -> StashState:
+    """Merge a doc batch into the stash (one sort of [S+N] rows)."""
+    sum_cols = tuple(int(i) for i in np.nonzero(meter_schema.sum_mask)[0])
+    max_cols = tuple(int(i) for i in np.nonzero(meter_schema.max_mask)[0])
+    return _merge(state, slot, key_hi, key_lo, tags, meters, valid, sum_cols, max_cols)
+
+
+@jax.jit
+def stash_flush(state: StashState, window_idx) -> tuple[StashState, dict]:
+    """Close a window: emit rows of `window_idx`, reclaim their slots.
+
+    Returns (new_state, out) where out holds full-capacity arrays plus a
+    `mask` of emitted rows (static shapes; host compacts). The stash keeps
+    its sort invariant trivially — holes are sentinel rows reclaimed by the
+    next merge's compaction.
+    """
+    window_idx = jnp.asarray(window_idx, dtype=jnp.uint32)
+    mask = state.valid & (state.slot == window_idx)
+    out = {
+        "mask": mask,
+        "slot": state.slot,
+        "key_hi": state.key_hi,
+        "key_lo": state.key_lo,
+        "tags": state.tags,
+        "meters": state.meters,
+        "count": jnp.sum(mask.astype(jnp.int32)),
+    }
+    new_state = dataclasses.replace(
+        state,
+        slot=jnp.where(mask, jnp.uint32(SENTINEL_SLOT), state.slot),
+        valid=state.valid & ~mask,
+    )
+    return new_state, out

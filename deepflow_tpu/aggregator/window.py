@@ -1,0 +1,170 @@
+"""Window ring controller — SubQuadGen/Collector window semantics.
+
+Replicates the reference's windowed-stash protocol
+(quadruple_generator.rs:275-352, collector.rs:380-430):
+
+  * time is bucketed into fixed `interval` windows (1s or 60s);
+  * a window stays open for `delay` seconds after its end to absorb
+    out-of-order arrivals, then is flushed;
+  * arrivals older than the oldest open window are dropped and counted
+    (`drop_before_window`, collector.rs:386-391).
+
+Control flow is host-driven (the reference drives it from queue ticks);
+the data path is device-resident. One deliberate difference: the
+reference interleaves per-flow inserts with window moves, while we apply
+batch-atomic semantics — merge the whole batch, then advance the window
+to `max(batch time) - delay`. Within-batch reordering is invisible to the
+output because merges are commutative per window.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..datamodel.schema import FLOW_METER, TAG_SCHEMA, MeterSchema, TagSchema
+from .stash import StashState, stash_flush, stash_init, stash_merge
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowConfig:
+    interval: int = 1  # seconds per window
+    delay: int = 2  # seconds a window stays open past its end
+    capacity: int = 1 << 14  # stash rows shared by all open windows
+
+    @property
+    def ring(self) -> int:
+        # number of simultaneously-open windows
+        return self.delay // self.interval + 2
+
+
+@dataclasses.dataclass
+class FlushedWindow:
+    window_idx: int  # absolute window index (timestamp // interval)
+    start_time: int  # window start in seconds
+    out: dict  # device arrays from stash_flush (mask/tags/meters/...)
+    count: int
+
+
+class WindowManager:
+    """Owns one stash + the open-window span for one granularity."""
+
+    def __init__(
+        self,
+        config: WindowConfig,
+        tag_schema: TagSchema = TAG_SCHEMA,
+        meter_schema: MeterSchema = FLOW_METER,
+    ):
+        self.config = config
+        self.tag_schema = tag_schema
+        self.meter_schema = meter_schema
+        self.state: StashState = stash_init(config.capacity, tag_schema, meter_schema)
+        self.start_window: int | None = None  # oldest open window idx
+        self.drop_before_window = 0
+        self.total_docs_in = 0
+        self.total_flushed = 0
+
+    def window_of(self, timestamp):
+        return timestamp // self.config.interval
+
+    def ingest(
+        self,
+        timestamp,  # [N] u32 seconds (device or host)
+        key_hi,
+        key_lo,
+        tags,
+        meters,
+        valid,
+    ) -> list[FlushedWindow]:
+        """Merge a doc batch; advance and flush any windows that closed.
+
+        Returns flushed windows in order (possibly empty).
+        """
+        timestamp = jnp.asarray(timestamp, dtype=jnp.uint32)
+        valid = jnp.asarray(valid)
+        window = (timestamp // jnp.uint32(self.config.interval)).astype(jnp.uint32)
+
+        ts_np = np.asarray(timestamp)
+        valid_np = np.asarray(valid)
+        if not valid_np.any():
+            return []
+        t_max = int(ts_np[valid_np].max())
+
+        if self.start_window is None:
+            # Open the ring far enough back that data older than the first
+            # batch but within `delay` is still accepted — the reference
+            # starts its window 2min in the past for the same reason
+            # (quadruple_generator.rs:782-783).
+            t_min = int(ts_np[valid_np].min())
+            self.start_window = self.window_of(max(0, min(t_min, t_max - self.config.delay)))
+
+        # Late-arrival gate: rows for already-flushed windows are dropped.
+        window_np = ts_np // self.config.interval
+        late = valid_np & (window_np < self.start_window)
+        n_late = int(late.sum())
+        if n_late:
+            self.drop_before_window += n_late
+            valid = valid & (window >= jnp.uint32(self.start_window))
+        self.total_docs_in += int(valid_np.sum()) - n_late
+
+        self.state = stash_merge(
+            self.state, window, key_hi, key_lo, tags, meters, valid, self.meter_schema
+        )
+
+        # Advance: every window whose end is more than `delay` behind the
+        # newest arrival closes now (move_window, quadruple_generator.rs:339).
+        # Flush only the distinct windows actually present in the stash —
+        # a large timestamp gap (agent restart, replay skip) must not cost
+        # one device call per empty intermediate window.
+        flushed: list[FlushedWindow] = []
+        new_start = self.window_of(max(t_max - self.config.delay, 0))
+        if self.start_window < new_start:
+            slots = np.asarray(self.state.slot)
+            valid_rows = np.asarray(self.state.valid)
+            occupied = np.unique(slots[valid_rows]) if valid_rows.any() else np.array([], np.uint32)
+            for w in sorted(int(w) for w in occupied if w < new_start):
+                self.state, out = stash_flush(self.state, np.uint32(w))
+                count = int(out["count"])
+                self.total_flushed += count
+                if count:  # empty slots shift silently (reference emits nothing)
+                    flushed.append(
+                        FlushedWindow(
+                            window_idx=w,
+                            start_time=w * self.config.interval,
+                            out=out,
+                            count=count,
+                        )
+                    )
+            self.start_window = new_start
+        return flushed
+
+    def flush_all(self) -> list[FlushedWindow]:
+        """Drain every open window (shutdown path)."""
+        if self.start_window is None:
+            return []
+        flushed = []
+        slots = np.asarray(self.state.slot)
+        valid = np.asarray(self.state.valid)
+        open_windows = sorted(int(w) for w in np.unique(slots[valid])) if valid.any() else []
+        for w in open_windows:
+            self.state, out = stash_flush(self.state, np.uint32(w))
+            count = int(out["count"])
+            self.total_flushed += count
+            flushed.append(
+                FlushedWindow(window_idx=w, start_time=w * self.config.interval, out=out, count=count)
+            )
+            self.start_window = max(self.start_window, w + 1)
+        return flushed
+
+    @property
+    def counters(self) -> dict:
+        return {
+            "doc_in": self.total_docs_in,
+            "flushed_doc": self.total_flushed,
+            "drop_before_window": self.drop_before_window,
+            "drop_overflow": int(self.state.dropped_overflow),
+            "occupancy": int(np.asarray(self.state.valid).sum()),
+        }

@@ -1,0 +1,215 @@
+"""Tag-code and enum model.
+
+Mirrors the semantics of the reference's metric document model
+(/root/reference/agent/src/metric/document.rs:124-312 — Code bitflags,
+Direction, TapSide, DocumentFlag) and the server twin
+(/root/reference/server/libs/flow-metrics/tag.go:38-98). Values are kept
+bit-compatible so wire encodings and test fixtures are directly comparable
+with the reference; the *representation* here is plain Python enums feeding
+integer columns, not struct fields.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class Code(enum.IntFlag):
+    """Tag-combination bitflags (document.rs:124-151).
+
+    A document's Code says which tag fields are populated; each metrics
+    table is a fixed Code combination (tag.go:497-520).
+    """
+
+    NONE = 0
+
+    IP = 1 << 0
+    L3_EPC_ID = 1 << 1
+    MAC = 1 << 11
+    GPID = 1 << 15
+
+    IP_PATH = 1 << 20
+    L3_EPC_PATH = 1 << 21
+    MAC_PATH = 1 << 31
+    GPID_PATH = 1 << 35
+
+    DIRECTION = 1 << 40
+    ACL_GID = 1 << 41
+    PROTOCOL = 1 << 42
+    SERVER_PORT = 1 << 43
+    TAP_TYPE = 1 << 45
+    VTAP_ID = 1 << 47
+    TAP_SIDE = 1 << 48
+    TAP_PORT = 1 << 49
+    L7_PROTOCOL = 1 << 51
+
+    TUNNEL_IP_ID = 1 << 62
+
+    def has_edge_tag(self) -> bool:
+        # document.rs:154-156: any *_PATH bit set.
+        return bool(int(self) & 0xFFFFF00000)
+
+
+# The stash only ever sees a handful of Code combinations
+# (collector.rs:156-194). We assign each a small dense id — this is the
+# `CodeID` packed into the reference's fast_id — and use it as a key column.
+class CodeId(enum.IntEnum):
+    NONE = 0
+    SINGLE_IP_PORT = 1
+    SINGLE_MAC_IP_PORT = 2
+    SINGLE_MAC_IP_PORT_APP = 3
+    SINGLE_IP_PORT_APP = 4
+    EDGE_IP_PORT = 5
+    EDGE_MAC_IP_PORT = 6
+    EDGE_IP_PORT_APP = 7
+    EDGE_MAC_IP_PORT_APP = 8
+    ACL = 9
+
+
+_SINGLE_IP = Code.IP | Code.L3_EPC_ID | Code.GPID | Code.VTAP_ID | Code.PROTOCOL | Code.DIRECTION | Code.TAP_TYPE
+_EDGE_IP = (
+    Code.IP_PATH
+    | Code.L3_EPC_PATH
+    | Code.GPID_PATH
+    | Code.VTAP_ID
+    | Code.PROTOCOL
+    | Code.DIRECTION
+    | Code.TAP_TYPE
+    | Code.TAP_PORT
+)
+
+CODE_OF_ID: dict[CodeId, Code] = {
+    CodeId.NONE: Code.NONE,
+    CodeId.SINGLE_IP_PORT: _SINGLE_IP | Code.SERVER_PORT,
+    CodeId.SINGLE_MAC_IP_PORT: _SINGLE_IP | Code.MAC | Code.SERVER_PORT,
+    CodeId.SINGLE_MAC_IP_PORT_APP: _SINGLE_IP | Code.MAC | Code.SERVER_PORT | Code.L7_PROTOCOL,
+    CodeId.SINGLE_IP_PORT_APP: _SINGLE_IP | Code.SERVER_PORT | Code.L7_PROTOCOL,
+    CodeId.EDGE_IP_PORT: _EDGE_IP | Code.SERVER_PORT,
+    CodeId.EDGE_MAC_IP_PORT: _EDGE_IP | Code.MAC_PATH | Code.SERVER_PORT,
+    CodeId.EDGE_IP_PORT_APP: _EDGE_IP | Code.SERVER_PORT | Code.L7_PROTOCOL,
+    CodeId.EDGE_MAC_IP_PORT_APP: _EDGE_IP | Code.MAC_PATH | Code.SERVER_PORT | Code.L7_PROTOCOL,
+    CodeId.ACL: Code.ACL_GID | Code.TUNNEL_IP_ID | Code.VTAP_ID,
+}
+
+
+class DocumentFlag(enum.IntFlag):
+    NONE = 0  # per-minute metrics
+    PER_SECOND_METRICS = 1 << 0
+
+
+# Direction / TapSide bit layout (document.rs:166-239): low 3 bits are
+# client/server/local, bits 3+ are the observation side.
+_SIDE_NODE = 1 << 3
+_SIDE_HYPERVISOR = 2 << 3
+_SIDE_GATEWAY_HYPERVISOR = 3 << 3
+_SIDE_GATEWAY = 4 << 3
+_SIDE_PROCESS = 5 << 3
+_SIDE_APP = 6 << 3
+
+MASK_CLIENT_SERVER = 0x7
+MASK_SIDE = 0xF8
+
+
+class Direction(enum.IntEnum):
+    NONE = 0
+    CLIENT_TO_SERVER = 1 << 0
+    SERVER_TO_CLIENT = 1 << 1
+    LOCAL_TO_LOCAL = 1 << 2
+    CLIENT_NODE_TO_SERVER = (1 << 0) | _SIDE_NODE
+    SERVER_NODE_TO_CLIENT = (1 << 1) | _SIDE_NODE
+    CLIENT_HYPERVISOR_TO_SERVER = (1 << 0) | _SIDE_HYPERVISOR
+    SERVER_HYPERVISOR_TO_CLIENT = (1 << 1) | _SIDE_HYPERVISOR
+    CLIENT_GATEWAY_HYPERVISOR_TO_SERVER = (1 << 0) | _SIDE_GATEWAY_HYPERVISOR
+    SERVER_GATEWAY_HYPERVISOR_TO_CLIENT = (1 << 1) | _SIDE_GATEWAY_HYPERVISOR
+    CLIENT_GATEWAY_TO_SERVER = (1 << 0) | _SIDE_GATEWAY
+    SERVER_GATEWAY_TO_CLIENT = (1 << 1) | _SIDE_GATEWAY
+    CLIENT_PROCESS_TO_SERVER = (1 << 0) | _SIDE_PROCESS
+    SERVER_PROCESS_TO_CLIENT = (1 << 1) | _SIDE_PROCESS
+    CLIENT_APP_TO_SERVER = (1 << 0) | _SIDE_APP
+    SERVER_APP_TO_CLIENT = (1 << 1) | _SIDE_APP
+    APP = _SIDE_APP
+
+    def is_client_to_server(self) -> bool:
+        return (self & MASK_CLIENT_SERVER) == Direction.CLIENT_TO_SERVER
+
+    def is_server_to_client(self) -> bool:
+        return (self & MASK_CLIENT_SERVER) == Direction.SERVER_TO_CLIENT
+
+
+class TapSide(enum.IntEnum):
+    REST = 0
+    CLIENT = 1 << 0
+    SERVER = 1 << 1
+    LOCAL = 1 << 2
+    CLIENT_NODE = (1 << 0) | _SIDE_NODE
+    SERVER_NODE = (1 << 1) | _SIDE_NODE
+    CLIENT_HYPERVISOR = (1 << 0) | _SIDE_HYPERVISOR
+    SERVER_HYPERVISOR = (1 << 1) | _SIDE_HYPERVISOR
+    CLIENT_GATEWAY_HYPERVISOR = (1 << 0) | _SIDE_GATEWAY_HYPERVISOR
+    SERVER_GATEWAY_HYPERVISOR = (1 << 1) | _SIDE_GATEWAY_HYPERVISOR
+    CLIENT_GATEWAY = (1 << 0) | _SIDE_GATEWAY
+    SERVER_GATEWAY = (1 << 1) | _SIDE_GATEWAY
+    CLIENT_PROCESS = (1 << 0) | _SIDE_PROCESS
+    SERVER_PROCESS = (1 << 1) | _SIDE_PROCESS
+    CLIENT_APP = (1 << 0) | _SIDE_APP
+    SERVER_APP = (1 << 1) | _SIDE_APP
+    APP = _SIDE_APP
+
+    @staticmethod
+    def from_direction(direction: "Direction") -> "TapSide":
+        # document.rs:243-264 — TapSide is Direction with the direction
+        # bit kept and NONE → REST.
+        if direction == Direction.NONE:
+            return TapSide.REST
+        return TapSide(int(direction))
+
+
+class SignalSource(enum.IntEnum):
+    # agent/src/common/lookup_key.rs / flow.rs SignalSource
+    PACKET = 0
+    XFLOW = 1
+    EBPF = 3
+    OTEL = 4
+
+
+class MeterId(enum.IntEnum):
+    # meter.rs:23-25 — protobuf meter_id discriminants.
+    FLOW = 1
+    USAGE = 4
+    APP = 5
+
+
+class L7Protocol(enum.IntEnum):
+    """Subset of the reference's L7Protocol registry
+    (agent/crates/public/src/l7_protocol.rs). Values used as dense tag ids.
+    """
+
+    UNKNOWN = 0
+    OTHER = 1
+    HTTP1 = 20
+    HTTP2 = 21
+    DUBBO = 40
+    GRPC = 41
+    SOFARPC = 43
+    FASTCGI = 44
+    BRPC = 45
+    TARS = 46
+    SOME_IP = 47
+    MYSQL = 60
+    POSTGRESQL = 61
+    ORACLE = 62
+    REDIS = 80
+    MONGODB = 81
+    MEMCACHED = 82
+    KAFKA = 100
+    MQTT = 101
+    AMQP = 102
+    OPENWIRE = 103
+    NATS = 104
+    PULSAR = 105
+    ZMTP = 106
+    ROCKETMQ = 107
+    DNS = 120
+    TLS = 121
+    PING = 122
+    CUSTOM = 127

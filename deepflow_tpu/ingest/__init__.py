@@ -1,0 +1,3 @@
+from .replay import SyntheticFlowGen
+
+__all__ = ["SyntheticFlowGen"]

@@ -1,0 +1,4 @@
+from .hashing import fingerprint64, fmix32
+from .segment import Grouped, groupby_reduce
+
+__all__ = ["fingerprint64", "fmix32", "Grouped", "groupby_reduce"]

@@ -1,0 +1,57 @@
+"""Count-min sketch — heavy-hitter frequency estimation on device.
+
+`[depth, width]` int32 counter plane; row hashes are derived from the
+64-bit key fingerprint by the Kirsch–Mitzenmacher construction
+(h_d = hi + d·lo), so no extra hashing per row. Update is one scatter-add
+over the flattened plane; merge is elementwise add (`psum` over mesh axes
+for cross-chip merge — BASELINE config 4/5).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def cms_init(depth: int = 4, width: int = 1 << 16) -> jnp.ndarray:
+    assert width & (width - 1) == 0, "width must be a power of two"
+    return jnp.zeros((depth, width), dtype=jnp.int32)
+
+
+def _row_slots(hash_hi, hash_lo, depth: int, width: int):
+    """[depth, N] flattened slot indices."""
+    d = jnp.arange(depth, dtype=jnp.uint32)[:, None]
+    h = hash_hi[None, :] + d * hash_lo[None, :]  # wrapping u32
+    # avalanche the row mix so consecutive d don't alias
+    h = h ^ (h >> jnp.uint32(15))
+    h = h * jnp.uint32(0x2C1B3C6D)
+    h = h ^ (h >> jnp.uint32(12))
+    col = (h & jnp.uint32(width - 1)).astype(jnp.int32)
+    row_base = (jnp.arange(depth, dtype=jnp.int32) * width)[:, None]
+    return row_base + col
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def cms_update(state: jnp.ndarray, hash_hi, hash_lo, weight, valid) -> jnp.ndarray:
+    """Add `weight` (i32, e.g. 1 or a byte count) for each valid row."""
+    depth, width = state.shape
+    slots = _row_slots(hash_hi, hash_lo, depth, width)  # [depth, N]
+    w = jnp.where(valid, weight.astype(jnp.int32), 0)
+    w = jnp.broadcast_to(w[None, :], slots.shape)
+    flat = state.reshape(-1).at[slots.reshape(-1)].add(w.reshape(-1))
+    return flat.reshape(depth, width)
+
+
+@jax.jit
+def cms_query(state: jnp.ndarray, hash_hi, hash_lo) -> jnp.ndarray:
+    """[N] frequency estimates: min over rows."""
+    depth, width = state.shape
+    slots = _row_slots(hash_hi, hash_lo, depth, width)
+    vals = state.reshape(-1)[slots.reshape(-1)].reshape(depth, -1)
+    return jnp.min(vals, axis=0)
+
+
+def cms_merge(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return a + b

@@ -1,0 +1,75 @@
+"""Log-binned latency histograms (DDSketch-style) — the streaming
+quantile path.
+
+Per-group `[num_groups, bins]` int32 planes with geometric bin edges:
+bin(v) = floor(log_gamma(v / vmin)). Updates are one scatter-add, merges
+are elementwise add (`psum`-able), and quantile queries are a cumsum +
+threshold search at flush time. Guaranteed relative quantile error is
+(gamma-1)/(gamma+1); the default covers 1µs..~17min at ≤2% error with
+1024 bins. At window close the plane can also be compressed into t-digest
+centroids (ops/tdigest.py) for compact export.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class LogHistSpec:
+    bins: int = 1024
+    vmin: float = 1.0  # values at/below vmin land in bin 0
+    gamma: float = 1.02
+
+    @property
+    def vmax(self) -> float:
+        return self.vmin * self.gamma ** (self.bins - 1)
+
+    def rel_error(self) -> float:
+        return (self.gamma - 1.0) / (self.gamma + 1.0)
+
+
+def loghist_init(num_groups: int, spec: LogHistSpec) -> jnp.ndarray:
+    return jnp.zeros((num_groups, spec.bins), dtype=jnp.int32)
+
+
+def loghist_bin(values: jnp.ndarray, spec: LogHistSpec) -> jnp.ndarray:
+    """[N] f32 values → [N] i32 bin ids."""
+    v = jnp.maximum(values.astype(jnp.float32), spec.vmin)
+    b = jnp.floor(jnp.log(v / spec.vmin) / math.log(spec.gamma)).astype(jnp.int32)
+    return jnp.clip(b, 0, spec.bins - 1)
+
+
+@partial(jax.jit, static_argnames=("spec",), donate_argnums=(0,))
+def loghist_update(state: jnp.ndarray, group_ids, values, valid, spec: LogHistSpec) -> jnp.ndarray:
+    b = loghist_bin(values, spec)
+    gid = jnp.where(valid, group_ids, state.shape[0])  # OOB → dropped
+    return state.at[gid, b].add(1, mode="drop")
+
+
+@partial(jax.jit, static_argnames=("spec", "qs"))
+def loghist_quantiles(state: jnp.ndarray, spec: LogHistSpec, qs: tuple[float, ...]) -> jnp.ndarray:
+    """[num_groups, len(qs)] quantile estimates (geometric bin centers)."""
+    counts = state.astype(jnp.float32)
+    cum = jnp.cumsum(counts, axis=1)
+    total = cum[:, -1:]
+    centers = spec.vmin * jnp.power(
+        jnp.float32(spec.gamma), jnp.arange(spec.bins, dtype=jnp.float32) + 0.5
+    )
+    out = []
+    for q in qs:
+        target = q * total  # rank threshold per group
+        idx = jnp.sum((cum < target).astype(jnp.int32), axis=1)
+        idx = jnp.clip(idx, 0, spec.bins - 1)
+        est = centers[idx]
+        out.append(jnp.where(total[:, 0] > 0, est, 0.0))
+    return jnp.stack(out, axis=1)
+
+
+def loghist_merge(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return a + b

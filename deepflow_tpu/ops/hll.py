@@ -1,0 +1,75 @@
+"""HyperLogLog — per-group cardinality on device.
+
+The reference aggregates exactly (no sketches anywhere in server/ or
+agent/ — SURVEY §0); HLL is this framework's addition for per-service
+distinct counts (BASELINE config 3). Design for TPU:
+
+  * state is a dense `[num_groups, m]` int32 register plane
+    (m = 2^precision). Updates are one `scatter-max`; merges are
+    elementwise `max`, so cross-chip merge is a single `pmax` over the
+    mesh axis — no host round-trip.
+  * rho (leading-zero rank) is computed from the hash's hi lane via
+    floor(log2): exact, because only the top set bit matters.
+
+precision=14 → 16384 registers/group → ~0.81% standard error, meeting the
+<1% north-star bound.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def hll_init(num_groups: int, precision: int = 14) -> jnp.ndarray:
+    return jnp.zeros((num_groups, 1 << precision), dtype=jnp.int32)
+
+
+def _clz32(x: jnp.ndarray) -> jnp.ndarray:
+    """Count leading zeros of u32, exactly, via branchless binary search
+    (float log2 rounds up near powers of two, which would bias rho low)."""
+    x = x.astype(jnp.uint32)
+    zero_in = x == 0
+    n = jnp.zeros(x.shape, dtype=jnp.int32)
+    for s in (16, 8, 4, 2, 1):
+        has_s_zeros = x < jnp.uint32(1 << (32 - s))
+        n = jnp.where(has_s_zeros, n + s, n)
+        x = jnp.where(has_s_zeros, x << jnp.uint32(s), x)
+    return jnp.where(zero_in, jnp.int32(32), n)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def hll_update(state: jnp.ndarray, group_ids, hash_hi, hash_lo, valid) -> jnp.ndarray:
+    """Scatter-max one batch of observations.
+
+    group_ids: [N] i32 (rows in state); hash_hi/lo: [N] u32 fingerprint of
+    the *distinct-counted entity* (e.g. client ip); valid: [N] bool.
+    """
+    m = state.shape[1]
+    p = int(m).bit_length() - 1
+    reg = (hash_lo & jnp.uint32(m - 1)).astype(jnp.int32)
+    rho = (_clz32(hash_hi) + 1).astype(jnp.int32)  # 1..33
+    gid = jnp.where(valid, group_ids, state.shape[0])  # OOB rows dropped
+    return state.at[gid, reg].max(rho, mode="drop")
+
+
+@jax.jit
+def hll_estimate(state: jnp.ndarray) -> jnp.ndarray:
+    """[num_groups] cardinality estimates (classic HLL with small-range
+    linear-counting correction)."""
+    m = state.shape[1]
+    alpha = 0.7213 / (1.0 + 1.079 / m)
+    regs = state.astype(jnp.float32)
+    raw = alpha * m * m / jnp.sum(jnp.exp2(-regs), axis=1)
+    zeros = jnp.sum((state == 0).astype(jnp.float32), axis=1)
+    linear = m * jnp.log(m / jnp.maximum(zeros, 1.0))
+    use_linear = (raw <= 2.5 * m) & (zeros > 0)
+    return jnp.where(use_linear, linear, raw)
+
+
+def hll_merge(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Register-wise max — associative/commutative, safe under psum-style
+    tree merges (`lax.pmax` over a mesh axis does this in-network)."""
+    return jnp.maximum(a, b)

@@ -1,0 +1,121 @@
+"""Sort-based group-by reduction — the aggregation hot loop.
+
+Replaces the reference's HashMap stash merge (`Stash::add`,
+collector.rs:810; `SubQuadGen::inject_flow`, quadruple_generator.rs:544)
+with a fully static-shape XLA pattern:
+
+    lax.sort((slot, key_hi, key_lo, iota), num_keys=3)
+      → segment ids from key-change flags (cumsum)
+      → segment_sum / segment_max per meter column group
+      → representative-row gather for tag columns
+
+Everything is O(N log N) compare-exchange on u32 lanes plus a few linear
+passes — no data-dependent shapes, no serial probing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+# Sentinel slot value for invalid rows: sorts after every real window.
+SENTINEL_SLOT = np.uint32(0xFFFFFFFF)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class Grouped:
+    """Result of one group-by reduce over N input rows. All arrays have
+    leading dim N (max possible segments); `seg_valid` marks live segments
+    (prefix — segments are emitted in sorted key order)."""
+
+    slot: jnp.ndarray  # [N] u32 — window index per segment
+    key_hi: jnp.ndarray  # [N] u32
+    key_lo: jnp.ndarray  # [N] u32
+    tags: jnp.ndarray  # [N, T] u32 — representative (first) row's tags
+    meters: jnp.ndarray  # [N, M] f32 — reduced
+    seg_valid: jnp.ndarray  # [N] bool
+    num_segments: jnp.ndarray  # scalar i32 — live segment count
+
+
+def groupby_reduce(
+    slot,
+    key_hi,
+    key_lo,
+    tags,
+    meters,
+    valid,
+    sum_cols: np.ndarray,
+    max_cols: np.ndarray,
+) -> Grouped:
+    """Group rows by (slot, key_hi, key_lo) and reduce meters.
+
+    Args:
+      slot/key_hi/key_lo: [N] u32. Invalid rows are re-keyed to sentinel.
+      tags: [N, T] u32; meters: [N, M] f32; valid: [N] bool.
+      sum_cols / max_cols: static np arrays of column indices, a partition
+        of range(M) (from MeterSchema.sum_mask/max_mask).
+    """
+    n = slot.shape[0]
+    m = meters.shape[1]
+    slot = jnp.where(valid, slot, jnp.uint32(SENTINEL_SLOT))
+    key_hi = jnp.where(valid, key_hi, jnp.uint32(0xFFFFFFFF))
+    key_lo = jnp.where(valid, key_lo, jnp.uint32(0xFFFFFFFF))
+
+    iota = jnp.arange(n, dtype=jnp.int32)
+    s_slot, s_hi, s_lo, perm = lax.sort((slot, key_hi, key_lo, iota), num_keys=3)
+
+    first = jnp.concatenate(
+        [
+            jnp.ones((1,), dtype=bool),
+            (s_slot[1:] != s_slot[:-1]) | (s_hi[1:] != s_hi[:-1]) | (s_lo[1:] != s_lo[:-1]),
+        ]
+    )
+    seg_id = jnp.cumsum(first.astype(jnp.int32)) - 1  # [N], ascending
+
+    meters_sorted = jnp.take(meters, perm, axis=0)
+    reduced = jnp.zeros((n, m), dtype=meters.dtype)
+    if sum_cols.size:
+        part = jax.ops.segment_sum(meters_sorted[:, sum_cols], seg_id, num_segments=n)
+        reduced = reduced.at[:, sum_cols].set(part)
+    if max_cols.size:
+        part = jax.ops.segment_max(meters_sorted[:, max_cols], seg_id, num_segments=n)
+        # segment_max yields -inf for empty segments; zero them.
+        part = jnp.where(jnp.isfinite(part), part, 0.0)
+        reduced = reduced.at[:, max_cols].set(part)
+
+    # Representative row (first in sorted order) per segment → tags.
+    rep_sorted_pos = jax.ops.segment_min(iota, seg_id, num_segments=n)
+    rep_sorted_pos = jnp.where(rep_sorted_pos >= n, 0, rep_sorted_pos)  # empty segs
+    rep_orig = jnp.take(perm, rep_sorted_pos)
+    tags_out = jnp.take(tags, rep_orig, axis=0)
+
+    # Per-segment keys: value at the representative position.
+    slot_out = jnp.take(s_slot, rep_sorted_pos)
+    hi_out = jnp.take(s_hi, rep_sorted_pos)
+    lo_out = jnp.take(s_lo, rep_sorted_pos)
+
+    total_segments = jnp.max(seg_id) + 1
+    # Segments holding sentinel rows are invalid; they sort last, so valid
+    # segments are exactly the prefix whose slot != SENTINEL.
+    seg_index = jnp.arange(n, dtype=jnp.int32)
+    seg_valid = (seg_index < total_segments) & (slot_out != SENTINEL_SLOT)
+    num_valid = jnp.sum(seg_valid.astype(jnp.int32))
+
+    # Defensive: clear outputs of dead segments so stale tag bytes never
+    # masquerade as live keys downstream.
+    slot_out = jnp.where(seg_valid, slot_out, jnp.uint32(SENTINEL_SLOT))
+
+    return Grouped(
+        slot=slot_out,
+        key_hi=hi_out,
+        key_lo=lo_out,
+        tags=tags_out,
+        meters=reduced,
+        seg_valid=seg_valid,
+        num_segments=num_valid,
+    )

@@ -1,0 +1,86 @@
+"""t-digest centroids — compact percentile export format.
+
+The streaming path accumulates log-binned histograms (ops/histogram.py);
+at window close each group's histogram is *compressed* into a fixed-size
+t-digest: C centroids whose mass allocation follows the arcsine scale
+function k(q) = 1/2 + asin(2q−1)/π, giving fine resolution at the tails
+(p99/p999) and coarse resolution mid-distribution — the classic t-digest
+trade. All steps are sort/cumsum/segment_sum with static shapes, so the
+compressor vmaps over groups and jits cleanly; merge = concatenate + re-
+compress, which is associative up to the digest's accuracy guarantee.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .histogram import LogHistSpec
+
+
+def _kscale(q: jnp.ndarray) -> jnp.ndarray:
+    q = jnp.clip(q, 0.0, 1.0)
+    return 0.5 + jnp.arcsin(2.0 * q - 1.0) / math.pi
+
+
+@partial(jax.jit, static_argnames=("compression",))
+def tdigest_compress(means: jnp.ndarray, weights: jnp.ndarray, compression: int = 64):
+    """(means [n], weights [n]) → (means [C], weights [C]).
+
+    Zero-weight inputs are ignored. Output centroids are mean-sorted with
+    zero-weight padding at unused tail positions.
+    """
+    n = means.shape[0]
+    c = compression
+    w = jnp.maximum(weights.astype(jnp.float32), 0.0)
+    # sort by mean; zero-weight rows pushed to the end via +inf key
+    key = jnp.where(w > 0, means.astype(jnp.float32), jnp.inf)
+    key, m_s, w_s = lax.sort((key, means.astype(jnp.float32), w), num_keys=1)
+    total = jnp.sum(w_s)
+    cum = jnp.cumsum(w_s)
+    q_mid = (cum - 0.5 * w_s) / jnp.maximum(total, 1.0)
+    bucket = jnp.floor(_kscale(q_mid) * c).astype(jnp.int32)
+    bucket = jnp.clip(bucket, 0, c - 1)
+    bucket = jnp.where(w_s > 0, bucket, c)  # dropped
+    out_w = jax.ops.segment_sum(w_s, bucket, num_segments=c)
+    out_wm = jax.ops.segment_sum(w_s * m_s, bucket, num_segments=c)
+    out_m = jnp.where(out_w > 0, out_wm / jnp.maximum(out_w, 1e-30), 0.0)
+    return out_m, out_w
+
+
+def tdigest_from_loghist(hist: jnp.ndarray, spec: LogHistSpec, compression: int = 64):
+    """[G, B] histogram plane → ([G, C] means, [G, C] weights)."""
+    centers = spec.vmin * jnp.power(
+        jnp.float32(spec.gamma), jnp.arange(spec.bins, dtype=jnp.float32) + 0.5
+    )
+    f = jax.vmap(lambda h: tdigest_compress(centers, h, compression))
+    return f(hist.astype(jnp.float32))
+
+
+@jax.jit
+def tdigest_quantile(means: jnp.ndarray, weights: jnp.ndarray, qs: jnp.ndarray) -> jnp.ndarray:
+    """Interpolated quantiles from one digest ([C] means/weights, [Q] qs)."""
+    total = jnp.sum(weights)
+    cum = jnp.cumsum(weights) - 0.5 * weights
+    q_cent = cum / jnp.maximum(total, 1e-30)
+    # Zero-weight padding centroids must not drag the interpolation: park
+    # them beyond q=1 *with the largest real mean* so tail queries
+    # saturate at the true maximum instead of sliding toward mean=0.
+    real = weights > 0
+    max_mean = jnp.max(jnp.where(real, means, -jnp.inf))
+    q_cent = jnp.where(real, q_cent, 2.0)
+    means_r = jnp.where(real, means, max_mean)
+    order = jnp.argsort(q_cent)
+    out = jnp.interp(qs, q_cent[order], means_r[order])
+    return jnp.where(total > 0, out, 0.0)
+
+
+def tdigest_merge(m1, w1, m2, w2, compression: int = 64):
+    """Merge two digests (concat + re-compress)."""
+    return tdigest_compress(
+        jnp.concatenate([m1, m2]), jnp.concatenate([w1, w2]), compression
+    )

@@ -1,0 +1,3 @@
+from .numpy_oracle import OracleDoc, oracle_l4_rollup
+
+__all__ = ["OracleDoc", "oracle_l4_rollup"]

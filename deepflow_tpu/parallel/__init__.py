@@ -1,0 +1,4 @@
+from .mesh import make_mesh
+from .sharded import ShardedPipeline, SketchPlanes
+
+__all__ = ["make_mesh", "ShardedPipeline", "SketchPlanes"]

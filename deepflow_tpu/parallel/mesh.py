@@ -1,0 +1,27 @@
+"""Device mesh construction.
+
+Two logical axes mirror the reference's two scaling tiers (SURVEY §2.3):
+
+  * `chip` — intra-host ICI: replaces the per-dispatcher thread fanout
+    (trident.rs:1697); sketch merges ride ICI collectives.
+  * `host` — DCN: replaces the multi-analyzer horizontal scale with
+    agent→analyzer assignment (controller/monitor rebalance); pod-wide
+    1-minute rollups reduce over this axis only at window close.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(n_devices: int | None = None, n_hosts: int = 1, devices=None) -> Mesh:
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    n = len(devices)
+    assert n % n_hosts == 0, (n, n_hosts)
+    arr = np.asarray(devices).reshape(n_hosts, n // n_hosts)
+    return Mesh(arr, axis_names=("host", "chip"))

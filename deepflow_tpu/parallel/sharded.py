@@ -1,0 +1,191 @@
+"""Sharded pipeline — batch-dim data parallelism + collective sketch merge.
+
+The scaling model (ARCHITECTURE.md §6, SURVEY §2.3):
+
+  * The flow batch is sharded over the flattened (host, chip) mesh — each
+    device runs the *identical* fanout→fingerprint→stash-merge step on its
+    shard. Exact document stashes never merge across devices (the
+    reference's `global_thread_id`/`_tid` tag isolates per-pipeline docs
+    the same way, document.rs:293; cross-shard aggregation belongs to the
+    query layer).
+  * Sketch planes (HLL registers, count-min counters, latency histograms)
+    merge *in-network* at window close: `pmax`/`psum` over `chip` (ICI)
+    for the per-second view, then over `host` (DCN) for the pod-wide
+    1-minute rollup (BASELINE config 5). Merges are elementwise max/add,
+    so the collectives are bandwidth-optimal ring reductions XLA schedules
+    on ICI without host involvement.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..aggregator.fanout import FanoutConfig
+from ..aggregator.pipeline import make_ingest_step
+from ..aggregator.stash import StashState, stash_init
+from ..datamodel.schema import FLOW_METER, TAG_SCHEMA
+from ..ops.hashing import fingerprint64
+from ..ops.histogram import LogHistSpec, loghist_update
+from ..ops.hll import hll_update
+from ..ops.cms import cms_update
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class SketchPlanes:
+    """Per-device sketch state (leading mesh dim when sharded)."""
+
+    hll: jnp.ndarray  # [G, m] i32 — distinct clients per service
+    cms: jnp.ndarray  # [depth, width] i32 — heavy-hitter counts
+    hist: jnp.ndarray  # [G, B] i32 — latency log-histogram per service
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardedConfig:
+    fanout: FanoutConfig = FanoutConfig()
+    interval: int = 1
+    capacity_per_device: int = 1 << 12
+    num_services: int = 256
+    hll_precision: int = 10
+    cms_depth: int = 4
+    cms_width: int = 1 << 14
+    hist: LogHistSpec = LogHistSpec(bins=512, vmin=1.0, gamma=1.04)
+
+
+class ShardedPipeline:
+    """shard_map'd ingest step + collective window-close merges."""
+
+    def __init__(self, mesh: Mesh, config: ShardedConfig = ShardedConfig()):
+        self.mesh = mesh
+        self.config = config
+        self.n_devices = mesh.devices.size
+        self.axes = tuple(mesh.axis_names)  # ("host", "chip")
+        self._step = self._build_step()
+        self._close = self._build_window_close()
+
+    # -- state ----------------------------------------------------------
+    def init_state(self) -> tuple[StashState, SketchPlanes]:
+        c = self.config
+        d = self.n_devices
+
+        def dev_axis(x):
+            return jnp.broadcast_to(x[None], (d,) + x.shape)
+
+        stash = jax.tree.map(dev_axis, stash_init(c.capacity_per_device, TAG_SCHEMA, FLOW_METER))
+        sketches = SketchPlanes(
+            hll=jnp.zeros((d, c.num_services, 1 << c.hll_precision), jnp.int32),
+            cms=jnp.zeros((d, c.cms_depth, c.cms_width), jnp.int32),
+            hist=jnp.zeros((d, c.num_services, c.hist.bins), jnp.int32),
+        )
+        spec = NamedSharding(self.mesh, P(self.axes))
+        stash = jax.tree.map(lambda x: jax.device_put(x, spec), stash)
+        sketches = jax.tree.map(lambda x: jax.device_put(x, spec), sketches)
+        return stash, sketches
+
+    # -- step -----------------------------------------------------------
+    def _build_step(self):
+        c = self.config
+        base_step = make_ingest_step(c.fanout, c.interval)
+        t_idx = TAG_SCHEMA.index
+        m_idx = FLOW_METER.index
+
+        def device_step(stash, sk, tags, meters, valid):
+            # block shapes: stash [1, S, ...], tags {f: [1, n]}, ...
+            stash1 = jax.tree.map(lambda x: x[0], stash)
+            tags1 = {k: v[0] for k, v in tags.items()}
+            meters1, valid1 = meters[0], valid[0]
+
+            new_stash = base_step(stash1, tags1, meters1, valid1)
+
+            # Sketch updates from the raw flow batch (service-level keys).
+            # service id: enrichment hook — until the PlatformInfoTable
+            # lands, derive from (dst epc, server port).
+            service = (
+                (tags1["l3_epc_id1"] * jnp.uint32(131) + tags1["server_port"])
+                % jnp.uint32(c.num_services)
+            ).astype(jnp.int32)
+            client_hi, client_lo = fingerprint64(
+                jnp.stack([tags1[f"ip0_w{w}"] for w in range(4)], axis=1)
+            )
+            hll = hll_update(sk.hll[0], service, client_hi, client_lo, valid1)
+            svc_hi, svc_lo = fingerprint64(
+                jnp.stack([tags1["l3_epc_id1"], tags1["server_port"]], axis=1)
+            )
+            byte_w = meters1[:, m_idx("byte_tx")].astype(jnp.int32)
+            cms = cms_update(sk.cms[0], svc_hi, svc_lo, byte_w, valid1)
+            rtt = meters1[:, m_idx("rtt_sum")] / jnp.maximum(meters1[:, m_idx("rtt_count")], 1.0)
+            hist = loghist_update(
+                sk.hist[0], service, rtt, valid1 & (meters1[:, m_idx("rtt_count")] > 0), c.hist
+            )
+
+            expand = lambda x: x[None]
+            return (
+                jax.tree.map(expand, new_stash),
+                SketchPlanes(hll=hll[None], cms=cms[None], hist=hist[None]),
+            )
+
+        pspec = P(self.axes)
+        mapped = shard_map(
+            device_step,
+            mesh=self.mesh,
+            in_specs=(pspec, pspec, pspec, pspec, pspec),
+            out_specs=(pspec, pspec),
+        )
+        return jax.jit(mapped, donate_argnums=(0, 1))
+
+    def step(self, stash, sketches, tags, meters, valid):
+        """tags: {f: [D*n]} u32 (device-shardable), meters [D*n, M],
+        valid [D*n]. Leading dim must be divisible by the device count."""
+        d = self.n_devices
+
+        def shard_batch(x):
+            return x.reshape((d, -1) + x.shape[1:])
+
+        tags = {k: shard_batch(jnp.asarray(v)) for k, v in tags.items()}
+        meters = shard_batch(jnp.asarray(meters))
+        valid = shard_batch(jnp.asarray(valid))
+        return self._step(stash, sketches, tags, meters, valid)
+
+    # -- window close ---------------------------------------------------
+    def _build_window_close(self):
+        axes = self.axes
+
+        def close(sk: SketchPlanes):
+            sk1 = jax.tree.map(lambda x: x[0], sk)
+            # per-second global view: merge over every chip in the pod.
+            hll_global = lax.pmax(sk1.hll, axes)
+            cms_global = lax.psum(sk1.cms, axes)
+            hist_global = lax.psum(sk1.hist, axes)
+            # pod-wide 1m rollup path (DCN tier only): reduce over hosts
+            # of the already-ICI-merged per-host planes.
+            hll_host = lax.pmax(sk1.hll, axes[1])  # ICI
+            hll_pod_1m = lax.pmax(hll_host, axes[0])  # DCN
+            expand = lambda x: x[None]
+            zeroed = jax.tree.map(lambda x: jnp.zeros_like(x[None]), sk1)
+            global_view = SketchPlanes(
+                hll=expand(hll_global), cms=expand(cms_global), hist=expand(hist_global)
+            )
+            return zeroed, global_view, expand(hll_pod_1m)
+
+        pspec = P(self.axes)
+        mapped = shard_map(
+            close,
+            mesh=self.mesh,
+            in_specs=(pspec,),
+            out_specs=(pspec, pspec, pspec),
+        )
+        return jax.jit(mapped)
+
+    def window_close(self, sketches):
+        """Merge sketch planes across the mesh; returns (reset local
+        planes, globally-merged planes replicated per device, pod-wide 1m
+        HLL). Call at each window boundary."""
+        return self._close(sketches)

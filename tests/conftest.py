@@ -1,0 +1,30 @@
+"""Test harness: force an 8-device virtual CPU mesh.
+
+The container's sitecustomize initializes JAX against the single real TPU
+(axon plugin) at interpreter start, so setting env vars here is too late —
+we flip the platform config and rebuild backends instead. All tests then
+run on 8 virtual CPU devices, which is what multi-chip sharding tests
+need and keeps the real chip free for benchmarking.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    import jax.extend.backend as _jeb
+
+    _jeb.clear_backends()
+except Exception:  # pragma: no cover - older jax fallback
+    jax._src.api.clear_backends()
+
+assert jax.devices()[0].platform == "cpu", jax.devices()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,106 @@
+"""Regression tests for review-confirmed bugs."""
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+from deepflow_tpu.ops.hll import _clz32
+from deepflow_tpu.ops.tdigest import tdigest_quantile
+
+
+def test_tdigest_quantile_ignores_padding_centroids():
+    # padded digest: 2 real centroids + 2 zero-weight pads (as emitted by
+    # tdigest_compress when inputs < compression)
+    means = jnp.asarray([100.0, 200.0, 0.0, 0.0])
+    weights = jnp.asarray([10.0, 10.0, 0.0, 0.0])
+    est = np.asarray(tdigest_quantile(means, weights, jnp.asarray([0.9, 0.99])))
+    assert est[0] > 190 and est[1] > 195, est  # saturate at max mean, not →0
+
+    # fully-empty digest → 0
+    est0 = np.asarray(tdigest_quantile(jnp.zeros(4), jnp.zeros(4), jnp.asarray([0.5])))
+    assert est0[0] == 0.0
+
+
+def test_clz32_exact_all_boundaries():
+    # every power of two, its neighbors, and all-ones patterns
+    vals = []
+    for k in range(32):
+        for delta in (-1, 0, 1):
+            v = (1 << k) + delta
+            if 0 <= v < 2**32:
+                vals.append(v)
+    vals.append(0xFFFFFFFF)
+    vals.append(0)
+    arr = np.array(vals, dtype=np.uint32)
+    got = np.asarray(_clz32(jnp.asarray(arr)))
+    expected = np.array([32 if v == 0 else 32 - int(v).bit_length() for v in vals])
+    np.testing.assert_array_equal(got, expected)
+
+
+def test_fanout_epc_sign_extended_matches_oracle():
+    """A sign-extended Internet EPC (-2 as u32) must behave like folded
+    0xFFFE: client ip zeroed, folded epc in the emitted tag."""
+    from deepflow_tpu.aggregator.fanout import FanoutConfig
+    from deepflow_tpu.aggregator.pipeline import L4Pipeline, L4PipelineConfig
+    from deepflow_tpu.aggregator.window import WindowConfig
+    from deepflow_tpu.datamodel.batch import FlowBatch
+    from deepflow_tpu.datamodel.schema import TAG_SCHEMA
+
+    rec = {
+        "timestamp": 1000,
+        "signal_source": 0,
+        "ip0_w3": 0x0A000001,
+        "ip1_w3": 0x0A000002,
+        "l3_epc_id": -2,  # Internet, sign-extended through u32 fold
+        "l3_epc_id1": 7,
+        "protocol": 6,
+        "server_port": 443,
+        "direction0": 1,
+        "direction1": 2,
+        "is_active_host0": 1,
+        "is_active_host1": 1,
+        "is_active_service": 1,
+        "meter": {"packet_tx": 1},
+    }
+    pipe = L4Pipeline(
+        L4PipelineConfig(window=WindowConfig(interval=1, delay=1, capacity=64), batch_size=16)
+    )
+    pipe.ingest(FlowBatch.from_records([rec]))
+    docs = []
+    for db in pipe.drain():
+        docs.extend(db.to_dicts())
+    assert docs
+    for d in docs:
+        if d["tag"]["code_id"] in (1, 2):  # single docs
+            if d["tag"]["direction"] == 1:  # client-side: Internet epc + ip zeroed
+                assert d["tag"]["l3_epc_id"] == 0xFFFE  # folded, not sign-extended
+                assert d["tag"]["ip0_w3"] == 0
+            else:  # server-side single doc carries the dst epc/ip
+                assert d["tag"]["l3_epc_id"] == 7
+                assert d["tag"]["ip0_w3"] == 0x0A000002
+        else:  # edge docs: src (Internet) ip zeroed, folded epc kept in tag
+            assert d["tag"]["l3_epc_id"] == 0xFFFE
+            assert d["tag"]["l3_epc_id1"] == 7
+            assert d["tag"]["ip0_w3"] == 0
+            assert d["tag"]["ip1_w3"] == 0x0A000002
+
+
+def test_window_gap_advance_is_bounded():
+    """A huge timestamp jump must not do per-window device flushes."""
+    from deepflow_tpu.aggregator.pipeline import L4Pipeline, L4PipelineConfig
+    from deepflow_tpu.aggregator.window import WindowConfig
+    from deepflow_tpu.datamodel.batch import FlowBatch
+    from deepflow_tpu.ingest.replay import SyntheticFlowGen
+
+    gen = SyntheticFlowGen(num_tuples=10, seed=0)
+    pipe = L4Pipeline(
+        L4PipelineConfig(window=WindowConfig(interval=1, delay=2, capacity=1 << 10), batch_size=64)
+    )
+    pipe.ingest(FlowBatch.from_records(gen.records(10, 1000)))
+    t0 = time.perf_counter()
+    out = pipe.ingest(FlowBatch.from_records(gen.records(10, 1000 + 86_400)))  # +1 day
+    dt = time.perf_counter() - t0
+    assert dt < 10.0, f"gap advance took {dt:.1f}s — unbounded flush loop?"
+    assert [f.size > 0 for f in out] == [True]  # window 1000 flushed once
+    assert pipe.wm.start_window == 1000 + 86_400 - 2

@@ -1,0 +1,124 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepflow_tpu.ops.segment import SENTINEL_SLOT, groupby_reduce
+
+
+def _np_reference(slot, hi, lo, tags, meters, valid, sum_cols, max_cols):
+    """Dict-based oracle for the group-by."""
+    groups = {}
+    order = []
+    for i in range(len(slot)):
+        if not valid[i]:
+            continue
+        k = (int(slot[i]), int(hi[i]), int(lo[i]))
+        if k not in groups:
+            groups[k] = {"tags": tags[i], "sum": np.zeros(meters.shape[1]), "max": np.zeros(meters.shape[1])}
+            order.append(k)
+        groups[k]["sum"] += meters[i]
+        groups[k]["max"] = np.maximum(groups[k]["max"], meters[i])
+    out = {}
+    for k, g in groups.items():
+        m = np.zeros(meters.shape[1], dtype=np.float64)
+        m[sum_cols] = g["sum"][sum_cols]
+        m[max_cols] = g["max"][max_cols]
+        out[k] = (g["tags"], m)
+    return out
+
+
+def _run_and_compare(n, t, m, n_keys, seed, valid_frac=1.0):
+    rng = np.random.default_rng(seed)
+    key_ids = rng.integers(0, n_keys, size=n)
+    uniq_tags = rng.integers(0, 2**31, size=(n_keys, t), dtype=np.uint32)
+    tags = uniq_tags[key_ids]
+    slot = (rng.integers(0, 3, size=n)).astype(np.uint32)
+    hi = uniq_tags[key_ids, 0]  # deterministic per-key pseudo-hash
+    lo = uniq_tags[key_ids, 1 % t]
+    meters = rng.integers(0, 1000, size=(n, m)).astype(np.float32)
+    valid = rng.random(n) < valid_frac
+    sum_cols = np.arange(0, m - 2, dtype=np.int32)
+    max_cols = np.arange(m - 2, m, dtype=np.int32)
+
+    g = jax.jit(
+        lambda *a: groupby_reduce(*a, sum_cols=sum_cols, max_cols=max_cols)
+    )(
+        jnp.asarray(slot),
+        jnp.asarray(hi),
+        jnp.asarray(lo),
+        jnp.asarray(tags),
+        jnp.asarray(meters),
+        jnp.asarray(valid),
+    )
+
+    ref = _np_reference(slot, hi, lo, tags, meters, valid, sum_cols, max_cols)
+    nseg = int(g.num_segments)
+    assert nseg == len(ref)
+
+    got_slots = np.asarray(g.slot)
+    got_hi = np.asarray(g.key_hi)
+    got_lo = np.asarray(g.key_lo)
+    got_meters = np.asarray(g.meters)
+    got_tags = np.asarray(g.tags)
+    got_valid = np.asarray(g.seg_valid)
+    assert got_valid[:nseg].all() and not got_valid[nseg:].any()
+
+    seen = set()
+    for j in range(nseg):
+        k = (int(got_slots[j]), int(got_hi[j]), int(got_lo[j]))
+        assert k in ref, k
+        assert k not in seen
+        seen.add(k)
+        ref_tags, ref_meters = ref[k]
+        np.testing.assert_array_equal(got_tags[j], ref_tags)
+        np.testing.assert_allclose(got_meters[j], ref_meters, rtol=0, atol=0)
+    # segments are emitted sorted by (slot, hi, lo)
+    keys = [(int(got_slots[j]), int(got_hi[j]), int(got_lo[j])) for j in range(nseg)]
+    assert keys == sorted(keys)
+
+
+def test_groupby_small_exact():
+    _run_and_compare(n=64, t=4, m=6, n_keys=7, seed=0)
+
+
+def test_groupby_many_keys():
+    _run_and_compare(n=512, t=8, m=10, n_keys=200, seed=1)
+
+
+def test_groupby_with_invalid_rows():
+    _run_and_compare(n=256, t=5, m=8, n_keys=31, seed=2, valid_frac=0.7)
+
+
+def test_groupby_all_invalid():
+    n, t, m = 16, 3, 4
+    g = groupby_reduce(
+        jnp.zeros(n, jnp.uint32),
+        jnp.zeros(n, jnp.uint32),
+        jnp.zeros(n, jnp.uint32),
+        jnp.zeros((n, t), jnp.uint32),
+        jnp.ones((n, m), jnp.float32),
+        jnp.zeros(n, bool),
+        sum_cols=np.arange(m, dtype=np.int32),
+        max_cols=np.array([], dtype=np.int32),
+    )
+    assert int(g.num_segments) == 0
+    assert not np.asarray(g.seg_valid).any()
+    assert (np.asarray(g.slot) == SENTINEL_SLOT).all()
+
+
+def test_groupby_single_key_all_rows():
+    n, t, m = 128, 3, 4
+    tags = np.tile(np.array([[7, 8, 9]], dtype=np.uint32), (n, 1))
+    g = groupby_reduce(
+        jnp.full((n,), 5, jnp.uint32),
+        jnp.full((n,), 11, jnp.uint32),
+        jnp.full((n,), 13, jnp.uint32),
+        jnp.asarray(tags),
+        jnp.ones((n, m), jnp.float32),
+        jnp.ones(n, bool),
+        sum_cols=np.array([0, 1], dtype=np.int32),
+        max_cols=np.array([2, 3], dtype=np.int32),
+    )
+    assert int(g.num_segments) == 1
+    np.testing.assert_array_equal(np.asarray(g.meters)[0], [n, n, 1, 1])
+    np.testing.assert_array_equal(np.asarray(g.tags)[0], [7, 8, 9])

@@ -1,0 +1,173 @@
+import jax.numpy as jnp
+import numpy as np
+
+from deepflow_tpu.ops.cms import cms_init, cms_merge, cms_query, cms_update
+from deepflow_tpu.ops.hashing import fingerprint64
+from deepflow_tpu.ops.histogram import (
+    LogHistSpec,
+    loghist_init,
+    loghist_merge,
+    loghist_quantiles,
+    loghist_update,
+)
+from deepflow_tpu.ops.hll import hll_estimate, hll_init, hll_merge, hll_update
+from deepflow_tpu.ops.tdigest import (
+    tdigest_compress,
+    tdigest_from_loghist,
+    tdigest_merge,
+    tdigest_quantile,
+)
+
+
+def _hashes(n, seed=0, lo_card=None):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, lo_card if lo_card else 2**31, size=(n, 1), dtype=np.uint32)
+    hi, lo = fingerprint64(jnp.asarray(ids))
+    return ids[:, 0], hi, lo
+
+
+class TestHLL:
+    def test_accuracy_1pct(self):
+        true_card = 100_000
+        ids, hi, lo = _hashes(200_000, seed=3, lo_card=true_card)
+        # ~all of true_card values appear (coupon collector at 2x draws ~86%)
+        expected = len(np.unique(ids))
+        state = hll_init(4, precision=14)
+        gids = jnp.zeros(len(ids), dtype=jnp.int32)
+        state = hll_update(state, gids, hi, lo, jnp.ones(len(ids), bool))
+        est = float(hll_estimate(state)[0])
+        assert abs(est - expected) / expected < 0.02
+        # untouched groups estimate 0
+        assert float(hll_estimate(state)[1]) == 0.0
+
+    def test_small_range_linear_counting(self):
+        ids, hi, lo = _hashes(500, seed=4, lo_card=300)
+        expected = len(np.unique(ids))
+        state = hll_init(1, precision=12)
+        state = hll_update(state, jnp.zeros(500, jnp.int32), hi, lo, jnp.ones(500, bool))
+        est = float(hll_estimate(state)[0])
+        assert abs(est - expected) / expected < 0.05
+
+    def test_merge_equals_union(self):
+        ids1, hi1, lo1 = _hashes(5000, seed=5, lo_card=4000)
+        ids2, hi2, lo2 = _hashes(5000, seed=6, lo_card=4000)
+        s1 = hll_update(hll_init(1, 12), jnp.zeros(5000, jnp.int32), hi1, lo1, jnp.ones(5000, bool))
+        s2 = hll_update(hll_init(1, 12), jnp.zeros(5000, jnp.int32), hi2, lo2, jnp.ones(5000, bool))
+        both = hll_update(
+            hll_update(hll_init(1, 12), jnp.zeros(5000, jnp.int32), hi1, lo1, jnp.ones(5000, bool)),
+            jnp.zeros(5000, jnp.int32),
+            hi2,
+            lo2,
+            jnp.ones(5000, bool),
+        )
+        merged = hll_merge(s1, s2)
+        np.testing.assert_array_equal(np.asarray(merged), np.asarray(both))
+
+    def test_group_isolation(self):
+        ids, hi, lo = _hashes(2000, seed=7, lo_card=1000)
+        gids = jnp.asarray((np.arange(2000) % 2).astype(np.int32))
+        state = hll_update(hll_init(2, 12), gids, hi, lo, jnp.ones(2000, bool))
+        e = np.asarray(hll_estimate(state))
+        for g in (0, 1):
+            expected = len(np.unique(ids[np.arange(2000) % 2 == g]))
+            assert abs(e[g] - expected) / expected < 0.06
+
+
+class TestCMS:
+    def test_point_queries_upper_bound(self):
+        rng = np.random.default_rng(8)
+        # zipf-ish frequencies over 1000 keys
+        keys = rng.zipf(1.3, size=50_000) % 1000
+        ids = keys.astype(np.uint32)[:, None]
+        hi, lo = fingerprint64(jnp.asarray(ids))
+        state = cms_init(depth=4, width=1 << 14)
+        state = cms_update(state, hi, lo, jnp.ones(len(keys), jnp.int32), jnp.ones(len(keys), bool))
+
+        uniq = np.unique(keys)
+        uh, ul = fingerprint64(jnp.asarray(uniq.astype(np.uint32)[:, None]))
+        est = np.asarray(cms_query(state, uh, ul))
+        true = np.array([(keys == k).sum() for k in uniq])
+        assert (est >= true).all()  # CMS never underestimates
+        # heavy hitters well approximated
+        heavy = true > 500
+        assert np.all((est[heavy] - true[heavy]) / true[heavy] < 0.05)
+
+    def test_merge_additive(self):
+        ids = np.arange(100, dtype=np.uint32)[:, None]
+        hi, lo = fingerprint64(jnp.asarray(ids))
+        ones = jnp.ones(100, jnp.int32)
+        v = jnp.ones(100, bool)
+        s1 = cms_update(cms_init(2, 1 << 10), hi, lo, ones, v)
+        s2 = cms_update(cms_init(2, 1 << 10), hi, lo, ones, v)
+        m = cms_merge(s1, s2)
+        est = np.asarray(cms_query(m, hi, lo))
+        assert (est >= 2).all()
+
+
+class TestLogHist:
+    SPEC = LogHistSpec(bins=1024, vmin=1.0, gamma=1.02)
+
+    def test_quantile_rel_error(self):
+        rng = np.random.default_rng(9)
+        vals = rng.lognormal(mean=6.0, sigma=1.5, size=100_000).astype(np.float32)
+        state = loghist_init(1, self.SPEC)
+        state = loghist_update(
+            state, jnp.zeros(len(vals), jnp.int32), jnp.asarray(vals), jnp.ones(len(vals), bool), self.SPEC
+        )
+        qs = (0.5, 0.95, 0.99)
+        est = np.asarray(loghist_quantiles(state, self.SPEC, qs))[0]
+        for q, e in zip(qs, est):
+            true = np.quantile(vals, q)
+            assert abs(e - true) / true < 0.03, (q, e, true)
+
+    def test_merge(self):
+        rng = np.random.default_rng(10)
+        a = rng.uniform(1, 1000, 5000).astype(np.float32)
+        b = rng.uniform(1, 1000, 5000).astype(np.float32)
+        g = jnp.zeros(5000, jnp.int32)
+        v = jnp.ones(5000, bool)
+        sa = loghist_update(loghist_init(1, self.SPEC), g, jnp.asarray(a), v, self.SPEC)
+        sb = loghist_update(loghist_init(1, self.SPEC), g, jnp.asarray(b), v, self.SPEC)
+        merged = loghist_merge(sa, sb)
+        est = float(np.asarray(loghist_quantiles(merged, self.SPEC, (0.5,)))[0, 0])
+        true = np.quantile(np.concatenate([a, b]), 0.5)
+        assert abs(est - true) / true < 0.03
+
+
+class TestTDigest:
+    def test_compress_and_quantile(self):
+        rng = np.random.default_rng(11)
+        vals = rng.gamma(2.0, 300.0, size=20_000).astype(np.float32)
+        m, w = tdigest_compress(jnp.asarray(vals), jnp.ones(len(vals), jnp.float32), compression=100)
+        qs = jnp.asarray([0.5, 0.9, 0.99])
+        est = np.asarray(tdigest_quantile(m, w, qs))
+        for q, e in zip([0.5, 0.9, 0.99], est):
+            true = np.quantile(vals, q)
+            assert abs(e - true) / true < 0.05, (q, e, true)
+
+    def test_from_loghist_pipeline(self):
+        spec = LogHistSpec(bins=1024, vmin=1.0, gamma=1.02)
+        rng = np.random.default_rng(12)
+        vals = rng.lognormal(5.0, 1.0, size=50_000).astype(np.float32)
+        state = loghist_init(2, spec)
+        state = loghist_update(
+            state, jnp.zeros(len(vals), jnp.int32), jnp.asarray(vals), jnp.ones(len(vals), bool), spec
+        )
+        means, weights = tdigest_from_loghist(state, spec, compression=64)
+        assert means.shape == (2, 64)
+        est = float(np.asarray(tdigest_quantile(means[0], weights[0], jnp.asarray([0.99]))[0]))
+        true = np.quantile(vals, 0.99)
+        assert abs(est - true) / true < 0.05
+        # empty group → all-zero digest
+        assert float(weights[1].sum()) == 0.0
+
+    def test_merge_two_digests(self):
+        rng = np.random.default_rng(13)
+        a = rng.normal(1000, 100, 10_000).astype(np.float32)
+        b = rng.normal(2000, 100, 10_000).astype(np.float32)
+        ma, wa = tdigest_compress(jnp.asarray(a), jnp.ones(len(a), jnp.float32), compression=100)
+        mb, wb = tdigest_compress(jnp.asarray(b), jnp.ones(len(b), jnp.float32), compression=100)
+        m, w = tdigest_merge(ma, wa, mb, wb, compression=100)
+        est = float(np.asarray(tdigest_quantile(m, w, jnp.asarray([0.5]))[0]))
+        true = np.quantile(np.concatenate([a, b]), 0.5)
+        assert abs(est - true) / true < 0.05

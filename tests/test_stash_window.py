@@ -1,0 +1,117 @@
+import jax.numpy as jnp
+import numpy as np
+
+from deepflow_tpu.datamodel.schema import MergeOp, MeterField, MeterSchema, TagField, TagSchema
+from deepflow_tpu.aggregator.stash import stash_flush, stash_init, stash_merge
+from deepflow_tpu.aggregator.window import WindowConfig, WindowManager
+
+TINY_METER = MeterSchema(
+    "tiny",
+    (
+        MeterField("a", MergeOp.SUM),
+        MeterField("b", MergeOp.SUM),
+        MeterField("mx", MergeOp.MAX),
+    ),
+)
+TINY_TAGS = TagSchema((TagField("k1"), TagField("k2")))
+
+
+def _mkbatch(rows):
+    """rows: list of (slot, hi, lo, (k1,k2), (a,b,mx))"""
+    n = len(rows)
+    slot = jnp.asarray(np.array([r[0] for r in rows], dtype=np.uint32))
+    hi = jnp.asarray(np.array([r[1] for r in rows], dtype=np.uint32))
+    lo = jnp.asarray(np.array([r[2] for r in rows], dtype=np.uint32))
+    tags = jnp.asarray(np.array([r[3] for r in rows], dtype=np.uint32))
+    meters = jnp.asarray(np.array([r[4] for r in rows], dtype=np.float32))
+    valid = jnp.ones((n,), dtype=bool)
+    return slot, hi, lo, tags, meters, valid
+
+
+def test_stash_merge_accumulates_across_batches():
+    st = stash_init(8, TINY_TAGS, TINY_METER)
+    b1 = _mkbatch([(1, 10, 0, (7, 8), (1, 2, 5)), (1, 11, 0, (9, 9), (10, 0, 1))])
+    st = stash_merge(st, *b1, TINY_METER)
+    b2 = _mkbatch([(1, 10, 0, (7, 8), (4, 4, 2))])
+    st = stash_merge(st, *b2, TINY_METER)
+
+    st, out = stash_flush(st, 1)
+    assert int(out["count"]) == 2
+    mask = np.asarray(out["mask"])
+    meters = np.asarray(out["meters"])[mask]
+    his = np.asarray(out["key_hi"])[mask]
+    row = {int(h): m for h, m in zip(his, meters)}
+    np.testing.assert_array_equal(row[10], [5, 6, 5])  # sums + max
+    np.testing.assert_array_equal(row[11], [10, 0, 1])
+    # flushed rows are gone
+    st, out2 = stash_flush(st, 1)
+    assert int(out2["count"]) == 0
+
+
+def test_stash_overflow_drops_newest_window():
+    st = stash_init(4, TINY_TAGS, TINY_METER)
+    # window 1: two keys; window 2: four keys → 6 segments > capacity 4
+    rows = [(1, i, 0, (i, 0), (1, 0, 0)) for i in (1, 2)]
+    rows += [(2, i, 0, (i, 0), (1, 0, 0)) for i in (1, 2, 3, 4)]
+    st = stash_merge(st, *_mkbatch(rows), TINY_METER)
+    assert int(st.dropped_overflow) == 2
+    # older window fully retained
+    st, out = stash_flush(st, 1)
+    assert int(out["count"]) == 2
+
+
+def test_window_manager_flushes_after_delay():
+    wm = WindowManager(WindowConfig(interval=1, delay=2, capacity=16), TINY_TAGS, TINY_METER)
+
+    def batch(ts_list, key_list):
+        n = len(ts_list)
+        ts = np.array(ts_list, dtype=np.uint32)
+        hi = np.array(key_list, dtype=np.uint32)
+        lo = np.zeros(n, dtype=np.uint32)
+        tags = np.stack([hi, hi], axis=1).astype(np.uint32)
+        meters = np.ones((n, 3), dtype=np.float32)
+        return (
+            jnp.asarray(ts),
+            jnp.asarray(hi),
+            jnp.asarray(lo),
+            jnp.asarray(tags),
+            jnp.asarray(meters),
+            jnp.ones(n, dtype=bool),
+        )
+
+    # t=100,101 → nothing closes yet (delay 2)
+    assert wm.ingest(*batch([100, 100, 101], [1, 1, 2])) == []
+    # t=103 → window 100 closes (103-2=101 > 100)
+    flushed = wm.ingest(*batch([103], [3]))
+    assert [f.window_idx for f in flushed] == [100]
+    f = flushed[0]
+    assert f.count == 1  # key 1 merged twice in window 100
+    mask = np.asarray(f.out["mask"])
+    np.testing.assert_array_equal(np.asarray(f.out["meters"])[mask][0], [2, 2, 1])
+
+    # late arrival for window 100 is dropped
+    assert wm.ingest(*batch([100], [9])) == []
+    assert wm.drop_before_window == 1
+
+    # drain
+    rest = wm.flush_all()
+    assert [f.window_idx for f in rest] == [101, 103]
+    assert wm.counters["occupancy"] == 0
+
+
+def test_window_manager_multi_window_batch():
+    wm = WindowManager(WindowConfig(interval=1, delay=1, capacity=32), TINY_TAGS, TINY_METER)
+    ts = [10, 11, 12, 13, 14]
+    n = len(ts)
+    b = (
+        jnp.asarray(np.array(ts, dtype=np.uint32)),
+        jnp.asarray(np.arange(n, dtype=np.uint32)),
+        jnp.zeros(n, dtype=jnp.uint32),
+        jnp.zeros((n, 2), dtype=jnp.uint32),
+        jnp.ones((n, 3), dtype=jnp.float32),
+        jnp.ones(n, dtype=bool),
+    )
+    flushed = wm.ingest(*b)
+    # t_max=14, delay=1 → windows 10..12 close
+    assert [f.window_idx for f in flushed] == [10, 11, 12]
+    assert all(f.count == 1 for f in flushed)
