@@ -1,21 +1,37 @@
-"""Document fanout — vectorized `fill_l4_stats`.
+"""Document fanout — vectorized `fill_l4_stats` / `fill_l7_stats`.
 
 The reference emits up to 4 documents per accumulated flow
-(collector.rs:500-607): one *single-ended* doc per endpoint whose
-direction is known (client view and server view, the server view with a
-tx/rx-reversed meter) and one *edge* doc per known direction (plus a
-rest/edge doc when both directions are unknown). Data-dependent emission
-counts don't exist on TPU, so we always emit a fixed [4, N] block with a
-validity mask — lane 0/1 are the ep0/ep1 single docs, lane 2/3 the ep0/ep1
-edge docs (lane 3 doubles as the both-directions-unknown rest doc).
+(collector.rs:500-607 for L4, :694-821 for L7): one *single-ended* doc per
+endpoint whose direction is known (client view and server view) and one
+*edge* doc per known direction (plus a rest/edge doc when both directions
+are unknown). Data-dependent emission counts don't exist on TPU, so we
+always emit a fixed [4, N] block with a validity mask — lane 0/1 are the
+ep0/ep1 single docs, lane 2/3 the ep0/ep1 edge docs (lane 3 doubles as
+the both-directions-unknown rest doc).
 
 Tag construction mirrors get_single_tagger / get_edge_tagger
 (collector.rs:882-1095): inactive-IP zeroing, Internet-EPC zeroing,
 vip-interface MAC gating, server-port suppression
 (`ignore_server_port`, collector.rs:877), OTel epc clamping
-(get_l3_epc_id, collector.rs:1097). Columns not covered by the doc's Code
+(get_l3_epc_id, collector.rs:1097), the both-hosts-inactive record drop
+(collector.rs:489-493, :684-687). Columns not covered by the doc's Code
 are zeroed, which is what makes "fingerprint all key columns" equivalent
 to StashKey equality.
+
+L4 vs L7 is one code path (`_make_lanes(app=...)`) differing only in:
+  * CodeIds (`*_APP` variants) and meter_id (Flow vs App);
+  * the L7 gate l7_protocol != Unknown (OTel exempt, collector.rs:794,816);
+  * the single-doc direction gate: L4 takes only pure c/s/local
+    directions, L7 additionally admits side-carrying directions
+    (c-p/s-p/c-app/s-app/app) for non-Packet signal sources
+    (collector.rs:796-803);
+  * edge-doc signal gate: L4 edge docs exist only for Packet/XFlow
+    (fill_edge_l4_stats, :600-607), L7 edge docs have no gate (:813-821);
+  * the app meter is never reversed (both endpoint views share one RED
+    meter, :737-787), while the L4 server single doc gets the tx/rx-
+    reversed flow meter (meter.rs:169-176);
+  * L7 docs carry l7_protocol / endpoint_hash / biz_type / time_span
+    key columns.
 
 Omitted here: the ACL/UsageMeter policy docs (collector.rs:440-487) —
 they depend on the minute-granularity policy id_maps and are emitted by
@@ -58,18 +74,14 @@ def _u32(x):
     return jnp.asarray(x, dtype=jnp.uint32)
 
 
-@partial(jax.jit, static_argnames=("config",))
-def fanout_l4(tags: dict, meters: jnp.ndarray, valid: jnp.ndarray, config: FanoutConfig):
-    """FlowBatch columns → DocBatch arrays of shape [4N, ...].
+def _tap_side(direction: jnp.ndarray) -> jnp.ndarray:
+    # TapSide::from(Direction) (document.rs:243-264): identity on the bit
+    # pattern, with NONE → REST (both 0).
+    return direction
 
-    Args:
-      tags: dict of [N] u32 columns named per FLOW_RECORD_TAG_FIELDS.
-      meters: [N, M] f32 FlowMeter rows (client-view).
-      valid: [N] bool.
-    Returns:
-      (doc_tags [4N, T] u32, doc_meters [4N, M] f32, doc_ts [4N] u32,
-       doc_valid [4N] bool)
-    """
+
+def _make_lanes(tags: dict, meters: jnp.ndarray, valid: jnp.ndarray, config: FanoutConfig, app: bool):
+    """Build the four (cols, lane_valid, lane_meter) lanes."""
     n = meters.shape[0]
     zero = jnp.zeros((n,), dtype=jnp.uint32)
 
@@ -77,8 +89,8 @@ def fanout_l4(tags: dict, meters: jnp.ndarray, valid: jnp.ndarray, config: Fanou
     dir1 = tags["direction1"]
     sig = tags["signal_source"]
     is_otel = sig == jnp.uint32(SignalSource.OTEL)
-    is_pkt_or_xflow = (sig == jnp.uint32(SignalSource.PACKET)) | (sig == jnp.uint32(SignalSource.XFLOW))
-    is_v6 = tags["is_ipv6"] != 0
+    is_packet = sig == jnp.uint32(SignalSource.PACKET)
+    is_pkt_or_xflow = is_packet | (sig == jnp.uint32(SignalSource.XFLOW))
     proto = tags["protocol"]
 
     active0 = tags["is_active_host0"] != 0
@@ -86,10 +98,21 @@ def fanout_l4(tags: dict, meters: jnp.ndarray, valid: jnp.ndarray, config: Fanou
     vip0 = tags["is_vip0"] != 0
     vip1 = tags["is_vip1"] != 0
 
-    # reversed meter for the server-endpoint single doc (meter.rs:169-176)
-    perm = jnp.asarray(FLOW_METER.reverse_perm)
-    zmask = jnp.asarray(~FLOW_METER.reverse_zero_mask, dtype=meters.dtype)
-    meters_rev = meters[:, perm] * zmask[None, :]
+    # Whole-record gates: both-hosts-inactive drop (collector.rs:489-493,
+    # :684-687) and, for L7, the unknown-protocol drop (:794,:816).
+    if config.inactive_ip_aggregation:
+        valid = valid & (active0 | active1)
+    if app:
+        l7_known = (tags["l7_protocol"] != 0) | is_otel
+        valid = valid & l7_known
+
+    # reversed meter for the L4 server-endpoint single doc (meter.rs:169-176)
+    if app:
+        meters_rev = meters
+    else:
+        perm = jnp.asarray(FLOW_METER.reverse_perm)
+        zmask = jnp.asarray(~FLOW_METER.reverse_zero_mask, dtype=meters.dtype)
+        meters_rev = meters[:, perm] * zmask[None, :]
 
     # ignore_server_port (collector.rs:877)
     inactive_service = tags["is_active_service"] == 0
@@ -115,6 +138,25 @@ def fanout_l4(tags: dict, meters: jnp.ndarray, valid: jnp.ndarray, config: Fanou
     def masked_ip(ip, keep):
         return [jnp.where(keep, w, zero) for w in ip]
 
+    meter_id = MeterId.APP if app else MeterId.FLOW
+    shared_cols = {
+        "meter_id": jnp.full((n,), meter_id, jnp.uint32),
+        "global_thread_id": jnp.full((n,), config.global_thread_id, jnp.uint32),
+        "agent_id": jnp.full((n,), config.agent_id, jnp.uint32),
+        "is_ipv6": tags["is_ipv6"],
+        "protocol": proto,
+        "tap_type": tags["tap_type"],
+        "signal_source": sig,
+        "pod_id": tags["pod_id"],
+    }
+    if app:
+        shared_cols.update(
+            l7_protocol=tags["l7_protocol"],
+            endpoint_hash=tags["endpoint_hash"],
+            biz_type=tags["biz_type"],
+            time_span=tags["time_span"],
+        )
+
     # ---- single docs (lanes 0, 1) -------------------------------------
     def single_lane(ep):
         d = dir0 if ep == 0 else dir1
@@ -125,9 +167,12 @@ def fanout_l4(tags: dict, meters: jnp.ndarray, valid: jnp.ndarray, config: Fanou
         gpid = tags["gpid0"] if ep == 0 else tags["gpid1"]
         mac = (tags["mac0_hi"], tags["mac0_lo"]) if ep == 0 else (tags["mac1_hi"], tags["mac1_lo"])
 
-        # emission gate (fill_l4_stats + fill_single_l4_stats)
-        no_side = (d & jnp.uint32(_DIR_SIDE_MASK)) == 0
-        lane_valid = valid & (d != 0) & no_side
+        # emission gate (fill_single_l4_stats / fill_single_l7_stats):
+        # pure c/s/local directions; L7 additionally admits sided
+        # directions for non-Packet sources.
+        pure_dir = (d & jnp.uint32(_DIR_SIDE_MASK)) == 0
+        dir_ok = (pure_dir | ~is_packet) if app else pure_dir
+        lane_valid = valid & (d != 0) & dir_ok
         if config.inactive_ip_aggregation:
             lane_valid = lane_valid & active
 
@@ -146,19 +191,16 @@ def fanout_l4(tags: dict, meters: jnp.ndarray, valid: jnp.ndarray, config: Fanou
         mac_lo = jnp.where(has_mac, mac[1], zero)
         code_id = jnp.where(
             has_mac,
-            jnp.uint32(CodeId.SINGLE_MAC_IP_PORT),
-            jnp.uint32(CodeId.SINGLE_IP_PORT),
+            jnp.uint32(CodeId.SINGLE_MAC_IP_PORT_APP if app else CodeId.SINGLE_MAC_IP_PORT),
+            jnp.uint32(CodeId.SINGLE_IP_PORT_APP if app else CodeId.SINGLE_IP_PORT),
         )
         # "If the resource is located on the client, the service port is
         # ignored" (collector.rs:948-955)
         port = zero if ep == 0 else dst_port
 
         cols = {
+            **shared_cols,
             "code_id": code_id,
-            "meter_id": jnp.full((n,), MeterId.FLOW, jnp.uint32),
-            "global_thread_id": jnp.full((n,), config.global_thread_id, jnp.uint32),
-            "agent_id": jnp.full((n,), config.agent_id, jnp.uint32),
-            "is_ipv6": tags["is_ipv6"],
             "ip0_w0": ip_w[0],
             "ip0_w1": ip_w[1],
             "ip0_w2": ip_w[2],
@@ -168,12 +210,8 @@ def fanout_l4(tags: dict, meters: jnp.ndarray, valid: jnp.ndarray, config: Fanou
             "mac0_lo": mac_lo,
             "direction": d,
             "tap_side": _tap_side(d),
-            "protocol": proto,
             "server_port": port,
-            "tap_type": tags["tap_type"],
             "gpid0": gpid,
-            "signal_source": sig,
-            "pod_id": tags["pod_id"],
         }
         return cols, lane_valid, (meters if ep == 0 else meters_rev)
 
@@ -193,8 +231,9 @@ def fanout_l4(tags: dict, meters: jnp.ndarray, valid: jnp.ndarray, config: Fanou
             lane_valid = valid & ((dir1 != 0) | both_none)
         else:
             lane_valid = valid & (d != 0)
-        # L4 edge docs exist only for Packet/XFlow (fill_edge_l4_stats)
-        lane_valid = lane_valid & is_pkt_or_xflow
+        if not app:
+            # L4 edge docs exist only for Packet/XFlow (fill_edge_l4_stats)
+            lane_valid = lane_valid & is_pkt_or_xflow
 
         # ip rewrite (get_edge_tagger, Managed)
         if config.inactive_ip_aggregation:
@@ -216,16 +255,13 @@ def fanout_l4(tags: dict, meters: jnp.ndarray, valid: jnp.ndarray, config: Fanou
         any_mac = (mac0_hi | mac0_lo | mac1_hi | mac1_lo) != 0
         code_id = jnp.where(
             any_mac,
-            jnp.uint32(CodeId.EDGE_MAC_IP_PORT),
-            jnp.uint32(CodeId.EDGE_IP_PORT),
+            jnp.uint32(CodeId.EDGE_MAC_IP_PORT_APP if app else CodeId.EDGE_MAC_IP_PORT),
+            jnp.uint32(CodeId.EDGE_IP_PORT_APP if app else CodeId.EDGE_IP_PORT),
         )
 
         cols = {
+            **shared_cols,
             "code_id": code_id,
-            "meter_id": jnp.full((n,), MeterId.FLOW, jnp.uint32),
-            "global_thread_id": jnp.full((n,), config.global_thread_id, jnp.uint32),
-            "agent_id": jnp.full((n,), config.agent_id, jnp.uint32),
-            "is_ipv6": tags["is_ipv6"],
             "ip0_w0": src_ip[0],
             "ip0_w1": src_ip[1],
             "ip0_w2": src_ip[2],
@@ -242,18 +278,19 @@ def fanout_l4(tags: dict, meters: jnp.ndarray, valid: jnp.ndarray, config: Fanou
             "mac1_lo": mac1_lo,
             "direction": d,
             "tap_side": _tap_side(d),
-            "protocol": proto,
             "server_port": dst_port,
             "tap_port": tags["tap_port"],
-            "tap_type": tags["tap_type"],
             "gpid0": tags["gpid0"],
             "gpid1": tags["gpid1"],
-            "signal_source": sig,
-            "pod_id": tags["pod_id"],
         }
         return cols, lane_valid, meters
 
-    lanes = [single_lane(0), single_lane(1), edge_lane(0), edge_lane(1)]
+    return [single_lane(0), single_lane(1), edge_lane(0), edge_lane(1)]
+
+
+def _fanout_impl(tags: dict, meters: jnp.ndarray, valid: jnp.ndarray, config: FanoutConfig, app: bool):
+    n = meters.shape[0]
+    lanes = _make_lanes(tags, meters, valid, config, app)
 
     t_count = _T.num_fields
     doc_tags = jnp.zeros((4, n, t_count), dtype=jnp.uint32)
@@ -276,7 +313,26 @@ def fanout_l4(tags: dict, meters: jnp.ndarray, valid: jnp.ndarray, config: Fanou
     )
 
 
-def _tap_side(direction: jnp.ndarray) -> jnp.ndarray:
-    # TapSide::from(Direction) (document.rs:243-264): identity on the bit
-    # pattern, with NONE → REST (both 0).
-    return direction
+@partial(jax.jit, static_argnames=("config",))
+def fanout_l4(tags: dict, meters: jnp.ndarray, valid: jnp.ndarray, config: FanoutConfig):
+    """FlowBatch columns → DocBatch arrays of shape [4N, ...].
+
+    Args:
+      tags: dict of [N] u32 columns named per FLOW_RECORD_TAG_FIELDS.
+      meters: [N, M] f32 FlowMeter rows (client-view).
+      valid: [N] bool.
+    Returns:
+      (doc_tags [4N, T] u32, doc_meters [4N, M] f32, doc_ts [4N] u32,
+       doc_valid [4N] bool)
+    """
+    return _fanout_impl(tags, meters, valid, config, app=False)
+
+
+@partial(jax.jit, static_argnames=("config",))
+def fanout_l7(tags: dict, meters: jnp.ndarray, valid: jnp.ndarray, config: FanoutConfig):
+    """AppMeterWithFlow columns → L7 doc arrays of shape [4N, ...].
+
+    Same contract as fanout_l4 with meters following APP_METER; see the
+    module docstring for the L4/L7 semantic deltas.
+    """
+    return _fanout_impl(tags, meters, valid, config, app=True)

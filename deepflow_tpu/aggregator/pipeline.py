@@ -1,10 +1,12 @@
-"""L4 flow-metrics rollup pipeline — the end-to-end device slice.
+"""Flow-metrics rollup pipelines (L4 network + L7 application) — the
+end-to-end device slice.
 
-Composes: fanout (fill_l4_stats) → key fingerprint → windowed stash
-merge → flush → DocBatch emission. This is the TPU replacement for the
-reference chain QuadrupleGenerator::inject_flow → Collector::collect_l4 →
-Stash::add → flush_stats (SURVEY §3.1), collapsed into one jit step per
-batch plus a host-driven window controller.
+Composes: fanout (fill_l4_stats / fill_l7_stats) → key fingerprint →
+windowed stash merge → flush → DocBatch emission. This is the TPU
+replacement for the reference chains QuadrupleGenerator::inject_flow →
+Collector::collect_l4 → Stash::add → flush_stats and
+L7QuadrupleGenerator → L7Collector::collect_l7 (SURVEY §3.1), collapsed
+into one jit step per batch plus a host-driven window controller.
 """
 
 from __future__ import annotations
@@ -16,27 +18,32 @@ import numpy as np
 
 from ..datamodel.batch import DocBatch, FlowBatch
 from ..datamodel.code import DocumentFlag
-from ..datamodel.schema import FLOW_METER, TAG_SCHEMA
+from ..datamodel.schema import APP_METER, FLOW_METER, TAG_SCHEMA, MeterSchema
 from ..ops.hashing import fingerprint64
-from .fanout import FanoutConfig, fanout_l4
+from .fanout import FanoutConfig, fanout_l4, fanout_l7
 from .window import FlushedWindow, WindowConfig, WindowManager
 
 _KEY_COLS = np.nonzero(TAG_SCHEMA.key_mask)[0].astype(np.int32)
 
 
-def make_ingest_step(fanout_config: FanoutConfig, interval: int = 1):
+def make_ingest_step(
+    fanout_config: FanoutConfig,
+    interval: int = 1,
+    meter_schema: MeterSchema = FLOW_METER,
+    fanout_fn=fanout_l4,
+):
     """Build the pure device step: FlowBatch columns → merged stash.
 
     state' = step(state, tags, meters, valid). This is the function the
-    benchmark times and the graft entry exposes; L4Pipeline uses the same
-    building blocks but drives window flushes from the host.
+    benchmark times and the graft entry exposes; RollupPipeline uses the
+    same building blocks but drives window flushes from the host.
     """
-    sum_cols = tuple(int(i) for i in np.nonzero(FLOW_METER.sum_mask)[0])
-    max_cols = tuple(int(i) for i in np.nonzero(FLOW_METER.max_mask)[0])
+    sum_cols = tuple(int(i) for i in np.nonzero(meter_schema.sum_mask)[0])
+    max_cols = tuple(int(i) for i in np.nonzero(meter_schema.max_mask)[0])
     key_cols = jnp.asarray(_KEY_COLS)
 
     def step(state, tags, meters, valid):
-        doc_tags, doc_meters, ts, doc_valid = fanout_l4(tags, meters, valid, fanout_config)
+        doc_tags, doc_meters, ts, doc_valid = fanout_fn(tags, meters, valid, fanout_config)
         key_mat = jnp.take(doc_tags, key_cols, axis=1)
         hi, lo = fingerprint64(key_mat)
         window = (ts // jnp.uint32(interval)).astype(jnp.uint32)
@@ -48,18 +55,26 @@ def make_ingest_step(fanout_config: FanoutConfig, interval: int = 1):
 
 
 @dataclasses.dataclass(frozen=True)
-class L4PipelineConfig:
+class PipelineConfig:
     fanout: FanoutConfig = FanoutConfig()
     window: WindowConfig = WindowConfig()
     batch_size: int = 4096  # static pad size for flow batches
 
 
-class L4Pipeline:
-    """Single-granularity (e.g. 1s) L4 rollup pipeline."""
+# Back-compat alias (bench/entry scripts predate the L7 pipeline).
+L4PipelineConfig = PipelineConfig
 
-    def __init__(self, config: L4PipelineConfig = L4PipelineConfig()):
+
+class RollupPipeline:
+    """Single-granularity (e.g. 1s) rollup pipeline: fanout → fingerprint
+    → windowed stash merge, with host-driven window flushes."""
+
+    fanout_fn = staticmethod(fanout_l4)
+    meter_schema: MeterSchema = FLOW_METER
+
+    def __init__(self, config: PipelineConfig = PipelineConfig()):
         self.config = config
-        self.wm = WindowManager(config.window, TAG_SCHEMA, FLOW_METER)
+        self.wm = WindowManager(config.window, TAG_SCHEMA, self.meter_schema)
 
     def ingest(self, batch: FlowBatch) -> list[DocBatch]:
         """Feed one decoded flow batch; returns any closed windows."""
@@ -68,7 +83,9 @@ class L4Pipeline:
         meters = jnp.asarray(batch.meters)
         valid = jnp.asarray(batch.valid)
 
-        doc_tags, doc_meters, ts, doc_valid = fanout_l4(tags, meters, valid, self.config.fanout)
+        doc_tags, doc_meters, ts, doc_valid = self.fanout_fn(
+            tags, meters, valid, self.config.fanout
+        )
         key_mat = jnp.take(doc_tags, jnp.asarray(_KEY_COLS), axis=1)
         hi, lo = fingerprint64(key_mat)
 
@@ -90,7 +107,7 @@ class L4Pipeline:
             timestamp=ts,
             valid=np.ones((n,), dtype=bool),
             tag_schema=TAG_SCHEMA,
-            meter_schema=FLOW_METER,
+            meter_schema=self.meter_schema,
         )
 
     @property
@@ -102,3 +119,19 @@ class L4Pipeline:
         if self.config.window.interval == 1:
             return DocumentFlag.PER_SECOND_METRICS
         return DocumentFlag.NONE
+
+
+class L4Pipeline(RollupPipeline):
+    """network / network_map rollup (FlowMeter docs)."""
+
+    fanout_fn = staticmethod(fanout_l4)
+    meter_schema = FLOW_METER
+
+
+class L7Pipeline(RollupPipeline):
+    """application / application_map rollup (AppMeter docs) — the TPU
+    replacement for L7QuadrupleGenerator → L7Collector
+    (l7_quadruple_generator.rs:93-253, collector.rs:694-821)."""
+
+    fanout_fn = staticmethod(fanout_l7)
+    meter_schema = APP_METER

@@ -54,6 +54,11 @@ FLOW_RECORD_TAG_FIELDS: tuple[str, ...] = (
     "is_vip0",
     "is_vip1",
     "is_active_service",
+    # L7-only fields (AppMeterWithFlow, collector.rs:101-112); zero for L4
+    # records.
+    "endpoint_hash",
+    "biz_type",
+    "time_span",
 )
 
 

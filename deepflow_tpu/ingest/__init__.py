@@ -1,3 +1,3 @@
-from .replay import SyntheticFlowGen
+from .replay import SyntheticAppGen, SyntheticFlowGen
 
-__all__ = ["SyntheticFlowGen"]
+__all__ = ["SyntheticAppGen", "SyntheticFlowGen"]

@@ -13,8 +13,8 @@ import dataclasses
 import numpy as np
 
 from ..datamodel.batch import FlowBatch
-from ..datamodel.code import Direction, SignalSource
-from ..datamodel.schema import FLOW_METER
+from ..datamodel.code import Direction, L7Protocol, SignalSource
+from ..datamodel.schema import APP_METER, FLOW_METER
 
 
 @dataclasses.dataclass
@@ -146,4 +146,130 @@ class SyntheticFlowGen:
         meters[:, col("rtt_count")] = 1
         meters[:, col("syn")] = 1
         meters[:, col("synack")] = 1
+        return FlowBatch(tags=tags, meters=meters, valid=np.ones(batch, dtype=bool))
+
+
+@dataclasses.dataclass
+class SyntheticAppGen:
+    """L7 request-log firehose (BASELINE config 2): a service population
+    with per-service endpoint sets, RED meters and log-normal-ish request
+    latencies. Emits AppMeterWithFlow-shaped records/batches."""
+
+    num_services: int = 64
+    endpoints_per_service: int = 16
+    seed: int = 0
+    agent_id: int = 1
+    p_error: float = 0.02
+
+    def __post_init__(self):
+        rng = np.random.default_rng(self.seed)
+        n = self.num_services
+        self.svc = {
+            "ip1": rng.integers(0x0A000000, 0x0AFFFFFF, n, dtype=np.uint32),
+            "port": rng.choice(np.array([80, 443, 8080, 9000], dtype=np.uint32), n),
+            "epc1": rng.integers(1, 50, n, dtype=np.uint32),
+            "l7": rng.choice(
+                np.array(
+                    [L7Protocol.HTTP1, L7Protocol.GRPC, L7Protocol.MYSQL, L7Protocol.REDIS],
+                    dtype=np.uint32,
+                ),
+                n,
+            ),
+            # median latency per service, µs
+            "lat_med": rng.integers(500, 20_000, n).astype(np.float64),
+        }
+        self._rng = rng
+
+    def _draw(self, batch: int):
+        rng = self._rng
+        svc = rng.integers(0, self.num_services, batch)
+        ep = rng.integers(0, self.endpoints_per_service, batch)
+        # endpoint_hash as the reference computes it agent-side (a hash of
+        # the endpoint string); here a mixed function of (svc, ep).
+        ep_hash = (
+            (svc.astype(np.uint64) * np.uint64(2654435761) + ep.astype(np.uint64))
+            & np.uint64(0xFFFFFFFF)
+        ).astype(np.uint32)
+        client_ip = rng.integers(0x0A000000, 0x0AFFFFFF, batch, dtype=np.uint32)
+        lat = (self.svc["lat_med"][svc] * rng.lognormal(0.0, 0.6, batch)).astype(np.uint32)
+        err = rng.random(batch) < self.p_error
+        return svc, ep_hash, client_ip, lat, err
+
+    def records(self, batch: int, t: int, draw=None) -> list[dict]:
+        svc, ep_hash, client_ip, lat, err = draw if draw is not None else self._draw(batch)
+        s = self.svc
+        out = []
+        for i in range(batch):
+            j = int(svc[i])
+            out.append(
+                {
+                    "timestamp": t,
+                    "global_thread_id": 1,
+                    "agent_id": self.agent_id,
+                    "signal_source": int(SignalSource.PACKET),
+                    "ip0_w3": int(client_ip[i]),
+                    "ip1_w3": int(s["ip1"][j]),
+                    "l3_epc_id": 10,
+                    "l3_epc_id1": int(s["epc1"][j]),
+                    "protocol": 6,
+                    "server_port": int(s["port"][j]),
+                    "tap_type": 3,
+                    "tap_port": 1,
+                    "l7_protocol": int(s["l7"][j]),
+                    "endpoint_hash": int(ep_hash[i]),
+                    "direction0": int(Direction.CLIENT_TO_SERVER),
+                    "direction1": int(Direction.SERVER_TO_CLIENT),
+                    "is_active_host0": 1,
+                    "is_active_host1": 1,
+                    "is_active_service": 1,
+                    "meter": {
+                        "request": 1,
+                        "response": 1,
+                        "rrt_max": int(lat[i]),
+                        "rrt_sum": int(lat[i]),
+                        "rrt_count": 1,
+                        "server_error": int(err[i]),
+                    },
+                }
+            )
+        return out
+
+    def app_batch(self, batch: int, t: int, draw=None) -> FlowBatch:
+        """Columnar batch (meters follow APP_METER). Pass the same `draw`
+        (from `_draw`) to records() and app_batch to get both views of one
+        workload — the conformance test uses that to pin their equivalence.
+        """
+        svc, ep_hash, client_ip, lat, err = draw if draw is not None else self._draw(batch)
+        s = self.svc
+        from ..datamodel.batch import FLOW_RECORD_TAG_FIELDS
+
+        tags = {f: np.zeros(batch, dtype=np.uint32) for f in FLOW_RECORD_TAG_FIELDS}
+        tags["timestamp"][:] = t
+        tags["global_thread_id"][:] = 1
+        tags["agent_id"][:] = self.agent_id
+        tags["signal_source"][:] = int(SignalSource.PACKET)
+        tags["ip0_w3"] = client_ip
+        tags["ip1_w3"] = s["ip1"][svc]
+        tags["l3_epc_id"][:] = 10
+        tags["l3_epc_id1"] = s["epc1"][svc]
+        tags["protocol"][:] = 6
+        tags["server_port"] = s["port"][svc]
+        tags["tap_type"][:] = 3
+        tags["tap_port"][:] = 1
+        tags["l7_protocol"] = s["l7"][svc]
+        tags["endpoint_hash"] = ep_hash
+        tags["direction0"][:] = int(Direction.CLIENT_TO_SERVER)
+        tags["direction1"][:] = int(Direction.SERVER_TO_CLIENT)
+        tags["is_active_host0"][:] = 1
+        tags["is_active_host1"][:] = 1
+        tags["is_active_service"][:] = 1
+
+        meters = np.zeros((batch, APP_METER.num_fields), dtype=np.float32)
+        col = APP_METER.index
+        meters[:, col("request")] = 1
+        meters[:, col("response")] = 1
+        meters[:, col("rrt_max")] = lat
+        meters[:, col("rrt_sum")] = lat
+        meters[:, col("rrt_count")] = 1
+        meters[:, col("server_error")] = err
         return FlowBatch(tags=tags, meters=meters, valid=np.ones(batch, dtype=bool))

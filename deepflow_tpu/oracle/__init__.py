@@ -1,3 +1,3 @@
-from .numpy_oracle import OracleDoc, oracle_l4_rollup
+from .numpy_oracle import OracleDoc, oracle_l4_rollup, oracle_l7_rollup
 
-__all__ = ["OracleDoc", "oracle_l4_rollup"]
+__all__ = ["OracleDoc", "oracle_l4_rollup", "oracle_l7_rollup"]

@@ -1,24 +1,25 @@
-"""Straight-line NumPy/dict oracle for the L4 rollup.
+"""Straight-line NumPy/dict oracle for the L4/L7 rollups.
 
 Independent re-implementation of the reference semantics (fanout rules of
-collector.rs:500-607/882-1095, merge rules of meter.rs:97-276) with
-Python dicts and exact int64 accumulators. The jit pipeline must agree
-with this scorer exactly on meters (within f32 representability) and on
-the emitted key set — this is the conformance harness the reference repo
-lacks (SURVEY §4).
+collector.rs:500-607/694-821/882-1095, merge rules of meter.rs:97-276)
+with Python dicts and exact int64 accumulators. The jit pipeline must
+agree with this scorer exactly on meters (within f32 representability)
+and on the emitted key set — this is the conformance harness the
+reference repo lacks (SURVEY §4).
 
 Kept deliberately scalar/dict-shaped: no jnp, no sorting tricks — so a
-bug in the device path can't be mirrored here by construction.
+bug in the device path can't be mirrored here by construction. (The L4
+and L7 paths do share one record walker, parameterized the same way the
+reference parameterizes its tagger builders — the shared logic *is* the
+shared reference semantics, collector.rs:882/984 get_*_tagger.)
 """
 
 from __future__ import annotations
 
 import dataclasses
 
-import numpy as np
-
 from ..datamodel.code import CodeId, Direction, MeterId, SignalSource
-from ..datamodel.schema import FLOW_METER, MergeOp, TAG_SCHEMA
+from ..datamodel.schema import APP_METER, FLOW_METER, MergeOp, TAG_SCHEMA, MeterSchema
 from ..aggregator.fanout import EPC_INTERNET_U16, FanoutConfig, TCP, UDP
 
 _SIDE_MASK = 0xF8
@@ -31,21 +32,17 @@ class OracleDoc:
     meter: dict  # int64 values
 
 
-def _meter_dict(meters_row) -> dict:
-    return {f.name: int(meters_row[i]) for i, f in enumerate(FLOW_METER.fields)}
-
-
-def _merge_meter(into: dict, add: dict) -> None:
-    for f in FLOW_METER.fields:
+def _merge_meter(into: dict, add: dict, schema: MeterSchema) -> None:
+    for f in schema.fields:
         if f.op is MergeOp.SUM:
             into[f.name] += add[f.name]
         else:
             into[f.name] = max(into[f.name], add[f.name])
 
 
-def _reversed_meter(m: dict) -> dict:
+def _reversed_meter(m: dict, schema: MeterSchema) -> dict:
     out = dict(m)
-    for i, f in enumerate(FLOW_METER.fields):
+    for f in schema.fields:
         if f.reverse_with:
             out[f.name] = m[f.reverse_with]
         if f.zero_on_reverse:
@@ -61,30 +58,36 @@ def _tap_side(direction: int) -> int:
     return direction
 
 
-def oracle_l4_rollup(
+def _rollup(
     records: list[dict],
     config: FanoutConfig,
-    interval: int = 1,
+    interval: int,
+    app: bool,
 ) -> dict[tuple, OracleDoc]:
-    """records: list of flow dicts (FlowBatch.from_records schema, int
-    values + 'meter' sub-dict). Returns {(window, key_tuple): OracleDoc}.
-    Key tuple = values of TAG_SCHEMA key columns, matching the device
-    fingerprint's equality.
-    """
+    schema = APP_METER if app else FLOW_METER
+    meter_id = int(MeterId.APP if app else MeterId.FLOW)
     out: dict[tuple, OracleDoc] = {}
     key_fields = [f.name for f in TAG_SCHEMA.fields if f.key]
 
     for r in records:
         ts = int(r["timestamp"])
         window = ts // interval
-        meter = {f.name: int(r.get("meter", {}).get(f.name, 0)) for f in FLOW_METER.fields}
+        meter = {f.name: int(r.get("meter", {}).get(f.name, 0)) for f in schema.fields}
 
         sig = int(r.get("signal_source", 0))
         is_otel = sig == SignalSource.OTEL
+        is_packet = sig == SignalSource.PACKET
         proto = int(r.get("protocol", 0))
         dirs = [int(r.get("direction0", 0)), int(r.get("direction1", 0))]
         active = [int(r.get("is_active_host0", 0)), int(r.get("is_active_host1", 0))]
         vip = [int(r.get("is_vip0", 0)), int(r.get("is_vip1", 0))]
+
+        # whole-record drops (collector.rs:489-493, :684-687, :794)
+        if config.inactive_ip_aggregation and not active[0] and not active[1]:
+            continue
+        l7p = int(r.get("l7_protocol", 0))
+        if app and l7p == 0 and not is_otel:
+            continue
 
         def epc_fix(v):
             v = int(v) & 0xFFFF
@@ -105,12 +108,34 @@ def oracle_l4_rollup(
         )
         dst_port = 0 if ignore_port else int(r.get("server_port", 0))
 
+        shared_tag = dict(
+            meter_id=meter_id,
+            global_thread_id=config.global_thread_id,
+            agent_id=config.agent_id,
+            is_ipv6=int(r.get("is_ipv6", 0)),
+            protocol=proto,
+            tap_type=int(r.get("tap_type", 0)),
+            signal_source=sig,
+            pod_id=int(r.get("pod_id", 0)),
+        )
+        if app:
+            shared_tag.update(
+                l7_protocol=l7p,
+                endpoint_hash=int(r.get("endpoint_hash", 0)),
+                biz_type=int(r.get("biz_type", 0)),
+                time_span=int(r.get("time_span", 0)),
+            )
+
         docs: list[tuple[dict, dict]] = []
 
         # --- single docs ---
         for ep in (0, 1):
             d = dirs[ep]
-            if d == 0 or (d & _SIDE_MASK) != 0:
+            if d == 0:
+                continue
+            pure = (d & _SIDE_MASK) == 0
+            dir_ok = (pure or not is_packet) if app else pure
+            if not dir_ok:
                 continue
             if config.inactive_ip_aggregation and not active[ep]:
                 continue
@@ -123,12 +148,12 @@ def oracle_l4_rollup(
                 keep_ip = True
             ip = ips[ep] if keep_ip else [0, 0, 0, 0]
             has_mac = bool(vip[ep]) or d == Direction.LOCAL_TO_LOCAL
+            if app:
+                code = CodeId.SINGLE_MAC_IP_PORT_APP if has_mac else CodeId.SINGLE_IP_PORT_APP
+            else:
+                code = CodeId.SINGLE_MAC_IP_PORT if has_mac else CodeId.SINGLE_IP_PORT
             tag.update(
-                code_id=int(CodeId.SINGLE_MAC_IP_PORT if has_mac else CodeId.SINGLE_IP_PORT),
-                meter_id=int(MeterId.FLOW),
-                global_thread_id=config.global_thread_id,
-                agent_id=config.agent_id,
-                is_ipv6=int(r.get("is_ipv6", 0)),
+                code_id=int(code),
                 ip0_w0=ip[0],
                 ip0_w1=ip[1],
                 ip0_w2=ip[2],
@@ -138,18 +163,17 @@ def oracle_l4_rollup(
                 mac0_lo=macs[ep][1] if has_mac else 0,
                 direction=d,
                 tap_side=_tap_side(d),
-                protocol=proto,
                 server_port=0 if ep == 0 else dst_port,
-                tap_type=int(r.get("tap_type", 0)),
                 gpid0=int(r.get("gpid0" if ep == 0 else "gpid1", 0)),
-                signal_source=sig,
-                pod_id=int(r.get("pod_id", 0)),
+                **shared_tag,
             )
-            docs.append((tag, meter if ep == 0 else _reversed_meter(meter)))
+            m = meter if (ep == 0 or app) else _reversed_meter(meter, schema)
+            docs.append((tag, m))
 
         # --- edge docs ---
         both_none = dirs[0] == 0 and dirs[1] == 0
-        if sig in (SignalSource.PACKET, SignalSource.XFLOW):
+        edge_ok = True if app else sig in (SignalSource.PACKET, SignalSource.XFLOW)
+        if edge_ok:
             edge_dirs = []
             for ep in (0, 1):
                 if dirs[ep] != 0:
@@ -169,12 +193,12 @@ def oracle_l4_rollup(
                 m0 = macs[0] if (vip[0] or is_ll) else (0, 0)
                 m1 = macs[1] if (vip[1] or is_ll) else (0, 0)
                 any_mac = any(m0) or any(m1)
+                if app:
+                    code = CodeId.EDGE_MAC_IP_PORT_APP if any_mac else CodeId.EDGE_IP_PORT_APP
+                else:
+                    code = CodeId.EDGE_MAC_IP_PORT if any_mac else CodeId.EDGE_IP_PORT
                 tag.update(
-                    code_id=int(CodeId.EDGE_MAC_IP_PORT if any_mac else CodeId.EDGE_IP_PORT),
-                    meter_id=int(MeterId.FLOW),
-                    global_thread_id=config.global_thread_id,
-                    agent_id=config.agent_id,
-                    is_ipv6=int(r.get("is_ipv6", 0)),
+                    code_id=int(code),
                     ip0_w0=src_ip[0],
                     ip0_w1=src_ip[1],
                     ip0_w2=src_ip[2],
@@ -191,21 +215,40 @@ def oracle_l4_rollup(
                     mac1_lo=m1[1],
                     direction=int(d),
                     tap_side=_tap_side(int(d)),
-                    protocol=proto,
                     server_port=dst_port,
                     tap_port=int(r.get("tap_port", 0)),
-                    tap_type=int(r.get("tap_type", 0)),
                     gpid0=int(r.get("gpid0", 0)),
                     gpid1=int(r.get("gpid1", 0)),
-                    signal_source=sig,
-                    pod_id=int(r.get("pod_id", 0)),
+                    **shared_tag,
                 )
                 docs.append((tag, meter))
 
         for tag, m in docs:
             key = (window,) + tuple(tag[k] for k in key_fields)
             if key in out:
-                _merge_meter(out[key].meter, m)
+                _merge_meter(out[key].meter, m, schema)
             else:
                 out[key] = OracleDoc(window=window, tag=dict(tag), meter=dict(m))
     return out
+
+
+def oracle_l4_rollup(
+    records: list[dict],
+    config: FanoutConfig,
+    interval: int = 1,
+) -> dict[tuple, OracleDoc]:
+    """records: list of flow dicts (FlowBatch.from_records schema, int
+    values + 'meter' sub-dict). Returns {(window, key_tuple): OracleDoc}.
+    Key tuple = values of TAG_SCHEMA key columns, matching the device
+    fingerprint's equality.
+    """
+    return _rollup(records, config, interval, app=False)
+
+
+def oracle_l7_rollup(
+    records: list[dict],
+    config: FanoutConfig,
+    interval: int = 1,
+) -> dict[tuple, OracleDoc]:
+    """L7 twin of oracle_l4_rollup (fill_l7_stats semantics)."""
+    return _rollup(records, config, interval, app=True)

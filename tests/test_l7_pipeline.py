@@ -1,0 +1,182 @@
+"""L7/App rollup conformance: jit pipeline vs numpy oracle.
+
+Mirrors tests/test_pipeline_conformance.py for the application metrics
+path (fill_l7_stats semantics, collector.rs:694-821).
+"""
+
+import numpy as np
+import pytest
+
+from deepflow_tpu.aggregator.fanout import FanoutConfig
+from deepflow_tpu.aggregator.pipeline import L7Pipeline, PipelineConfig
+from deepflow_tpu.aggregator.window import WindowConfig
+from deepflow_tpu.datamodel.batch import FlowBatch
+from deepflow_tpu.datamodel.code import CodeId, Direction, L7Protocol, MeterId, SignalSource
+from deepflow_tpu.datamodel.schema import APP_METER, TAG_SCHEMA
+from deepflow_tpu.ingest.replay import SyntheticAppGen
+from deepflow_tpu.oracle.numpy_oracle import oracle_l7_rollup
+
+KEY_FIELDS = [f.name for f in TAG_SCHEMA.fields if f.key]
+
+
+def run_pipeline(records_per_t, config=FanoutConfig(), interval=1, capacity=1 << 14):
+    pipe = L7Pipeline(
+        PipelineConfig(
+            fanout=config,
+            window=WindowConfig(interval=interval, delay=2, capacity=capacity),
+            batch_size=512,
+        )
+    )
+    out = []
+    for t, records in records_per_t:
+        out += pipe.ingest(FlowBatch.from_records(records, APP_METER))
+    out += pipe.drain()
+    return pipe, out
+
+
+def collect_docs(doc_batches, interval=1):
+    got = {}
+    for db in doc_batches:
+        for d in db.to_dicts():
+            key = (d["timestamp"] // interval,) + tuple(d["tag"][k] for k in KEY_FIELDS)
+            assert key not in got, f"duplicate key emitted: {key}"
+            got[key] = d
+    return got
+
+
+def assert_matches_oracle(doc_batches, oracle, interval=1):
+    got = collect_docs(doc_batches, interval)
+    assert set(got.keys()) == set(oracle.keys())
+    for key, doc in got.items():
+        want = oracle[key].meter
+        for name in APP_METER.field_names():
+            assert doc["meter"][name] == pytest.approx(want[name]), (
+                f"meter {name} mismatch at {key}: {doc['meter'][name]} != {want[name]}"
+            )
+
+
+def test_l7_synthetic_conformance():
+    gen = SyntheticAppGen(num_services=16, endpoints_per_service=4, seed=3)
+    t0 = 1_700_000_000
+    per_t = [(t, gen.records(200, t)) for t in range(t0, t0 + 5)]
+    _, out = run_pipeline(per_t)
+    oracle = oracle_l7_rollup([r for _, recs in per_t for r in recs], FanoutConfig())
+    assert_matches_oracle(out, oracle)
+
+
+def _base_record(t=1_700_000_000, **kw):
+    r = {
+        "timestamp": t,
+        "signal_source": int(SignalSource.PACKET),
+        "ip0_w3": 0x0A000001,
+        "ip1_w3": 0x0A000002,
+        "l3_epc_id": 3,
+        "l3_epc_id1": 4,
+        "protocol": 6,
+        "server_port": 443,
+        "tap_type": 3,
+        "l7_protocol": int(L7Protocol.HTTP1),
+        "endpoint_hash": 77,
+        "direction0": int(Direction.CLIENT_TO_SERVER),
+        "direction1": int(Direction.SERVER_TO_CLIENT),
+        "is_active_host0": 1,
+        "is_active_host1": 1,
+        "is_active_service": 1,
+        "meter": {"request": 1, "response": 1, "rrt_sum": 1000, "rrt_count": 1, "rrt_max": 1000},
+    }
+    r.update(kw)
+    return r
+
+
+def _docs_of(records, config=FanoutConfig()):
+    _, out = run_pipeline([(records[0]["timestamp"], records)], config)
+    return list(collect_docs(out).values())
+
+
+def test_unknown_l7_protocol_dropped():
+    docs = _docs_of([_base_record(l7_protocol=0)])
+    assert docs == []
+
+
+def test_otel_unknown_l7_kept():
+    docs = _docs_of(
+        [_base_record(l7_protocol=0, signal_source=int(SignalSource.OTEL), direction0=0, direction1=0)]
+    )
+    # both directions None → one rest edge doc with direction=App
+    assert len(docs) == 1
+    assert docs[0]["tag"]["direction"] == int(Direction.APP)
+    assert docs[0]["tag"]["code_id"] == int(CodeId.EDGE_IP_PORT_APP)
+
+
+def test_packet_sided_direction_no_single_doc():
+    # c-p (process-sided) direction on Packet data: edge doc only
+    d = int(Direction.CLIENT_PROCESS_TO_SERVER)
+    docs = _docs_of([_base_record(direction0=d, direction1=0)])
+    assert len(docs) == 1
+    assert docs[0]["tag"]["code_id"] == int(CodeId.EDGE_IP_PORT_APP)
+
+
+def test_ebpf_sided_direction_emits_single_doc():
+    d = int(Direction.CLIENT_PROCESS_TO_SERVER)
+    docs = _docs_of(
+        [_base_record(direction0=d, direction1=0, signal_source=int(SignalSource.EBPF))]
+    )
+    codes = sorted(doc["tag"]["code_id"] for doc in docs)
+    assert codes == [int(CodeId.SINGLE_IP_PORT_APP), int(CodeId.EDGE_IP_PORT_APP)]
+
+
+def test_app_meter_not_reversed():
+    # the server-endpoint single doc carries the same request/response
+    # counts as the client doc (no tx/rx swap for app meters)
+    docs = _docs_of([_base_record(meter={"request": 5, "response": 3})])
+    singles = [
+        d
+        for d in docs
+        if d["tag"]["code_id"] in (int(CodeId.SINGLE_IP_PORT_APP), int(CodeId.SINGLE_MAC_IP_PORT_APP))
+    ]
+    assert len(singles) == 2
+    for d in singles:
+        assert d["meter"]["request"] == 5
+        assert d["meter"]["response"] == 3
+
+
+def test_l7_keys_include_endpoint_hash():
+    r1 = _base_record(endpoint_hash=1)
+    r2 = _base_record(endpoint_hash=2)
+    docs = _docs_of([r1, r2])
+    # each endpoint keeps its own documents: 4 docs per record
+    assert len(docs) == 8
+    eps = {d["tag"]["endpoint_hash"] for d in docs}
+    assert eps == {1, 2}
+
+
+def test_l7_meter_ids_app():
+    for d in _docs_of([_base_record()]):
+        assert d["tag"]["meter_id"] == int(MeterId.APP)
+
+
+def test_both_inactive_record_dropped():
+    # collector.rs:684-687: both hosts inactive + inactive_ip_aggregation
+    # → whole record dropped (no edge/rest docs either)
+    cfg = FanoutConfig(inactive_ip_aggregation=True)
+    rec = _base_record(is_active_host0=0, is_active_host1=0)
+    assert _docs_of([rec], cfg) == []
+    from deepflow_tpu.oracle.numpy_oracle import oracle_l7_rollup as o7
+
+    assert o7([rec], cfg) == {}
+    # one active host: record survives (edge docs at least)
+    rec2 = _base_record(is_active_host0=0, is_active_host1=1)
+    assert len(_docs_of([rec2], cfg)) > 0
+
+
+def test_app_batch_matches_records():
+    # app_batch (columnar fast path) and records (oracle path) must be two
+    # views of the same workload
+    gen = SyntheticAppGen(num_services=8, seed=5)
+    draw = gen._draw(64)
+    t = 1_700_000_000
+    fb_cols = gen.app_batch(64, t, draw=draw)
+    fb_recs = FlowBatch.from_records(gen.records(64, t, draw=draw), APP_METER)
+    for name, col in fb_cols.tags.items():
+        np.testing.assert_array_equal(col, fb_recs.tags[name], err_msg=name)
+    np.testing.assert_array_equal(fb_cols.meters, fb_recs.meters)

@@ -108,3 +108,34 @@ def test_minute_granularity():
     batches = [(t, 100) for t in (5000, 5030, 5059, 5061, 5125)]
     emitted, oracle = _run_both({"num_tuples": 40, "seed": 5}, batches, interval=60)
     _compare(emitted, oracle)
+
+
+def test_l4_both_inactive_record_dropped():
+    # collector.rs:489-493: both hosts inactive + inactive_ip_aggregation
+    # → whole record dropped, including edge docs
+    from deepflow_tpu.datamodel.batch import FlowBatch
+    from deepflow_tpu.datamodel.code import Direction, SignalSource
+
+    cfg = FanoutConfig(inactive_ip_aggregation=True)
+    rec = {
+        "timestamp": 1_700_000_000,
+        "signal_source": int(SignalSource.PACKET),
+        "ip0_w3": 1,
+        "ip1_w3": 2,
+        "protocol": 6,
+        "server_port": 80,
+        "direction0": int(Direction.CLIENT_TO_SERVER),
+        "direction1": int(Direction.SERVER_TO_CLIENT),
+        "is_active_host0": 0,
+        "is_active_host1": 0,
+        "is_active_service": 1,
+        "meter": {"packet_tx": 7},
+    }
+    pipe = L4Pipeline(
+        L4PipelineConfig(
+            fanout=cfg, window=WindowConfig(interval=1, delay=2, capacity=256), batch_size=64
+        )
+    )
+    out = pipe.ingest(FlowBatch.from_records([rec])) + pipe.drain()
+    assert all(db.size == 0 for db in out)
+    assert oracle_l4_rollup([rec], cfg) == {}
