@@ -26,18 +26,17 @@ from .window import FlushedWindow, WindowConfig, WindowManager
 _KEY_COLS = np.nonzero(TAG_SCHEMA.key_mask)[0].astype(np.int32)
 
 
-def make_ingest_step(
-    fanout_config: FanoutConfig,
-    interval: int = 1,
-    meter_schema: MeterSchema = FLOW_METER,
-    fanout_fn=fanout_l4,
-):
+def make_ingest_step(fanout_config: FanoutConfig, interval: int = 1, app: bool = False):
     """Build the pure device step: FlowBatch columns → merged stash.
 
     state' = step(state, tags, meters, valid). This is the function the
     benchmark times and the graft entry exposes; RollupPipeline uses the
     same building blocks but drives window flushes from the host.
+    `app` selects the L7 path (fanout_l7 + APP_METER) — fanout and meter
+    schema are coupled by construction so they cannot drift apart.
     """
+    fanout_fn = fanout_l7 if app else fanout_l4
+    meter_schema = APP_METER if app else FLOW_METER
     sum_cols = tuple(int(i) for i in np.nonzero(meter_schema.sum_mask)[0])
     max_cols = tuple(int(i) for i in np.nonzero(meter_schema.max_mask)[0])
     key_cols = jnp.asarray(_KEY_COLS)
@@ -122,10 +121,8 @@ class RollupPipeline:
 
 
 class L4Pipeline(RollupPipeline):
-    """network / network_map rollup (FlowMeter docs)."""
-
-    fanout_fn = staticmethod(fanout_l4)
-    meter_schema = FLOW_METER
+    """network / network_map rollup (FlowMeter docs) — the RollupPipeline
+    defaults, named for symmetry with L7Pipeline."""
 
 
 class L7Pipeline(RollupPipeline):

@@ -1,0 +1,149 @@
+"""Telemetry wire framing — the agent↔server transport ABI.
+
+Byte-compatible with the reference's framed TCP/UDP protocol:
+
+  * 19-byte flow header (uniform_sender.rs:110-147; layout comment at
+    :109-118): frame_size u32 BE, msg_type u8, version u16 LE (0x8000),
+    encoder u8, team_id u32 LE, organization_id u16 LE, reserved_1 u16,
+    agent_id u16 LE, reserved_2 u8. frame_size counts the whole frame
+    including the header.
+  * message-type registry (droplet-message.go:31-88).
+  * METRICS frame body: back-to-back [pb_len u32 LE][protobuf Document]
+    records (uniform_sender.rs:186-196 cache_to_sender).
+
+The server side parses the header to route by msg_type and extract
+org/team/agent identity (receiver.go:631-700 semantics).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import struct
+
+
+class MessageType(enum.IntEnum):
+    """droplet-message.go:36-60."""
+
+    COMPRESS = 0
+    SYSLOG = 1
+    SERVER_DFSTATS = 2
+    METRICS = 3
+    TAGGEDFLOW = 4
+    PROTOCOLLOG = 5
+    OPENTELEMETRY = 6
+    PROMETHEUS = 7
+    TELEGRAF = 8
+    PACKETSEQUENCE = 9
+    DFSTATS = 10
+    OPENTELEMETRY_COMPRESSED = 11
+    RAW_PCAP = 12
+    PROFILE = 13
+    PROC_EVENT = 14
+    ALERT_EVENT = 15
+    K8S_EVENT = 16
+    APPLICATION_LOG = 17
+    AGENT_LOG = 18
+    SKYWALKING = 19
+    DATADOG = 20
+
+
+HEADER_VERSION = 0x8000
+HEADER_LEN = 19
+
+# frame_size is BE; everything after msg_type is LE (uniform_sender.rs
+# Header::encode mixes endianness exactly like this).
+_HDR_TAIL = struct.Struct("<HBIHHHB")  # version, encoder, team, org, rsvd1, agent, rsvd2
+
+
+@dataclasses.dataclass
+class FlowHeader:
+    msg_type: int
+    frame_size: int = 0  # filled by encode_frame
+    version: int = HEADER_VERSION
+    encoder: int = 0  # 0 = raw; compression codecs are negotiated ids
+    team_id: int = 0
+    organization_id: int = 0
+    agent_id: int = 0
+
+    def encode(self) -> bytes:
+        return (
+            struct.pack(">I", self.frame_size)
+            + struct.pack("B", self.msg_type)
+            + _HDR_TAIL.pack(
+                self.version, self.encoder, self.team_id, self.organization_id, 0, self.agent_id, 0
+            )
+        )
+
+    @classmethod
+    def parse(cls, buf: bytes) -> "FlowHeader":
+        if len(buf) < HEADER_LEN:
+            raise ValueError(f"short header: {len(buf)} < {HEADER_LEN}")
+        (frame_size,) = struct.unpack_from(">I", buf, 0)
+        msg_type = buf[4]
+        version, encoder, team, org, _r1, agent, _r2 = _HDR_TAIL.unpack_from(buf, 5)
+        return cls(
+            msg_type=msg_type,
+            frame_size=frame_size,
+            version=version,
+            encoder=encoder,
+            team_id=team,
+            organization_id=org,
+            agent_id=agent,
+        )
+
+
+def encode_frame(header: FlowHeader, messages: list[bytes]) -> bytes:
+    """One wire frame: header + [len u32 LE][pb] per message."""
+    body = b"".join(struct.pack("<I", len(m)) + m for m in messages)
+    header.frame_size = HEADER_LEN + len(body)
+    return header.encode() + body
+
+
+def split_messages(payload: bytes) -> list[bytes]:
+    """Frame body → pb message list (inverse of encode_frame's body)."""
+    out = []
+    off = 0
+    n = len(payload)
+    while off + 4 <= n:
+        (size,) = struct.unpack_from("<I", payload, off)
+        off += 4
+        if off + size > n:
+            raise ValueError(f"truncated message at {off}: need {size}, have {n - off}")
+        out.append(payload[off : off + size])
+        off += size
+    if off != n:
+        raise ValueError(f"trailing garbage: {n - off} bytes")
+    return out
+
+
+class FrameReassembler:
+    """Incremental TCP stream → frames (the receiver's flow-header scan,
+    receiver.go:515-585). Feed arbitrary chunks; yields (header, body)."""
+
+    def __init__(self, max_frame: int = 1 << 24):
+        self._buf = bytearray()
+        self.max_frame = max_frame
+        self.bad_frames = 0
+
+    def feed(self, chunk: bytes) -> list[tuple[FlowHeader, bytes]]:
+        self._buf += chunk
+        frames = []
+        while True:
+            if len(self._buf) < HEADER_LEN:
+                return frames
+            header = FlowHeader.parse(bytes(self._buf[:HEADER_LEN]))
+            if (
+                header.frame_size < HEADER_LEN
+                or header.frame_size >= self.max_frame
+                or header.version != HEADER_VERSION
+            ):
+                # resync: drop one byte (malformed stream)
+                self.bad_frames += 1
+                del self._buf[0]
+                continue
+            if len(self._buf) < header.frame_size:
+                return frames
+            body = bytes(self._buf[HEADER_LEN : header.frame_size])
+            del self._buf[: header.frame_size]
+            frames.append((header, body))

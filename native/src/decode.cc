@@ -1,0 +1,389 @@
+// Document protobuf → struct-of-arrays decoder — the ingester's DecodePB
+// hot loop (/root/reference/server/libs/app/codec.go:28,
+// flow_metrics/unmarshaller/unmarshaller.go:220) as native code.
+//
+// Wire format: metric.proto Document{timestamp=1, tag=2, meter=3, flags=4}
+// (see deepflow_tpu/ingest/codec.py, the Python reference implementation
+// this must match byte-for-byte; conformance is pinned by
+// tests/test_native.py).
+//
+// Schema-agnostic by construction: the caller passes
+//   * tag_col[slot]   — semantic slot → output tag column (-1 = absent)
+//   * meter maps      — (submsg<<5 | field) → meter column, per meter id
+//   * a code→code_id table
+// so the C++ never hardcodes the Python TAG_SCHEMA layout.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+// Semantic tag slots — ABI shared with deepflow_tpu/native/__init__.py
+// (order must match _SLOT_NAMES there).
+enum Slot {
+  S_CODE_ID = 0,
+  S_METER_ID,
+  S_GLOBAL_THREAD_ID,
+  S_AGENT_ID,
+  S_IS_IPV6,
+  S_IP0_W0,
+  S_IP0_W1,
+  S_IP0_W2,
+  S_IP0_W3,
+  S_IP1_W0,
+  S_IP1_W1,
+  S_IP1_W2,
+  S_IP1_W3,
+  S_L3_EPC_ID,
+  S_L3_EPC_ID1,
+  S_MAC0_HI,
+  S_MAC0_LO,
+  S_MAC1_HI,
+  S_MAC1_LO,
+  S_DIRECTION,
+  S_TAP_SIDE,
+  S_PROTOCOL,
+  S_ACL_GID,
+  S_SERVER_PORT,
+  S_TAP_PORT,
+  S_TAP_TYPE,
+  S_L7_PROTOCOL,
+  S_GPID0,
+  S_GPID1,
+  S_ENDPOINT_HASH,
+  S_BIZ_TYPE,
+  S_SIGNAL_SOURCE,
+  S_POD_ID,
+  NUM_SLOTS,
+};
+
+struct Cursor {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool fail = false;
+
+  uint64_t varint() {
+    uint64_t out = 0;
+    int shift = 0;
+    while (p < end) {
+      uint8_t b = *p++;
+      out |= static_cast<uint64_t>(b & 0x7F) << shift;
+      if (!(b & 0x80)) return out;
+      shift += 7;
+      if (shift >= 70) break;
+    }
+    fail = true;
+    return 0;
+  }
+
+  // Returns field id; wire type in *wire; for LEN fields sets *sub.
+  // Returns 0 at end.
+  uint32_t next(uint32_t* wire, Cursor* sub, uint64_t* value) {
+    if (p >= end || fail) return 0;
+    uint64_t key = varint();
+    if (fail) return 0;
+    uint32_t field = static_cast<uint32_t>(key >> 3);
+    *wire = static_cast<uint32_t>(key & 7);
+    switch (*wire) {
+      case 0:
+        *value = varint();
+        break;
+      case 2: {
+        uint64_t len = varint();
+        if (fail || p + len > end) {
+          fail = true;
+          return 0;
+        }
+        sub->p = p;
+        sub->end = p + len;
+        sub->fail = false;
+        p += len;
+        break;
+      }
+      case 5:
+        if (p + 4 > end) { fail = true; return 0; }
+        *value = 0;
+        memcpy(value, p, 4);
+        p += 4;
+        break;
+      case 1:
+        if (p + 8 > end) { fail = true; return 0; }
+        memcpy(value, p, 8);
+        p += 8;
+        break;
+      default:
+        fail = true;
+        return 0;
+    }
+    return field;
+  }
+};
+
+inline uint32_t rotl32(uint32_t x, int r) { return (x << r) | (x >> (32 - r)); }
+
+inline uint32_t fmix32(uint32_t h) {
+  h ^= h >> 16;
+  h *= 0x85EBCA6B;
+  h ^= h >> 13;
+  h *= 0xC2B2AE35;
+  h ^= h >> 16;
+  return h;
+}
+
+// Identical to deepflow_tpu/ops/hashing._fold(cols, SEED_HI) over the
+// little-endian u32 words of the zero-padded string.
+uint32_t hash_string(const uint8_t* s, uint32_t len) {
+  if (len == 0) return 0;
+  uint32_t n_words = (len + 3) / 4;
+  uint32_t h = 0x9747B28C;  // SEED_HI
+  for (uint32_t i = 0; i < n_words; i++) {
+    uint32_t w = 0;
+    uint32_t take = len - i * 4 < 4 ? len - i * 4 : 4;
+    memcpy(&w, s + i * 4, take);  // little-endian load, zero padded
+    uint32_t k = w * 0xCC9E2D51u;
+    k = rotl32(k, 15);
+    k = k * 0x1B873593u;
+    h ^= k;
+    h = rotl32(h, 13);
+    h = h * 5 + 0xE6546B64u;
+  }
+  h ^= n_words * 4;
+  return fmix32(h);
+}
+
+struct DecodeCtx {
+  const int32_t* tag_col;
+  uint32_t t_cols;
+  const int32_t* meter_maps[8];  // by meter_id; (sub<<5|fid) → col
+  int32_t meter_sub_field[8];    // Meter.{flow=2,usage=3,app=4}; -1 unknown
+  bool meter_flat[8];            // UsageMeter has flat fields
+  const uint64_t* codes;
+  const uint32_t* code_ids;
+  uint32_t n_codes;
+};
+
+inline void set_tag(uint32_t* row, const DecodeCtx& ctx, int slot, uint32_t v) {
+  int32_t col = ctx.tag_col[slot];
+  if (col >= 0) row[col] = v;
+}
+
+void decode_ip(uint32_t* row, const DecodeCtx& ctx, Cursor ip, int base_slot) {
+  size_t len = ip.end - ip.p;
+  if (len == 4) {
+    uint32_t v = (uint32_t(ip.p[0]) << 24) | (uint32_t(ip.p[1]) << 16) |
+                 (uint32_t(ip.p[2]) << 8) | uint32_t(ip.p[3]);
+    set_tag(row, ctx, base_slot + 3, v);
+  } else if (len == 16) {
+    for (int w = 0; w < 4; w++) {
+      const uint8_t* q = ip.p + w * 4;
+      uint32_t v = (uint32_t(q[0]) << 24) | (uint32_t(q[1]) << 16) |
+                   (uint32_t(q[2]) << 8) | uint32_t(q[3]);
+      set_tag(row, ctx, base_slot + w, v);
+    }
+  }
+}
+
+// status codes
+enum { OK = 0, ERR_DECODE = 1, ERR_METER = 2 };
+
+int decode_one(const uint8_t* msg, uint32_t len, const DecodeCtx& ctx,
+               uint32_t* tag_row, float* meter_row, uint32_t* ts,
+               uint32_t* flags, uint8_t* meter_id_out, uint64_t* str_offs,
+               uint32_t* str_lens, const uint8_t* base) {
+  Cursor doc{msg, msg + len};
+  Cursor minitag{nullptr, nullptr}, meter_buf{nullptr, nullptr};
+  uint32_t wire;
+  uint64_t v;
+  Cursor sub{nullptr, nullptr};
+  while (uint32_t field = doc.next(&wire, &sub, &v)) {
+    switch (field) {
+      case 1: *ts = static_cast<uint32_t>(v); break;
+      case 2: minitag = sub; break;
+      case 3: meter_buf = sub; break;
+      case 4: *flags = static_cast<uint32_t>(v); break;
+      default: break;
+    }
+  }
+  if (doc.fail) return ERR_DECODE;
+
+  // ---- MiniTag{field=1, code=2} ----
+  uint64_t code = 0;
+  Cursor minifield{nullptr, nullptr};
+  while (uint32_t field = minitag.next(&wire, &sub, &v)) {
+    if (field == 1) minifield = sub;
+    else if (field == 2) code = v;
+  }
+  if (minitag.fail) return ERR_DECODE;
+
+  while (uint32_t field = minifield.next(&wire, &sub, &v)) {
+    switch (field) {
+      case 1: decode_ip(tag_row, ctx, sub, S_IP0_W0); break;
+      case 2: decode_ip(tag_row, ctx, sub, S_IP1_W0); break;
+      case 3: set_tag(tag_row, ctx, S_GLOBAL_THREAD_ID, v); break;
+      case 4: set_tag(tag_row, ctx, S_IS_IPV6, v); break;
+      case 5:
+      case 6: {
+        int64_t iv = static_cast<int64_t>(v);
+        set_tag(tag_row, ctx, field == 5 ? S_L3_EPC_ID : S_L3_EPC_ID1,
+                static_cast<uint32_t>(iv & 0xFFFF));
+        break;
+      }
+      case 7:
+        set_tag(tag_row, ctx, S_MAC0_HI, v >> 32);
+        set_tag(tag_row, ctx, S_MAC0_LO, v & 0xFFFFFFFF);
+        break;
+      case 8:
+        set_tag(tag_row, ctx, S_MAC1_HI, v >> 32);
+        set_tag(tag_row, ctx, S_MAC1_LO, v & 0xFFFFFFFF);
+        break;
+      case 9: set_tag(tag_row, ctx, S_DIRECTION, v); break;
+      case 10: set_tag(tag_row, ctx, S_TAP_SIDE, v); break;
+      case 11: set_tag(tag_row, ctx, S_PROTOCOL, v); break;
+      case 12: set_tag(tag_row, ctx, S_ACL_GID, v); break;
+      case 13: set_tag(tag_row, ctx, S_SERVER_PORT, v); break;
+      case 14: set_tag(tag_row, ctx, S_AGENT_ID, v); break;
+      case 15: set_tag(tag_row, ctx, S_TAP_PORT, v); break;
+      case 16: set_tag(tag_row, ctx, S_TAP_TYPE, v); break;
+      case 17: set_tag(tag_row, ctx, S_L7_PROTOCOL, v); break;
+      case 20: set_tag(tag_row, ctx, S_GPID0, v); break;
+      case 21: set_tag(tag_row, ctx, S_GPID1, v); break;
+      case 22: set_tag(tag_row, ctx, S_SIGNAL_SOURCE, v); break;
+      case 23:
+      case 24:
+      case 25: {
+        int idx = field - 23;
+        str_offs[idx] = sub.p - base;
+        str_lens[idx] = static_cast<uint32_t>(sub.end - sub.p);
+        if (field == 25)
+          set_tag(tag_row, ctx, S_ENDPOINT_HASH,
+                  hash_string(sub.p, str_lens[idx]));
+        break;
+      }
+      case 27: set_tag(tag_row, ctx, S_POD_ID, v); break;
+      case 28: set_tag(tag_row, ctx, S_BIZ_TYPE, v); break;
+      default: break;
+    }
+  }
+  if (minifield.fail) return ERR_DECODE;
+
+  // code → dense code_id (linear scan; the table has ~10 entries)
+  uint32_t code_id = 0;
+  for (uint32_t i = 0; i < ctx.n_codes; i++) {
+    if (ctx.codes[i] == code) {
+      code_id = ctx.code_ids[i];
+      break;
+    }
+  }
+  set_tag(tag_row, ctx, S_CODE_ID, code_id);
+
+  // ---- Meter{meter_id=1, flow=2, usage=3, app=4} ----
+  // Mirror the Python decoder: pick the submessage matching the declared
+  // meter_id; a missing submessage means an all-zero meter, submessages
+  // of other meter kinds are ignored.
+  uint32_t meter_id = 0;
+  Cursor sub_bufs[8] = {};
+  while (uint32_t field = meter_buf.next(&wire, &sub, &v)) {
+    if (field == 1) meter_id = static_cast<uint32_t>(v);
+    else if (wire == 2 && field < 8) sub_bufs[field] = sub;
+  }
+  if (meter_buf.fail) return ERR_DECODE;
+  if (meter_id >= 8 || ctx.meter_maps[meter_id] == nullptr) return ERR_METER;
+  Cursor inner = sub_bufs[ctx.meter_sub_field[meter_id]];
+  set_tag(tag_row, ctx, S_METER_ID, meter_id);
+  *meter_id_out = static_cast<uint8_t>(meter_id);
+
+  const int32_t* mmap = ctx.meter_maps[meter_id];
+  if (ctx.meter_flat[meter_id]) {
+    while (uint32_t fid = inner.next(&wire, &sub, &v)) {
+      if (wire != 0 || fid >= 32) continue;
+      int32_t col = mmap[fid];  // sub 0 → plain fid index
+      if (col >= 0) meter_row[col] = static_cast<float>(v);
+    }
+    if (inner.fail) return ERR_DECODE;
+  } else {
+    Cursor subm{nullptr, nullptr};
+    while (uint32_t smsg = inner.next(&wire, &subm, &v)) {
+      if (wire != 2 || smsg >= 8) continue;
+      while (uint32_t fid = subm.next(&wire, &sub, &v)) {
+        if (wire != 0 || fid >= 32) continue;
+        int32_t col = mmap[(smsg << 5) | fid];
+        if (col >= 0) meter_row[col] = static_cast<float>(v);
+      }
+      if (subm.fail) return ERR_DECODE;
+    }
+    if (inner.fail) return ERR_DECODE;
+  }
+  return OK;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Split a frame body into [len u32 LE][msg] messages; writes offsets (into
+// body) and lengths. Returns message count, or -1 on malformed body.
+int32_t df_split_messages(const uint8_t* body, uint32_t len, uint64_t* offs,
+                          uint32_t* lens, uint32_t max_msgs) {
+  uint32_t off = 0;
+  uint32_t n = 0;
+  while (off + 4 <= len && n < max_msgs) {
+    uint32_t size;
+    memcpy(&size, body + off, 4);
+    off += 4;
+    if (off + size > len) return -1;
+    offs[n] = off;
+    lens[n] = size;
+    off += size;
+    n++;
+  }
+  if (off != len) return -1;
+  return static_cast<int32_t>(n);
+}
+
+// Decode n Documents (concatenated in `buf` at offs/lens) into SoA outputs.
+// All outputs are preallocated by the caller with n rows. Returns the
+// number of OK rows (status[i]==0).
+int32_t df_decode_documents(
+    const uint8_t* buf, const uint64_t* offs, const uint32_t* lens, uint32_t n,
+    const int32_t* tag_col, uint32_t t_cols,
+    const int32_t* flow_map, const int32_t* usage_map, const int32_t* app_map,
+    const uint64_t* codes, const uint32_t* code_ids, uint32_t n_codes,
+    uint32_t m_cols,  // meters row stride (max over meter schemas)
+    uint32_t* tags, float* meters, uint32_t* timestamps, uint32_t* flags,
+    uint8_t* meter_ids, uint64_t* str_offs, uint32_t* str_lens,
+    uint8_t* status) {
+  DecodeCtx ctx{};
+  ctx.tag_col = tag_col;
+  ctx.t_cols = t_cols;
+  for (int i = 0; i < 8; i++) {
+    ctx.meter_maps[i] = nullptr;
+    ctx.meter_sub_field[i] = -1;
+    ctx.meter_flat[i] = false;
+  }
+  // MeterId::{FLOW=1, USAGE=4, APP=5} → Meter.{flow=2, usage=3, app=4}
+  ctx.meter_maps[1] = flow_map;
+  ctx.meter_sub_field[1] = 2;
+  ctx.meter_maps[4] = usage_map;
+  ctx.meter_sub_field[4] = 3;
+  ctx.meter_flat[4] = true;
+  ctx.meter_maps[5] = app_map;
+  ctx.meter_sub_field[5] = 4;
+  ctx.codes = codes;
+  ctx.code_ids = code_ids;
+  ctx.n_codes = n_codes;
+
+  int32_t ok = 0;
+  for (uint32_t i = 0; i < n; i++) {
+    uint32_t* tag_row = tags + static_cast<size_t>(i) * t_cols;
+    float* meter_row = meters + static_cast<size_t>(i) * m_cols;
+    int st = decode_one(buf + offs[i], lens[i], ctx, tag_row, meter_row,
+                        timestamps + i, flags + i, meter_ids + i,
+                        str_offs + static_cast<size_t>(i) * 3,
+                        str_lens + static_cast<size_t>(i) * 3, buf);
+    status[i] = static_cast<uint8_t>(st);
+    if (st == OK) ok++;
+  }
+  return ok;
+}
+
+}  // extern "C"
