@@ -1,0 +1,6 @@
+from .platform import (  # noqa: F401
+    ENRICH_FIELDS,
+    PlatformInfoTable,
+    PlatformState,
+    enrich_docs,
+)

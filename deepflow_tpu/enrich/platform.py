@@ -1,0 +1,478 @@
+"""Tag enrichment — the TPU-native `DocumentExpand`.
+
+The reference enriches every document on the ingest host by chasing
+hash-map pointers per doc (unmarshaller/handle_document.go:114-270,
+grpc_platformdata.go:263-392): gpid→pod fill, pod→info, MAC→info,
+(EPC,IP)→info fallback chain, pod-service / custom-service resolution,
+auto_service / auto_instance priority encoding, multicast peer fill,
+other-region drop and OTel fixups.
+
+Here the whole batch is enriched *on device*: the controller-synced
+platform metadata is compiled by the host into `DeviceHashTable`s +
+a dense info matrix (see ops/hashtable.py), and `enrich_docs` resolves
+every doc row with vectorized probes and gathers — no per-row host work.
+The fallback chain becomes nested `jnp.where` selects; the region filter
+becomes a keep-mask instead of an error return.
+
+Deviation from the reference (documented): pod-service resolution
+(grpc_platformdata.go:1685-2054 QueryPodService) is keyed here on
+(pod_group_id | pod_node_id, protocol, port) with port-0 wildcard rows,
+rather than the reference's clusterIP/backend-IP LRU complex; custom
+services are keyed on (EPC, IP[, port]) exactly like the reference's
+QueryCustomService.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import ipaddress
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..datamodel.code import CodeId, SignalSource
+from ..datamodel.schema import TAG_SCHEMA
+from ..ops.hashing import fingerprint64
+from ..ops.hashtable import DeviceHashTable, build_table, empty_table
+
+_T = TAG_SCHEMA
+
+# EPC sentinel values, i16 sign-folded to u16 (datatype/endpoint.go:28-30).
+EPC_INTERNET = 0xFFFE  # -2
+EPC_UNKNOWN = 0
+
+# TagSource bits (flow-metrics/tag.go:257-266).
+TS_GPID = 1
+TS_POD_ID = 2
+TS_MAC = 4
+TS_EPC_IP = 8
+TS_PEER = 16
+
+# AutoService/AutoInstance type codes (trident.proto:332-364,
+# ingester/common/common.go:145-193).
+TYPE_INTERNET_IP = 0
+TYPE_IP = 255
+TYPE_POD = 10
+TYPE_POD_SERVICE = 11
+TYPE_POD_NODE = 14
+TYPE_POD_CLUSTER = 103
+TYPE_CUSTOM_SERVICE = 104
+TYPE_PROCESS = 120
+DEVICE_TYPE_POD_SERVICE = 11
+
+# Info matrix column layout (grpc.Info, grpc_platformdata.go:64-90).
+INFO_FIELDS = (
+    "region_id",
+    "host_id",
+    "l3_device_id",
+    "l3_device_type",
+    "subnet_id",
+    "pod_node_id",
+    "pod_ns_id",
+    "az_id",
+    "pod_group_id",
+    "pod_group_type",
+    "pod_id",
+    "pod_cluster_id",
+)
+_I = {n: i for i, n in enumerate(INFO_FIELDS)}
+
+# Per-side enrichment output columns.
+ENRICH_FIELDS = INFO_FIELDS + (
+    "service_id",
+    "auto_instance_id",
+    "auto_instance_type",
+    "auto_service_id",
+    "auto_service_type",
+    "tag_source",
+)
+
+# Table-key seeds: each keyspace prepends a distinct discriminator column
+# so fingerprints never collide across tables that share a state pytree.
+_KS_MAC = 1
+_KS_EPC_IP = 2
+_KS_POD_SVC = 3
+_KS_CUSTOM_SVC = 4
+
+
+def _ip_words(ip) -> tuple[int, tuple[int, int, int, int]]:
+    """Accept '1.2.3.4', 'fd00::1', int (v4), or (is_v6, words) →
+    (is_v6, 4×u32 words, v4 right-aligned in word 3)."""
+    if isinstance(ip, tuple):
+        return ip
+    if isinstance(ip, int):
+        return 0, (0, 0, 0, ip & 0xFFFFFFFF)
+    addr = ipaddress.ip_address(ip)
+    n = int(addr)
+    if addr.version == 4:
+        return 0, (0, 0, 0, n)
+    return 1, tuple((n >> s) & 0xFFFFFFFF for s in (96, 64, 32, 0))
+
+
+def _fold_epc(epc: int) -> int:
+    return epc & 0xFFFF
+
+
+def _fp_np(cols: list[np.ndarray]) -> tuple[np.ndarray, np.ndarray]:
+    mat = np.stack([np.asarray(c, np.uint32) for c in cols], axis=1)
+    return fingerprint64(mat, xp=np)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class PlatformState:
+    """Device-resident platform metadata (one refresh generation)."""
+
+    infos: jnp.ndarray  # [M, len(INFO_FIELDS)] u32; row 0 = zero info
+    gproc_rows: jnp.ndarray  # [G, 2] u32 (agent_id, pod_id); row 0 = zeros
+    pod_t: DeviceHashTable  # pod_id → info row
+    mac_t: DeviceHashTable  # fp(epc, mac) → info row
+    epcip_t: DeviceHashTable  # fp(is_v6, epc, ip words) → info row
+    gproc_t: DeviceHashTable  # gpid → gproc row
+    podsvc_t: DeviceHashTable  # fp(kind, id, proto, port) → service_id
+    customsvc_t: DeviceHashTable  # fp(is_v6, epc, ip words, port) → service_id
+    my_region_id: jnp.ndarray  # scalar u32 (0 = no region filtering)
+
+
+class PlatformInfoTable:
+    """Host-side registry; `build()` compiles to a `PlatformState`.
+
+    The controller sync path (trisolaris push → PlatformInfoTable refresh,
+    grpc_platformdata.go:147) maps to: apply updates here, rebuild, and
+    swap the new pytree into the jit'd pipeline — generation semantics
+    instead of in-place LRU mutation.
+    """
+
+    def __init__(self, my_region_id: int = 0):
+        self.my_region_id = my_region_id
+        self._infos: list[dict] = []
+        self._pod: dict[int, int] = {}
+        self._mac: dict[tuple[int, int], int] = {}  # (epc, mac48) → info idx
+        self._epcip: dict[tuple, int] = {}  # (is_v6, epc, words) → info idx
+        self._gproc: dict[int, tuple[int, int]] = {}  # gpid → (agent, pod)
+        self._podsvc: dict[tuple, int] = {}  # (kind, id, proto, port) → svc
+        self._customsvc: dict[tuple, int] = {}  # (is_v6, epc, words, port) → svc
+
+    # -- population ----------------------------------------------------
+    def add_info(self, *, epc_id: int = 0, ips=(), mac: int = 0, pod_id: int = 0, **fields):
+        """Register one resource (interface/pod) with its metadata.
+
+        `fields` are INFO_FIELDS values; `ips`/`mac`/`pod_id` key it.
+        """
+        unknown = set(fields) - set(INFO_FIELDS)
+        if unknown:
+            raise KeyError(f"unknown info fields: {unknown}")
+        idx = len(self._infos) + 1  # row 0 is the zero info
+        self._infos.append({f: int(fields.get(f, 0)) for f in INFO_FIELDS})
+        epc = _fold_epc(epc_id)
+        if pod_id:
+            self._pod[pod_id] = idx
+        if mac:
+            self._mac[(epc, mac)] = idx
+        for ip in ips:
+            is_v6, words = _ip_words(ip)
+            self._epcip[(is_v6, epc, words)] = idx
+        return idx
+
+    def add_gprocess(self, gpid: int, agent_id: int, pod_id: int):
+        self._gproc[gpid] = (agent_id, pod_id)
+
+    def add_pod_service(self, service_id: int, *, pod_group_id: int = 0, pod_node_id: int = 0, protocol: int = 0, server_port: int = 0):
+        """port/protocol 0 rows act as wildcards (any-port service)."""
+        if pod_group_id:
+            self._podsvc[(0, pod_group_id, protocol, server_port)] = service_id
+        if pod_node_id:
+            self._podsvc[(1, pod_node_id, protocol, server_port)] = service_id
+
+    def add_custom_service(self, service_id: int, *, epc_id: int, ip, server_port: int = 0):
+        is_v6, words = _ip_words(ip)
+        self._customsvc[(is_v6, _fold_epc(epc_id), words, server_port)] = service_id
+
+    # -- compile -------------------------------------------------------
+    def build(self) -> PlatformState:
+        infos = np.zeros((len(self._infos) + 1, len(INFO_FIELDS)), dtype=np.uint32)
+        for i, rec in enumerate(self._infos):
+            infos[i + 1] = [rec[f] for f in INFO_FIELDS]
+
+        gproc_rows = np.zeros((len(self._gproc) + 1, 2), dtype=np.uint32)
+        g_keys, g_vals = [], []
+        for i, (gpid, (agent, pod)) in enumerate(self._gproc.items()):
+            gproc_rows[i + 1] = (agent, pod)
+            g_keys.append(gpid)
+            g_vals.append(i + 1)
+
+        def table(d: dict, key_fn) -> DeviceHashTable:
+            if not d:
+                return empty_table()
+            cols = [key_fn(k) for k in d]
+            hi, lo = _fp_np([np.array([c[j] for c in cols], np.uint32) for j in range(len(cols[0]))])
+            return build_table(hi, lo, np.array(list(d.values()), np.uint32))
+
+        pod_t = (
+            build_table(
+                np.zeros(len(self._pod), np.uint32),
+                np.fromiter(self._pod.keys(), np.uint32, len(self._pod)),
+                np.fromiter(self._pod.values(), np.uint32, len(self._pod)),
+            )
+            if self._pod
+            else empty_table()
+        )
+        gproc_t = (
+            build_table(
+                np.zeros(len(g_keys), np.uint32),
+                np.asarray(g_keys, np.uint32),
+                np.asarray(g_vals, np.uint32),
+            )
+            if g_keys
+            else empty_table()
+        )
+        mac_t = table(self._mac, lambda k: (_KS_MAC, k[0], (k[1] >> 32) & 0xFFFF, k[1] & 0xFFFFFFFF))
+        epcip_t = table(self._epcip, lambda k: (_KS_EPC_IP, k[0], k[1], *k[2]))
+        podsvc_t = table(self._podsvc, lambda k: (_KS_POD_SVC, *k))
+        customsvc_t = table(
+            self._customsvc, lambda k: (_KS_CUSTOM_SVC, k[0], k[1], *k[2], k[3])
+        )
+        return PlatformState(
+            infos=jnp.asarray(infos),
+            gproc_rows=jnp.asarray(gproc_rows),
+            pod_t=pod_t,
+            mac_t=mac_t,
+            epcip_t=epcip_t,
+            gproc_t=gproc_t,
+            podsvc_t=podsvc_t,
+            customsvc_t=customsvc_t,
+            my_region_id=jnp.asarray(self.my_region_id, jnp.uint32),
+        )
+
+
+def _fp_cols(cols):
+    mat = jnp.stack([jnp.asarray(c, jnp.uint32) for c in cols], axis=1)
+    return fingerprint64(mat)
+
+
+def _col(tags, name):
+    return tags[:, _T.index(name)]
+
+
+def _lookup_fp(t: DeviceHashTable, cols):
+    hi, lo = _fp_cols(cols)
+    return t.lookup(hi, lo)
+
+
+def _is_multicast(is_v6, w0, w3):
+    v4 = (w3 >> jnp.uint32(28)) == jnp.uint32(0xE)
+    v6 = (w0 >> jnp.uint32(24)) == jnp.uint32(0xFF)
+    return jnp.where(is_v6 != 0, v6, v4)
+
+
+def _enrich_side(state: PlatformState, tags, side: int, is_edge, is_otel):
+    """Resolve one endpoint: the getPlatformInfos fallback chain
+    (handle_document.go:41-112) + service/auto encodings (:137-240)."""
+    n = tags.shape[0]
+    zero = jnp.zeros((n,), jnp.uint32)
+    sfx = "" if side == 0 else "1"
+    epc = _col(tags, "l3_epc_id" + sfx) & jnp.uint32(0xFFFF)
+    gpid = _col(tags, "gpid" + ("0" if side == 0 else "1"))
+    mac_hi = _col(tags, f"mac{side}_hi")
+    mac_lo = _col(tags, f"mac{side}_lo")
+    ipw = [_col(tags, f"ip{side}_w{w}") for w in range(4)]
+    is_v6 = _col(tags, "is_ipv6")
+    agent_id = _col(tags, "agent_id")
+    pod = _col(tags, "pod_id") if side == 0 else zero
+
+    # side 1 participates only in edge docs; side 0 always.
+    in_play = (is_edge if side == 1 else jnp.ones((n,), bool)) & (
+        epc != jnp.uint32(EPC_INTERNET)
+    )
+    tag_source = zero
+
+    # gpid → pod fill (QueryGprocessInfo; agent match required)
+    g_row, g_found = state.gproc_t.lookup(zero, gpid)
+    g_row = jnp.where(g_found, g_row, 0)
+    g_agent = state.gproc_rows[g_row, 0]
+    g_pod = state.gproc_rows[g_row, 1]
+    use_gproc = in_play & (gpid != 0) & (pod == 0) & g_found & (g_pod != 0) & (g_agent == agent_id)
+    pod = jnp.where(use_gproc, g_pod, pod)
+    tag_source = tag_source | jnp.where(use_gproc, jnp.uint32(TS_GPID), 0)
+
+    # pod → info
+    try_pod = in_play & (pod != 0)
+    pod_idx, pod_found = state.pod_t.lookup(zero, pod)
+    pod_hit = try_pod & pod_found
+    tag_source = tag_source | jnp.where(try_pod, jnp.uint32(TS_POD_ID), 0)
+
+    # mac → info (key includes EPC, grpc_platformdata.go:63)
+    try_mac = in_play & ~pod_hit & ((mac_hi | mac_lo) != 0)
+    mac_idx, mac_found = _lookup_fp(
+        state.mac_t, [jnp.full((n,), _KS_MAC, jnp.uint32), epc, mac_hi, mac_lo]
+    )
+    mac_hit = try_mac & mac_found
+    tag_source = tag_source | jnp.where(try_mac, jnp.uint32(TS_MAC), 0)
+
+    # (EPC, IP) → info
+    ip_idx, ip_found = _lookup_fp(
+        state.epcip_t, [jnp.full((n,), _KS_EPC_IP, jnp.uint32), is_v6, epc, *ipw]
+    )
+    try_ip = in_play & ~pod_hit & ~mac_hit
+    ip_hit = try_ip & ip_found
+    tag_source = tag_source | jnp.where(try_ip, jnp.uint32(TS_EPC_IP), 0)
+
+    have = pod_hit | mac_hit | ip_hit
+    idx = jnp.where(pod_hit, pod_idx, jnp.where(mac_hit, mac_idx, jnp.where(ip_hit, ip_idx, 0)))
+    info = jnp.where(have[:, None], state.infos[idx], 0)
+
+    out = {f: info[:, _I[f]] for f in INFO_FIELDS}
+    # the matched pod wins over the info's pod column when info came from
+    # the gpid/pod path (reference keeps t.PodID as matched)
+    out["pod_id"] = jnp.where(pod_hit, pod, out["pod_id"])
+
+    # -- pod service (IsPodServiceIP gate, handle_document.go:151,194-202)
+    dev_type = out["l3_device_type"]
+    server_port = _col(tags, "server_port")
+    protocol = _col(tags, "protocol")
+    is_pod_svc_ip = (dev_type == jnp.uint32(DEVICE_TYPE_POD_SERVICE)) | (out["pod_id"] != 0) | (out["pod_node_id"] != 0)
+    if side == 0:
+        # single-side with valid port → port-matched; else any-port, and
+        # pod-node-only endpoints don't match (handle_document.go:199).
+        use_port = (server_port > 0) & ~is_edge
+        port_key = jnp.where(use_port, server_port, zero)
+        proto_key = jnp.where(use_port, protocol, zero)
+        gate = have & is_pod_svc_ip & (
+            use_port
+            | (dev_type == jnp.uint32(DEVICE_TYPE_POD_SERVICE))
+            | (out["pod_id"] != 0)
+        )
+    else:
+        port_key = server_port
+        proto_key = protocol
+        gate = have & is_pod_svc_ip
+
+    def podsvc_lookup(kind_const, ident, proto_c, port_c):
+        v, f = _lookup_fp(
+            state.podsvc_t,
+            [
+                jnp.full((n,), _KS_POD_SVC, jnp.uint32),
+                jnp.full((n,), kind_const, jnp.uint32),
+                ident,
+                proto_c,
+                port_c,
+            ],
+        )
+        return v, f
+
+    svc = zero
+    svc_found = jnp.zeros((n,), bool)
+    for kind, ident in ((0, out["pod_group_id"]), (1, out["pod_node_id"])):
+        for p_proto, p_port in ((proto_key, port_key), (zero, zero)):
+            v, f = podsvc_lookup(kind, ident, p_proto, p_port)
+            use = gate & (ident != 0) & f & ~svc_found
+            svc = jnp.where(use, v, svc)
+            svc_found = svc_found | use
+    out["service_id"] = svc
+
+    # -- custom service (QueryCustomService: exact port then any-port).
+    # Side 0 uses the port only for single-side docs (handle_document.go:236-238);
+    # side 1 always does (:178).
+    cs_port = server_port if side == 1 else jnp.where(~is_edge, server_port, zero)
+    cs = zero
+    cs_found = jnp.zeros((n,), bool)
+    for p in (cs_port, zero):
+        v, f = _lookup_fp(
+            state.customsvc_t,
+            [jnp.full((n,), _KS_CUSTOM_SVC, jnp.uint32), is_v6, epc, *ipw, p],
+        )
+        use = f & ~cs_found & (epc != jnp.uint32(EPC_INTERNET))
+        cs = jnp.where(use, v, cs)
+        cs_found = cs_found | use
+
+    # -- auto instance / auto service priority chains (common.go:160-193)
+    is_internet = epc == jnp.uint32(EPC_INTERNET)
+
+    def chain(*pairs, internet, fallback):
+        cid, ctype = fallback
+        cid, ctype = jnp.where(is_internet, internet[0], cid), jnp.where(
+            is_internet, internet[1], ctype
+        )
+        for pid, ptype in reversed(pairs):
+            take = pid > 0
+            cid = jnp.where(take, pid, cid)
+            ctype = jnp.where(take, ptype, ctype)
+        return cid, ctype
+
+    dev = out["l3_device_id"]
+    out["auto_instance_id"], out["auto_instance_type"] = chain(
+        (out["pod_id"], jnp.full((n,), TYPE_POD, jnp.uint32)),
+        (gpid, jnp.full((n,), TYPE_PROCESS, jnp.uint32)),
+        (out["pod_node_id"], jnp.full((n,), TYPE_POD_NODE, jnp.uint32)),
+        (dev, dev_type),
+        internet=(zero, jnp.full((n,), TYPE_INTERNET_IP, jnp.uint32)),
+        fallback=(out["subnet_id"], jnp.full((n,), TYPE_IP, jnp.uint32)),
+    )
+    out["auto_service_id"], out["auto_service_type"] = chain(
+        (cs, jnp.full((n,), TYPE_CUSTOM_SERVICE, jnp.uint32)),
+        (svc, jnp.full((n,), TYPE_POD_SERVICE, jnp.uint32)),
+        (out["pod_group_id"], out["pod_group_type"]),
+        (gpid, jnp.full((n,), TYPE_PROCESS, jnp.uint32)),
+        (out["pod_cluster_id"], jnp.full((n,), TYPE_POD_CLUSTER, jnp.uint32)),
+        (dev, dev_type),
+        internet=(zero, jnp.full((n,), TYPE_INTERNET_IP, jnp.uint32)),
+        fallback=(out["subnet_id"], jnp.full((n,), TYPE_IP, jnp.uint32)),
+    )
+
+    # OTel: Internet-typed endpoints display as plain IP (handle_document.go:255-266)
+    for f in ("auto_service_type", "auto_instance_type"):
+        out[f] = jnp.where(
+            is_otel & (out[f] == jnp.uint32(TYPE_INTERNET_IP)), jnp.uint32(TYPE_IP), out[f]
+        )
+
+    out["tag_source"] = tag_source
+    return out, have
+
+
+@jax.jit
+def enrich_docs(state: PlatformState, tags: jnp.ndarray, valid: jnp.ndarray):
+    """Enrich a doc batch: [N, T] u32 tag matrix → (side0 dict, side1 dict,
+    keep mask, other_region_drops).
+
+    keep = valid ∧ ¬other-region (the reference returns an error per doc
+    and drops it, handle_document.go:170-231).
+    """
+    code_id = _col(tags, "code_id")
+    is_edge = (code_id >= jnp.uint32(CodeId.EDGE_IP_PORT)) & (
+        code_id <= jnp.uint32(CodeId.EDGE_MAC_IP_PORT_APP)
+    )
+    sig = _col(tags, "signal_source")
+    is_otel = sig == jnp.uint32(SignalSource.OTEL)
+    # exact CLIENT/SERVER compare — sided variants (e.g. SERVER_NODE) are
+    # not region-checked in the reference (handle_document.go:171,221)
+    tap_side = _col(tags, "tap_side")
+
+    side0, have0 = _enrich_side(state, tags, 0, is_edge, is_otel)
+    side1, have1 = _enrich_side(state, tags, 1, is_edge, is_otel)
+
+    # multicast peer fill (handle_document.go:154-168, 203-217)
+    is_v6 = _col(tags, "is_ipv6")
+    mc0 = _is_multicast(is_v6, _col(tags, "ip0_w0"), _col(tags, "ip0_w3"))
+    mc1 = _is_multicast(is_v6, _col(tags, "ip1_w0"), _col(tags, "ip1_w3"))
+    fill0 = ~have0 & have1 & mc0 & is_edge
+    fill1 = ~have1 & have0 & mc1 & is_edge
+    for f in ("region_id", "subnet_id", "az_id"):
+        side0[f] = jnp.where(fill0, side1[f], side0[f])
+        side1[f] = jnp.where(fill1, side0[f], side1[f])
+    side0["tag_source"] = side0["tag_source"] | jnp.where(fill0, jnp.uint32(TS_PEER), 0)
+    side1["tag_source"] = side1["tag_source"] | jnp.where(fill1, jnp.uint32(TS_PEER), 0)
+
+    # other-region filter (handle_document.go:170-231): single-side docs
+    # must match my region; edge docs check the observation side.
+    my = state.my_region_id
+    r0, r1 = side0["region_id"], side1["region_id"]
+    filtering = my != 0
+    bad_single = ~is_edge & (r0 != 0) & (r0 != my)
+    bad_edge_client = is_edge & (tap_side == 1) & (r0 != 0) & (r0 != my)
+    bad_edge_server = is_edge & (tap_side == 2) & (r1 != 0) & (r1 != my)
+    other_region = filtering & (bad_single | bad_edge_client | bad_edge_server)
+    keep = valid & ~other_region
+
+    drops = jnp.sum((valid & other_region).astype(jnp.int32))
+    return side0, side1, keep, drops

@@ -344,7 +344,9 @@ class DocumentDecoder:
         for msg in messages:
             try:
                 row = self._decode_one(msg, strings)
-            except (ValueError, IndexError, KeyError):
+            except Exception:
+                # hostile/corrupt wire data must never kill the batch —
+                # count and continue (unmarshaller.go decode_errors stance)
                 self.decode_errors += 1
                 continue
             rows.setdefault(row[0], []).append(row)
@@ -383,13 +385,13 @@ class DocumentDecoder:
         meter_buf = b""
         for field, v in _iter_fields(msg):
             if field == 1:
-                ts = v
+                ts = v & 0xFFFFFFFF  # native twin masks to u32 too
             elif field == 2:
                 minitag = v
             elif field == 3:
                 meter_buf = v
             elif field == 4:
-                flags = v
+                flags = v & 0xFFFFFFFF
 
         code = 0
         minifield = b""

@@ -93,10 +93,21 @@ class FlowHeader:
         )
 
 
+# Upper bound on one wire frame; shared by sender and receiver so a frame
+# that encodes is always accepted (a frame at/over the reassembler's limit
+# would otherwise desync the whole stream into byte-wise resync).
+MAX_FRAME_SIZE = (1 << 24) - 1
+
+
 def encode_frame(header: FlowHeader, messages: list[bytes]) -> bytes:
     """One wire frame: header + [len u32 LE][pb] per message."""
     body = b"".join(struct.pack("<I", len(m)) + m for m in messages)
-    header.frame_size = HEADER_LEN + len(body)
+    frame_size = HEADER_LEN + len(body)
+    if frame_size > MAX_FRAME_SIZE:
+        raise ValueError(
+            f"frame too large: {frame_size} > {MAX_FRAME_SIZE}; batch fewer messages"
+        )
+    header.frame_size = frame_size
     return header.encode() + body
 
 
@@ -121,7 +132,7 @@ class FrameReassembler:
     """Incremental TCP stream → frames (the receiver's flow-header scan,
     receiver.go:515-585). Feed arbitrary chunks; yields (header, body)."""
 
-    def __init__(self, max_frame: int = 1 << 24):
+    def __init__(self, max_frame: int = MAX_FRAME_SIZE + 1):
         self._buf = bytearray()
         self.max_frame = max_frame
         self.bad_frames = 0

@@ -109,7 +109,10 @@ def _load():
             )
         lib = ctypes.CDLL(_SO_PATH)
     except (OSError, subprocess.CalledProcessError, FileNotFoundError) as e:
-        _build_error = str(e)
+        # CalledProcessError's str() omits captured stderr — keep the
+        # compiler diagnostics so skip reasons stay debuggable.
+        stderr = getattr(e, "stderr", None)
+        _build_error = f"{e}: {stderr.strip()}" if stderr else str(e)
         return
 
     lib.dfq_new.restype = ctypes.c_void_p
@@ -283,21 +286,26 @@ class NativeDocumentDecoder:
         strings = StringDict()
         out: dict[int, DecodedBatch] = {}
         ok = status == 0
+        # intern string slices in *message order* — ids must match the
+        # Python decoder exactly even when meter types interleave
+        # (rare for L4; hot only on L7/app paths)
+        sid_all = np.zeros((n, 3), dtype=np.uint32)
+        for i in range(n):
+            if not ok[i]:
+                continue
+            for j in range(3):
+                ln = int(str_lens[i, j])
+                if ln:
+                    off = int(str_offs[i, j])
+                    sid_all[i, j] = strings.intern(
+                        buf[off : off + ln].decode(errors="replace")
+                    )
         for meter_id, schema in _SCHEMA_OF_ID.items():
             mask = ok & (meter_ids == meter_id)
             if not mask.any():
                 continue
             rows = np.nonzero(mask)[0]
-            service_ids = np.zeros((rows.size, 3), dtype=np.uint32)
-            # intern string slices (rare for L4; hot only on L7/app paths)
-            for k, i in enumerate(rows):
-                for j in range(3):
-                    ln = int(str_lens[i, j])
-                    if ln:
-                        off = int(str_offs[i, j])
-                        service_ids[k, j] = strings.intern(
-                            buf[off : off + ln].decode(errors="replace")
-                        )
+            service_ids = sid_all[rows]
             out[meter_id] = DecodedBatch(
                 meter_id=meter_id,
                 meter_schema=schema,

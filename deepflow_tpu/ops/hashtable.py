@@ -1,0 +1,128 @@
+"""Device-resident static hash tables: vectorized u64-key → u32-value lookup.
+
+The reference resolves platform metadata with pointer-chasing hash maps on
+the host (`PlatformInfoTable` LRUs + id maps, grpc_platformdata.go:263-392;
+hmap/idmap u64/u128 maps). On TPU the same lookups become *gathers*: the
+host builds a fixed-capacity open-addressing table (linear probing) as flat
+u32 arrays, ships it to HBM once per refresh, and the device probes it for
+a whole batch at once — `max_probes` is measured at build time and becomes
+the static unroll bound, so a lookup is `max_probes` gathers + compares on
+the VPU with no data-dependent control flow.
+
+Keys are (hi, lo) u32 lane pairs (TPUs have no useful native u64 path —
+see ops/hashing.py). Values are u32; multi-field values are expressed as a
+row index into a caller-side matrix, gathered after lookup.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .hashing import fmix32
+
+NOT_FOUND = np.uint32(0xFFFFFFFF)
+# Multiplicative mixing constant (2^32 / golden ratio) used to combine the
+# two key lanes before the finalizer.
+_PHI = 0x9E3779B9
+
+
+def _bucket_hash(hi, lo, xp):
+    h = xp.asarray(hi, xp.uint32) * xp.uint32(_PHI) ^ xp.asarray(lo, xp.uint32)
+    return fmix32(h, xp)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class DeviceHashTable:
+    """Flat open-addressing table. `filled` marks occupied buckets."""
+
+    key_hi: jnp.ndarray  # [C] u32
+    key_lo: jnp.ndarray  # [C] u32
+    value: jnp.ndarray  # [C] u32
+    filled: jnp.ndarray  # [C] bool
+    # static: max probe distance measured by the host builder
+    max_probes: int = dataclasses.field(metadata={"static": True}, default=1)
+
+    @property
+    def capacity(self) -> int:
+        return self.key_hi.shape[0]
+
+    def lookup(self, hi, lo):
+        """Batched probe: [N] u32 lanes → ([N] u32 values, [N] bool found).
+
+        Misses return NOT_FOUND with found=False. The probe loop is a
+        static unroll of `max_probes` gather+compare steps.
+        """
+        hi = jnp.asarray(hi, jnp.uint32)
+        lo = jnp.asarray(lo, jnp.uint32)
+        mask = jnp.uint32(self.capacity - 1)
+        idx = _bucket_hash(hi, lo, jnp) & mask
+        value = jnp.full(hi.shape, NOT_FOUND, dtype=jnp.uint32)
+        found = jnp.zeros(hi.shape, dtype=bool)
+        for p in range(self.max_probes):
+            slot = (idx + jnp.uint32(p)) & mask
+            hit = (
+                self.filled[slot]
+                & (self.key_hi[slot] == hi)
+                & (self.key_lo[slot] == lo)
+                & ~found
+            )
+            value = jnp.where(hit, self.value[slot], value)
+            found = found | hit
+        return value, found
+
+
+def build_table(
+    keys_hi: np.ndarray, keys_lo: np.ndarray, values: np.ndarray, min_capacity: int = 8
+) -> DeviceHashTable:
+    """Host-side construction with numpy linear probing.
+
+    Capacity is the next power of two ≥ 2×n (load factor ≤ 0.5), so probe
+    chains stay short; the realized worst chain becomes `max_probes`.
+    Duplicate keys: last insert wins (refresh overwrite semantics).
+    """
+    keys_hi = np.asarray(keys_hi, dtype=np.uint32)
+    keys_lo = np.asarray(keys_lo, dtype=np.uint32)
+    values = np.asarray(values, dtype=np.uint32)
+    n = keys_hi.shape[0]
+    cap = int(min_capacity)
+    while cap < max(2 * n, min_capacity):
+        cap *= 2
+
+    t_hi = np.zeros(cap, dtype=np.uint32)
+    t_lo = np.zeros(cap, dtype=np.uint32)
+    t_val = np.zeros(cap, dtype=np.uint32)
+    t_fill = np.zeros(cap, dtype=bool)
+    max_probes = 1
+    mask = cap - 1
+    start = _bucket_hash(keys_hi, keys_lo, np)
+    for i in range(n):
+        idx = int(start[i]) & mask
+        for p in range(cap):
+            slot = (idx + p) & mask
+            if not t_fill[slot]:
+                t_hi[slot], t_lo[slot], t_val[slot] = keys_hi[i], keys_lo[i], values[i]
+                t_fill[slot] = True
+                max_probes = max(max_probes, p + 1)
+                break
+            if t_hi[slot] == keys_hi[i] and t_lo[slot] == keys_lo[i]:
+                t_val[slot] = values[i]  # overwrite duplicate
+                break
+    return DeviceHashTable(
+        key_hi=jnp.asarray(t_hi),
+        key_lo=jnp.asarray(t_lo),
+        value=jnp.asarray(t_val),
+        filled=jnp.asarray(t_fill),
+        max_probes=max_probes,
+    )
+
+
+def empty_table() -> DeviceHashTable:
+    """A valid table with no entries (all lookups miss)."""
+    return build_table(
+        np.zeros(0, np.uint32), np.zeros(0, np.uint32), np.zeros(0, np.uint32)
+    )

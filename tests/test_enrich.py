@@ -1,0 +1,400 @@
+"""Enrichment conformance: device gather chain vs. a scalar oracle.
+
+The oracle reimplements the reference's DocumentExpand fallback chain
+(handle_document.go:41-267) row by row in plain Python against the host
+dictionaries, independently of the device hash tables — so a bug in the
+table build or the probe loop cannot hide.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from deepflow_tpu.datamodel.code import CodeId, SignalSource
+from deepflow_tpu.datamodel.schema import TAG_SCHEMA
+from deepflow_tpu.enrich.platform import (
+    DEVICE_TYPE_POD_SERVICE,
+    EPC_INTERNET,
+    TS_EPC_IP,
+    TS_GPID,
+    TS_MAC,
+    TS_PEER,
+    TS_POD_ID,
+    TYPE_CUSTOM_SERVICE,
+    TYPE_INTERNET_IP,
+    TYPE_IP,
+    TYPE_POD,
+    TYPE_POD_CLUSTER,
+    TYPE_POD_NODE,
+    TYPE_POD_SERVICE,
+    TYPE_PROCESS,
+    INFO_FIELDS,
+    PlatformInfoTable,
+    _ip_words,
+    enrich_docs,
+)
+from deepflow_tpu.ops.hashtable import NOT_FOUND, build_table
+
+_T = TAG_SCHEMA
+
+
+# ---------------------------------------------------------------- hashtable
+def test_hashtable_roundtrip():
+    rng = np.random.default_rng(7)
+    n = 500
+    hi = rng.integers(0, 2**32, n, dtype=np.uint32)
+    lo = rng.integers(0, 2**32, n, dtype=np.uint32)
+    # dedupe key pairs
+    _, uniq = np.unique(hi.astype(np.uint64) << 32 | lo, return_index=True)
+    hi, lo = hi[uniq], lo[uniq]
+    vals = np.arange(len(hi), dtype=np.uint32)
+    t = build_table(hi, lo, vals)
+
+    got, found = t.lookup(hi, lo)
+    assert bool(np.all(np.asarray(found)))
+    assert np.array_equal(np.asarray(got), vals)
+
+    miss_hi = rng.integers(0, 2**32, 64, dtype=np.uint32)
+    miss_lo = np.full(64, 0xDEADBEEF, np.uint32)
+    keyset = set(zip(hi.tolist(), lo.tolist()))
+    mask = np.array([(a, b) not in keyset for a, b in zip(miss_hi, miss_lo)])
+    got, found = t.lookup(miss_hi, miss_lo)
+    assert not np.any(np.asarray(found)[mask])
+    assert np.all(np.asarray(got)[mask] == NOT_FOUND)
+
+
+# ---------------------------------------------------------------- fixtures
+MY_REGION = 3
+
+
+def make_platform() -> PlatformInfoTable:
+    pt = PlatformInfoTable(my_region_id=MY_REGION)
+    # pod-keyed pod (also ip-keyed)
+    pt.add_info(
+        epc_id=10, pod_id=101, ips=["10.0.0.1"], region_id=MY_REGION, host_id=1,
+        l3_device_id=11, l3_device_type=1, subnet_id=21, pod_node_id=31,
+        pod_ns_id=41, az_id=51, pod_group_id=61, pod_group_type=101, pod_cluster_id=71,
+    )
+    # mac-keyed VM interface
+    pt.add_info(
+        epc_id=10, mac=0x0050_5600_0001, region_id=MY_REGION, host_id=2,
+        l3_device_id=12, l3_device_type=1, subnet_id=22, az_id=52,
+    )
+    # ip-keyed interface in another region (for the region filter)
+    pt.add_info(
+        epc_id=10, ips=["10.0.0.9"], region_id=MY_REGION + 1, host_id=3,
+        l3_device_id=13, l3_device_type=2, subnet_id=23, az_id=53,
+    )
+    # ipv6-keyed
+    pt.add_info(
+        epc_id=12, ips=["fd00::42"], region_id=MY_REGION, host_id=4,
+        l3_device_id=14, l3_device_type=1, subnet_id=24, az_id=54,
+    )
+    # pod reachable only via gprocess fill
+    pt.add_info(
+        epc_id=10, pod_id=202, region_id=MY_REGION, host_id=5,
+        l3_device_id=15, l3_device_type=1, subnet_id=25, pod_node_id=35,
+        az_id=55, pod_group_id=65, pod_group_type=102, pod_cluster_id=75,
+    )
+    pt.add_gprocess(gpid=9001, agent_id=1, pod_id=202)
+    pt.add_gprocess(gpid=9002, agent_id=77, pod_id=202)  # wrong agent → no fill
+    pt.add_pod_service(501, pod_group_id=61, protocol=6, server_port=80)
+    pt.add_pod_service(502, pod_group_id=65)  # wildcard any-port
+    pt.add_pod_service(503, pod_node_id=31)
+    pt.add_custom_service(601, epc_id=10, ip="10.0.0.50", server_port=443)
+    pt.add_custom_service(602, epc_id=10, ip="10.0.0.50")  # any port
+    return pt
+
+
+def make_row(**cols) -> np.ndarray:
+    row = np.zeros(_T.num_fields, dtype=np.uint32)
+    for k, v in cols.items():
+        row[_T.index(k)] = np.uint32(v & 0xFFFFFFFF)
+    return row
+
+
+def set_ip(cols: dict, side: int, ip):
+    is_v6, words = _ip_words(ip)
+    if is_v6:
+        cols["is_ipv6"] = 1
+    for w in range(4):
+        cols[f"ip{side}_w{w}"] = words[w]
+
+
+# ------------------------------------------------------------------ oracle
+def oracle_side(pt: PlatformInfoTable, row, side, is_edge, is_otel):
+    g = lambda name: int(row[_T.index(name)])
+    sfx = "" if side == 0 else "1"
+    epc = g("l3_epc_id" + sfx) & 0xFFFF
+    gpid = g("gpid0") if side == 0 else g("gpid1")
+    mac = (g(f"mac{side}_hi") << 32) | g(f"mac{side}_lo")
+    is_v6 = g("is_ipv6")
+    words = tuple(g(f"ip{side}_w{w}") for w in range(4))
+    pod = g("pod_id") if side == 0 else 0
+    agent = g("agent_id")
+    server_port = g("server_port")
+    protocol = g("protocol")
+
+    out = {f: 0 for f in INFO_FIELDS}
+    out.update(service_id=0, auto_instance_id=0, auto_instance_type=0,
+               auto_service_id=0, auto_service_type=0, tag_source=0)
+    in_play = (side == 0 or is_edge) and epc != EPC_INTERNET
+    info = None
+    ts = 0
+    if in_play:
+        if gpid and not pod and gpid in pt._gproc:
+            a, p = pt._gproc[gpid]
+            if p and a == agent:
+                pod = p
+                ts |= TS_GPID
+        if pod:
+            ts |= TS_POD_ID
+            info = pt._pod.get(pod)
+        if info is None:
+            if mac:
+                ts |= TS_MAC
+                info = pt._mac.get((epc, mac))
+            if info is None:
+                ts |= TS_EPC_IP
+                info = pt._epcip.get((is_v6, epc, words))
+    have = info is not None
+    if have:
+        rec = pt._infos[info - 1]
+        out.update(rec)
+        if pod:
+            out["pod_id"] = pod
+
+        # pod service (our keyed model: group/node × exact/wildcard)
+        is_pod_svc_ip = (
+            out["l3_device_type"] == DEVICE_TYPE_POD_SERVICE
+            or out["pod_id"]
+            or out["pod_node_id"]
+        )
+        if side == 0:
+            use_port = server_port > 0 and not is_edge
+            pk, prk = (server_port, protocol) if use_port else (0, 0)
+            gate = is_pod_svc_ip and (
+                use_port
+                or out["l3_device_type"] == DEVICE_TYPE_POD_SERVICE
+                or out["pod_id"]
+            )
+        else:
+            pk, prk = server_port, protocol
+            gate = is_pod_svc_ip
+        if gate:
+            for kind, ident in ((0, out["pod_group_id"]), (1, out["pod_node_id"])):
+                if not ident:
+                    continue
+                hit = pt._podsvc.get((kind, ident, prk, pk))
+                if hit is None:
+                    hit = pt._podsvc.get((kind, ident, 0, 0))
+                if hit is not None:
+                    out["service_id"] = hit
+                    break
+
+    # custom service
+    cs = 0
+    if epc != EPC_INTERNET:
+        cs_port = server_port if (side == 1 or not is_edge) else 0
+        cs = pt._customsvc.get((is_v6, epc, words, cs_port)) or pt._customsvc.get(
+            (is_v6, epc, words, 0)
+        ) or 0
+
+    # auto instance / service chains (common.go:160-193)
+    def chain(pairs, fallback_type):
+        for pid, ptype in pairs:
+            if pid > 0:
+                return pid, ptype
+        if epc == EPC_INTERNET:
+            return 0, TYPE_INTERNET_IP
+        return out["subnet_id"], fallback_type
+
+    out["auto_instance_id"], out["auto_instance_type"] = chain(
+        [
+            (out["pod_id"], TYPE_POD),
+            (gpid, TYPE_PROCESS),
+            (out["pod_node_id"], TYPE_POD_NODE),
+            (out["l3_device_id"], out["l3_device_type"]),
+        ],
+        TYPE_IP,
+    )
+    out["auto_service_id"], out["auto_service_type"] = chain(
+        [
+            (cs, TYPE_CUSTOM_SERVICE),
+            (out["service_id"], TYPE_POD_SERVICE),
+            (out["pod_group_id"], out["pod_group_type"]),
+            (gpid, TYPE_PROCESS),
+            (out["pod_cluster_id"], TYPE_POD_CLUSTER),
+            (out["l3_device_id"], out["l3_device_type"]),
+        ],
+        TYPE_IP,
+    )
+    if is_otel:
+        for f in ("auto_service_type", "auto_instance_type"):
+            if out[f] == TYPE_INTERNET_IP:
+                out[f] = TYPE_IP
+    out["tag_source"] = ts
+    return out, have
+
+
+def is_mc(is_v6, words):
+    return (words[0] >> 24) == 0xFF if is_v6 else (words[3] >> 28) == 0xE
+
+
+def oracle(pt: PlatformInfoTable, row):
+    g = lambda name: int(row[_T.index(name)])
+    code = g("code_id")
+    is_edge = CodeId.EDGE_IP_PORT <= code <= CodeId.EDGE_MAC_IP_PORT_APP
+    is_otel = g("signal_source") == SignalSource.OTEL
+    s0, have0 = oracle_side(pt, row, 0, is_edge, is_otel)
+    s1, have1 = oracle_side(pt, row, 1, is_edge, is_otel)
+
+    is_v6 = g("is_ipv6")
+    w0 = tuple(g(f"ip0_w{w}") for w in range(4))
+    w1 = tuple(g(f"ip1_w{w}") for w in range(4))
+    if is_edge and not have0 and have1 and is_mc(is_v6, w0):
+        for f in ("region_id", "subnet_id", "az_id"):
+            s0[f] = s1[f]
+        s0["tag_source"] |= TS_PEER
+    if is_edge and not have1 and have0 and is_mc(is_v6, w1):
+        for f in ("region_id", "subnet_id", "az_id"):
+            s1[f] = s0[f]
+        s1["tag_source"] |= TS_PEER
+
+    tap_side = g("tap_side")
+    keep = True
+    if MY_REGION:
+        if not is_edge and s0["region_id"] not in (0, MY_REGION):
+            keep = False
+        if is_edge and tap_side == 1 and s0["region_id"] not in (0, MY_REGION):
+            keep = False
+        if is_edge and tap_side == 2 and s1["region_id"] not in (0, MY_REGION):
+            keep = False
+    return s0, s1, keep
+
+
+# ------------------------------------------------------------------- cases
+def doc_rows():
+    rows = []
+
+    def add(**cols):
+        rows.append(make_row(**cols))
+
+    # pod-keyed hit, single-side server doc with port-matched pod service
+    c = dict(code_id=CodeId.SINGLE_IP_PORT, l3_epc_id=10, pod_id=101,
+             server_port=80, protocol=6, agent_id=1, tap_side=2, direction=2)
+    set_ip(c, 0, "10.0.0.1")
+    add(**c)
+    # same but any-port path (port 0)
+    c = dict(code_id=CodeId.SINGLE_IP_PORT, l3_epc_id=10, pod_id=101,
+             server_port=0, protocol=6, agent_id=1, tap_side=1, direction=1)
+    set_ip(c, 0, "10.0.0.1")
+    add(**c)
+    # mac-keyed hit
+    c = dict(code_id=CodeId.SINGLE_MAC_IP_PORT, l3_epc_id=10,
+             mac0_hi=0x0050, mac0_lo=0x56000001, agent_id=1, tap_side=1, direction=1)
+    set_ip(c, 0, "10.9.9.9")  # ip would miss; mac wins
+    add(**c)
+    # mac set but unknown → falls through to ip hit
+    c = dict(code_id=CodeId.SINGLE_MAC_IP_PORT, l3_epc_id=10,
+             mac0_hi=0xBEEF, mac0_lo=0x1, agent_id=1, tap_side=1, direction=1)
+    set_ip(c, 0, "10.0.0.1")
+    add(**c)
+    # gprocess fill (agent match) → pod 202 wildcard service
+    c = dict(code_id=CodeId.SINGLE_IP_PORT, l3_epc_id=10, gpid0=9001,
+             agent_id=1, tap_side=1, direction=1)
+    set_ip(c, 0, "10.250.0.1")
+    add(**c)
+    # gprocess wrong agent → no fill, ip miss → subnet/ip fallback
+    c = dict(code_id=CodeId.SINGLE_IP_PORT, l3_epc_id=10, gpid0=9002,
+             agent_id=1, tap_side=1, direction=1)
+    set_ip(c, 0, "10.250.0.2")
+    add(**c)
+    # internet epc: no lookups, auto types internet
+    c = dict(code_id=CodeId.SINGLE_IP_PORT, l3_epc_id=EPC_INTERNET,
+             agent_id=1, tap_side=1, direction=1)
+    set_ip(c, 0, "8.8.8.8")
+    add(**c)
+    # OTel internet → plain IP type
+    c = dict(code_id=CodeId.SINGLE_IP_PORT_APP, l3_epc_id=EPC_INTERNET,
+             agent_id=1, tap_side=1, direction=1, signal_source=SignalSource.OTEL)
+    set_ip(c, 0, "8.8.4.4")
+    add(**c)
+    # edge doc: both sides resolve; custom service on side1 port hit
+    c = dict(code_id=CodeId.EDGE_IP_PORT, l3_epc_id=10, l3_epc_id1=10,
+             server_port=443, protocol=6, agent_id=1, tap_side=1, direction=1)
+    set_ip(c, 0, "10.0.0.1")
+    set_ip(c, 1, "10.0.0.50")
+    add(**c)
+    # edge doc: side0 multicast, side1 known → peer fill
+    c = dict(code_id=CodeId.EDGE_IP_PORT, l3_epc_id=10, l3_epc_id1=10,
+             agent_id=1, tap_side=2, direction=2)
+    set_ip(c, 0, "239.1.1.1")
+    set_ip(c, 1, "10.0.0.1")
+    add(**c)
+    # region filter: single doc in other region → dropped
+    c = dict(code_id=CodeId.SINGLE_IP_PORT, l3_epc_id=10, agent_id=1,
+             tap_side=1, direction=1)
+    set_ip(c, 0, "10.0.0.9")
+    add(**c)
+    # region filter: edge server-side doc, side1 other region → dropped
+    c = dict(code_id=CodeId.EDGE_IP_PORT, l3_epc_id=10, l3_epc_id1=10,
+             agent_id=1, tap_side=2, direction=2)
+    set_ip(c, 0, "10.0.0.1")
+    set_ip(c, 1, "10.0.0.9")
+    add(**c)
+    # same edge mismatch but client-side observation → kept
+    c = dict(code_id=CodeId.EDGE_IP_PORT, l3_epc_id=10, l3_epc_id1=10,
+             agent_id=1, tap_side=1, direction=1)
+    set_ip(c, 0, "10.0.0.1")
+    set_ip(c, 1, "10.0.0.9")
+    add(**c)
+    # ipv6 endpoint hit
+    c = dict(code_id=CodeId.SINGLE_IP_PORT, l3_epc_id=12, agent_id=1,
+             tap_side=1, direction=1)
+    set_ip(c, 0, "fd00::42")
+    add(**c)
+    # node-keyed pod service on side0 any-port (pod 101 → node 31)
+    c = dict(code_id=CodeId.EDGE_IP_PORT, l3_epc_id=10, l3_epc_id1=10,
+             server_port=9999, protocol=17, agent_id=1, tap_side=1, direction=1)
+    set_ip(c, 0, "10.9.9.8")
+    set_ip(c, 1, "10.0.0.1")
+    add(**c)
+    return np.stack(rows)
+
+
+@pytest.fixture(scope="module")
+def platform():
+    return make_platform()
+
+
+def test_enrich_matches_oracle(platform):
+    state = platform.build()
+    rows = doc_rows()
+    valid = np.ones(rows.shape[0], dtype=bool)
+    s0, s1, keep, drops = enrich_docs(state, rows, valid)
+    s0 = {k: np.asarray(v) for k, v in s0.items()}
+    s1 = {k: np.asarray(v) for k, v in s1.items()}
+    keep = np.asarray(keep)
+
+    n_drop = 0
+    for i in range(rows.shape[0]):
+        o0, o1, okeep = oracle(platform, rows[i])
+        for f, want in o0.items():
+            assert int(s0[f][i]) == want, f"row {i} side0 {f}: {int(s0[f][i])} != {want}"
+        for f, want in o1.items():
+            assert int(s1[f][i]) == want, f"row {i} side1 {f}: {int(s1[f][i])} != {want}"
+        assert bool(keep[i]) == okeep, f"row {i} keep: {bool(keep[i])} != {okeep}"
+        n_drop += not okeep
+    assert int(drops) == n_drop
+    assert n_drop >= 2  # the two region-filter cases above
+
+
+def test_enrich_invalid_rows_stay_dropped(platform):
+    state = platform.build()
+    rows = doc_rows()
+    valid = np.zeros(rows.shape[0], dtype=bool)
+    _, _, keep, drops = enrich_docs(state, rows, valid)
+    assert not np.any(np.asarray(keep))
+    assert int(drops) == 0

@@ -104,3 +104,72 @@ def test_window_gap_advance_is_bounded():
     assert dt < 10.0, f"gap advance took {dt:.1f}s — unbounded flush loop?"
     assert [f.size > 0 for f in out] == [True]  # window 1000 flushed once
     assert pipe.wm.start_window == 1000 + 86_400 - 2
+
+
+def test_decoder_survives_hostile_documents():
+    """Malformed wire data must count decode_errors, not raise
+    (codec.py decode contract; found in review: varint-typed minitag
+    raised TypeError, 64-bit timestamps raised OverflowError)."""
+    import numpy as np
+
+    from deepflow_tpu.datamodel.code import CodeId, MeterId
+    from deepflow_tpu.datamodel.schema import FLOW_METER, TAG_SCHEMA
+    from deepflow_tpu.ingest.codec import DocumentDecoder, encode_document
+
+    tags = np.zeros(TAG_SCHEMA.num_fields, dtype=np.uint32)
+    tags[TAG_SCHEMA.index("meter_id")] = int(MeterId.FLOW)
+    tags[TAG_SCHEMA.index("code_id")] = int(CodeId.SINGLE_IP_PORT)
+    meters = np.zeros(FLOW_METER.num_fields, dtype=np.float32)
+    good = encode_document(1_700_000_000, tags, meters)
+    huge_ts = encode_document(2**33 + 7, tags, meters)
+
+    dec = DocumentDecoder()
+    out = dec.decode([good, b"\x10\x05", huge_ts])  # field 2 as varint
+    assert dec.decode_errors == 1
+    batch = out[int(MeterId.FLOW)]
+    # 64-bit timestamp masked to u32 (native twin behavior), not an error
+    assert batch.timestamp.tolist() == [1_700_000_000, (2**33 + 7) & 0xFFFFFFFF]
+
+
+def test_encode_frame_rejects_oversize():
+    """encode_frame caps at MAX_FRAME_SIZE so a legal sender can never
+    produce a frame the reassembler would reject into byte-resync."""
+    import pytest
+
+    from deepflow_tpu.ingest.framing import FlowHeader, MAX_FRAME_SIZE, encode_frame
+
+    with pytest.raises(ValueError):
+        encode_frame(FlowHeader(msg_type=1), [b"x" * MAX_FRAME_SIZE])
+
+
+def test_native_string_ids_follow_message_order():
+    """Mixed FLOW/APP batches must intern strings in message order in both
+    decoders (review finding: native iterated meter-group order)."""
+    import numpy as np
+    import pytest
+
+    from deepflow_tpu import native
+    from deepflow_tpu.datamodel.code import CodeId, MeterId
+    from deepflow_tpu.datamodel.schema import APP_METER, FLOW_METER, TAG_SCHEMA
+    from deepflow_tpu.ingest.codec import DocumentDecoder, encode_document
+
+    if not native.native_available():
+        pytest.skip(f"native build failed: {native.build_error()}")
+
+    def doc(meter_id, code_id, schema, strings):
+        tags = np.zeros(TAG_SCHEMA.num_fields, dtype=np.uint32)
+        tags[TAG_SCHEMA.index("meter_id")] = int(meter_id)
+        tags[TAG_SCHEMA.index("code_id")] = int(code_id)
+        return encode_document(
+            5, tags, np.zeros(schema.num_fields, np.float32), strings=strings
+        )
+
+    msgs = [
+        doc(MeterId.APP, CodeId.SINGLE_IP_PORT_APP, APP_METER, {"app_service": "a"}),
+        doc(MeterId.FLOW, CodeId.SINGLE_IP_PORT, FLOW_METER, {"app_service": "b"}),
+    ]
+    py = DocumentDecoder().decode(msgs)
+    nat = native.NativeDocumentDecoder().decode(msgs)
+    for mid in py:
+        assert py[mid].strings.values == nat[mid].strings.values
+        np.testing.assert_array_equal(py[mid].service_ids, nat[mid].service_ids)
