@@ -164,7 +164,10 @@ class PlatformInfoTable:
         if unknown:
             raise KeyError(f"unknown info fields: {unknown}")
         idx = len(self._infos) + 1  # row 0 is the zero info
-        self._infos.append({f: int(fields.get(f, 0)) for f in INFO_FIELDS})
+        rec = {f: int(fields.get(f, 0)) for f in INFO_FIELDS}
+        if pod_id:
+            rec["pod_id"] = int(pod_id)  # keys double as Info.PodID
+        self._infos.append(rec)
         epc = _fold_epc(epc_id)
         if pod_id:
             self._pod[pod_id] = idx
@@ -323,9 +326,10 @@ def _enrich_side(state: PlatformState, tags, side: int, is_edge, is_otel):
     info = jnp.where(have[:, None], state.infos[idx], 0)
 
     out = {f: info[:, _I[f]] for f in INFO_FIELDS}
-    # the matched pod wins over the info's pod column when info came from
-    # the gpid/pod path (reference keeps t.PodID as matched)
-    out["pod_id"] = jnp.where(pod_hit, pod, out["pod_id"])
+    # matched info overwrites PodID (handle_document.go:192 t.PodID =
+    # info.PodID); with no info the original/gpid-filled pod survives for
+    # the auto_instance chain (GetAutoInstance takes t.PodID)
+    out["pod_id"] = jnp.where(have, out["pod_id"], pod)
 
     # -- pod service (IsPodServiceIP gate, handle_document.go:151,194-202)
     dev_type = out["l3_device_type"]

@@ -403,6 +403,7 @@ class DocumentDecoder:
 
         tag_vec = np.zeros(_T.num_fields, dtype=np.uint32)
         sids = np.zeros(3, dtype=np.uint32)
+        raw_strs: dict[int, str] = {}
 
         def set_tag(name, v):
             tag_vec[_T.index(name)] = v & 0xFFFFFFFF
@@ -455,8 +456,11 @@ class DocumentDecoder:
             elif field == 22:
                 set_tag("signal_source", v)
             elif field in (23, 24, 25):
+                # defer interning until the row fully decodes — a row that
+                # errors later must not pollute the shared StringDict (the
+                # native decoder skips error rows entirely)
                 s = v.decode(errors="replace")
-                sids[field - 23] = strings.intern(s)
+                raw_strs[field - 23] = s
                 if field == 25:
                     set_tag("endpoint_hash", _hash_str(s))
             elif field == 27:
@@ -499,4 +503,6 @@ class DocumentDecoder:
                     if name:
                         meter_vec[schema.index(name)] = v
 
+        for j, s in raw_strs.items():
+            sids[j] = strings.intern(s)
         return meter_id, ts, tag_vec, meter_vec, flags, sids

@@ -1,0 +1,74 @@
+"""Bounded overwrite queues for the ingest plane.
+
+Mirrors the reference's `OverwriteQueue` (libs/queue/queue.go:43-260):
+fixed capacity, *overwrites oldest on overflow* (backpressure sheds the
+oldest data, never blocks the producer), blocking batched `Gets` with
+timeout on the consumer side.
+
+Two interchangeable implementations: the C++ ring in native/src/queue.cc
+(used when the shared object builds) and a Python fallback with identical
+semantics. `new_queue` picks automatically.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+from .. import native
+
+
+class PyOverwriteQueue:
+    """Python twin of native.OverwriteQueue (same API/semantics)."""
+
+    def __init__(self, capacity: int):
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self.capacity = capacity
+        self._dq: collections.deque = collections.deque()
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._overwritten = 0
+        self._closed = False
+
+    def put(self, item: bytes) -> None:
+        with self._cond:
+            if self._closed:
+                return
+            if len(self._dq) >= self.capacity:
+                self._dq.popleft()
+                self._overwritten += 1
+            self._dq.append(bytes(item))
+            self._cond.notify()
+
+    def gets(self, max_items: int, timeout_ms: int = -1) -> list[bytes]:
+        """Block until ≥1 item (or timeout/close); pop up to max_items."""
+        timeout = None if timeout_ms < 0 else timeout_ms / 1000.0
+        with self._cond:
+            if not self._dq and not self._closed:
+                self._cond.wait(timeout)
+            out = []
+            while self._dq and len(out) < max_items:
+                out.append(self._dq.popleft())
+            return out
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    @property
+    def overwritten(self) -> int:
+        with self._lock:
+            return self._overwritten
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._dq)
+
+
+def new_queue(capacity: int, prefer_native: bool = True):
+    """OverwriteQueue factory: native C++ ring when built, else Python."""
+    if prefer_native and native.native_available():
+        return native.OverwriteQueue(capacity)
+    return PyOverwriteQueue(capacity)

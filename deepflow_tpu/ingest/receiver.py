@@ -1,0 +1,207 @@
+"""Telemetry receiver — the server's front door (TCP+UDP :20033 analog).
+
+Re-creates `server/libs/receiver/receiver.go` semantics the TPU-host way:
+one TCP listener + one UDP socket, a per-message-type handler registry
+(`register_handler`, receiver.go:444), org/team/agent identity parsed from
+the 19-byte flow header (:631-700), per-agent liveness/status tracking,
+and hash fanout into the handler's N overwrite queues (:515-585) keyed by
+agent id so one agent's stream stays ordered within a queue.
+
+Queue items are the *raw frame* (header + body): self-contained bytes so
+the native C++ ring can carry them and any worker can re-parse identity
+without shared state.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+from .framing import HEADER_LEN, FlowHeader, FrameReassembler, MessageType
+
+
+class AgentStatus:
+    __slots__ = ("agent_id", "org_id", "team_id", "addr", "first_seen", "last_seen", "frames", "bytes")
+
+    def __init__(self, agent_id, org_id, team_id, addr):
+        self.agent_id = agent_id
+        self.org_id = org_id
+        self.team_id = team_id
+        self.addr = addr
+        self.first_seen = self.last_seen = time.time()
+        self.frames = 0
+        self.bytes = 0
+
+
+class Receiver:
+    """Framed TCP/UDP intake with per-msg-type queue fanout."""
+
+    def __init__(self, host: str = "127.0.0.1", tcp_port: int = 0, udp_port: int = 0):
+        self.host = host
+        self.tcp_port = tcp_port
+        self.udp_port = udp_port
+        self._handlers: dict[int, list] = {}
+        self._threads: list[threading.Thread] = []
+        self._conn_threads: list[threading.Thread] = []
+        self._stats_lock = threading.Lock()
+        self._tcp_sock: socket.socket | None = None
+        self._udp_sock: socket.socket | None = None
+        self._conns: set[socket.socket] = set()
+        self._lock = threading.Lock()
+        self._running = False
+        self.agents: dict[tuple[int, int], AgentStatus] = {}  # (org, agent) → status
+        self.counters = {
+            "rx_frames": 0,
+            "rx_bytes": 0,
+            "bad_frames": 0,
+            "no_handler": 0,
+            "udp_frames": 0,
+            "tcp_conns": 0,
+        }
+
+    # -- registry (receiver.go:444 RegistHandler) -----------------------
+    def register_handler(self, msg_type: MessageType, queues: list) -> None:
+        if not queues:
+            raise ValueError("need at least one queue")
+        self._handlers[int(msg_type)] = list(queues)
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> None:
+        self._running = True
+        self._tcp_sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._tcp_sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._tcp_sock.bind((self.host, self.tcp_port))
+        self.tcp_port = self._tcp_sock.getsockname()[1]
+        self._tcp_sock.listen(64)
+        # timeouts on every blocking op: on Linux, close() does NOT wake a
+        # thread blocked in accept()/recv(), which would keep the listening
+        # socket alive (and the port EADDRINUSE) after stop()
+        self._tcp_sock.settimeout(0.5)
+
+        self._udp_sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._udp_sock.bind((self.host, self.udp_port))
+        self.udp_port = self._udp_sock.getsockname()[1]
+        self._udp_sock.settimeout(0.5)
+
+        for target in (self._accept_loop, self._udp_loop):
+            t = threading.Thread(target=target, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._running = False
+        for s in (self._tcp_sock, self._udp_sock):
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+        with self._lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        with self._lock:
+            threads = self._threads + self._conn_threads
+        for t in threads:
+            t.join(timeout=2)
+
+    # -- dispatch -------------------------------------------------------
+    def _count(self, key: str, n: int = 1) -> None:
+        # dict += is a non-atomic read-modify-write; conn threads + the UDP
+        # thread all dispatch concurrently
+        with self._stats_lock:
+            self.counters[key] += n
+
+    def _dispatch(self, header: FlowHeader, raw_frame: bytes, addr) -> None:
+        key = (header.organization_id, header.agent_id)
+        with self._stats_lock:
+            self.counters["rx_frames"] += 1
+            self.counters["rx_bytes"] += len(raw_frame)
+            st = self.agents.get(key)
+            if st is None:
+                st = self.agents[key] = AgentStatus(
+                    header.agent_id, header.organization_id, header.team_id, addr
+                )
+            st.last_seen = time.time()
+            st.frames += 1
+            st.bytes += len(raw_frame)
+
+        queues = self._handlers.get(header.msg_type)
+        if not queues:
+            self._count("no_handler")
+            return
+        queues[header.agent_id % len(queues)].put(raw_frame)
+
+    # -- TCP ------------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                conn, addr = self._tcp_sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            conn.settimeout(0.5)
+            self._count("tcp_conns")
+            with self._lock:
+                self._conns.add(conn)
+                # prune finished handler threads so a long-lived receiver
+                # doesn't grow the list unboundedly
+                self._conn_threads = [t for t in self._conn_threads if t.is_alive()]
+            t = threading.Thread(target=self._conn_loop, args=(conn, addr), daemon=True)
+            t.start()
+            with self._lock:
+                self._conn_threads.append(t)
+
+    def _conn_loop(self, conn: socket.socket, addr) -> None:
+        asm = FrameReassembler()
+        seen_bad = 0
+        try:
+            while self._running:
+                try:
+                    chunk = conn.recv(1 << 16)
+                except socket.timeout:
+                    continue
+                if not chunk:
+                    return
+                for header, body in asm.feed(chunk):
+                    self._dispatch(header, header.encode() + body, addr)
+                if asm.bad_frames != seen_bad:
+                    self._count("bad_frames", asm.bad_frames - seen_bad)
+                    seen_bad = asm.bad_frames
+        except OSError:
+            return
+        finally:
+            with self._lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # -- UDP (one frame per datagram, receiver.go UDP path) -------------
+    def _udp_loop(self) -> None:
+        while self._running:
+            try:
+                data, addr = self._udp_sock.recvfrom(1 << 16)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            self._count("udp_frames")
+            if len(data) < HEADER_LEN:
+                self._count("bad_frames")
+                continue
+            try:
+                header = FlowHeader.parse(data[:HEADER_LEN])
+            except ValueError:
+                self._count("bad_frames")
+                continue
+            if header.frame_size != len(data):
+                self._count("bad_frames")
+                continue
+            self._dispatch(header, data, addr)

@@ -1,0 +1,159 @@
+"""UniformSender — the agent-side telemetry transport client.
+
+Re-creates `agent/src/sender/uniform_sender.rs` behavior on the host:
+batches encoded pb messages into framed TCP writes (header layout in
+framing.py), flushes on size or interval, reconnects with exponential
+backoff, and fails over across a server list (uniform_sender.rs:398-560).
+Messages that cannot be shipped are shed oldest-first by the bounded
+overwrite queue — same backpressure stance as the rest of the pipeline.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+from .framing import MAX_FRAME_SIZE, FlowHeader, MessageType, encode_frame
+from .queues import new_queue
+
+
+class UniformSender:
+    def __init__(
+        self,
+        servers: list[tuple[str, int]],
+        msg_type: MessageType,
+        *,
+        agent_id: int = 1,
+        team_id: int = 0,
+        organization_id: int = 0,
+        batch_bytes: int = 1 << 17,
+        flush_interval: float = 0.2,
+        queue_capacity: int = 1 << 14,
+        prefer_native_queue: bool = True,
+    ):
+        if not servers:
+            raise ValueError("need at least one server")
+        self.servers = list(servers)
+        self.msg_type = MessageType(msg_type)
+        self.agent_id = agent_id
+        self.team_id = team_id
+        self.organization_id = organization_id
+        self.batch_bytes = min(batch_bytes, MAX_FRAME_SIZE // 2)
+        self.flush_interval = flush_interval
+        self._q = new_queue(queue_capacity, prefer_native=prefer_native_queue)
+        self._sock: socket.socket | None = None
+        self._server_idx = 0
+        self._running = True
+        self.counters = {"tx_frames": 0, "tx_bytes": 0, "tx_msgs": 0, "reconnects": 0, "send_errors": 0}
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    # -- producer side --------------------------------------------------
+    def send(self, msgs: list[bytes]) -> None:
+        for m in msgs:
+            self._q.put(m)
+
+    @property
+    def dropped(self) -> int:
+        return self._q.overwritten
+
+    def close(self, drain_timeout: float = 5.0) -> None:
+        deadline = time.time() + drain_timeout
+        while len(self._q) and time.time() < deadline:
+            time.sleep(0.02)
+        self._running = False
+        self._q.close()
+        self._thread.join(timeout=drain_timeout)
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    # -- sender thread ---------------------------------------------------
+    def _connect(self) -> bool:
+        """Try each server once, starting from the current; True on success."""
+        for i in range(len(self.servers)):
+            host, port = self.servers[(self._server_idx + i) % len(self.servers)]
+            try:
+                s = socket.create_connection((host, port), timeout=5)
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._sock = s
+                self._server_idx = (self._server_idx + i) % len(self.servers)
+                return True
+            except OSError:
+                continue
+        return False
+
+    def _frame(self, msgs: list[bytes]) -> bytes:
+        header = FlowHeader(
+            msg_type=int(self.msg_type),
+            agent_id=self.agent_id,
+            team_id=self.team_id,
+            organization_id=self.organization_id,
+        )
+        # encode_frame enforces MAX_FRAME_SIZE — a frame that encodes is
+        # always accepted by the receiver's reassembler
+        return encode_frame(header, msgs)
+
+    def _run(self) -> None:
+        backoff = 0.05
+        pending: list[bytes] = []
+        pending_bytes = 0
+        last_flush = time.monotonic()
+        while self._running or pending or len(self._q):
+            if not pending:
+                got = self._q.gets(256, timeout_ms=50)
+                if not got and not self._running:
+                    return
+                for m in got:
+                    pending.append(m)
+                    pending_bytes += len(m) + 4
+            elif pending_bytes < self.batch_bytes and self._running:
+                # accumulate until flush deadline — wait on the queue for
+                # the remaining window instead of spinning
+                remaining = self.flush_interval - (time.monotonic() - last_flush)
+                if remaining > 0:
+                    for m in self._q.gets(256, timeout_ms=max(1, int(remaining * 1000))):
+                        pending.append(m)
+                        pending_bytes += len(m) + 4
+            now = time.monotonic()
+            if pending and (pending_bytes >= self.batch_bytes or now - last_flush >= self.flush_interval or not self._running):
+                if self._sock is None and not self._connect():
+                    self.counters["send_errors"] += 1
+                    time.sleep(backoff)
+                    backoff = min(backoff * 2, 2.0)
+                    continue
+                try:
+                    # chunk so no frame exceeds batch_bytes (≤ MAX_FRAME_SIZE/2)
+                    while pending:
+                        chunk, sz = [], 0
+                        while pending and (not chunk or sz + len(pending[0]) + 4 <= self.batch_bytes):
+                            m = pending.pop(0)
+                            chunk.append(m)
+                            sz += len(m) + 4
+                        try:
+                            frame = self._frame(chunk)
+                        except ValueError:
+                            # a single message too large for any frame — drop
+                            self.counters["send_errors"] += 1
+                            continue
+                        self._sock.sendall(frame)
+                        self.counters["tx_frames"] += 1
+                        self.counters["tx_bytes"] += len(frame)
+                        self.counters["tx_msgs"] += len(chunk)
+                    pending_bytes = 0
+                    last_flush = now
+                    backoff = 0.05
+                except OSError:
+                    self.counters["send_errors"] += 1
+                    self.counters["reconnects"] += 1
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = None
+                    self._server_idx = (self._server_idx + 1) % len(self.servers)
+                    time.sleep(backoff)
+                    backoff = min(backoff * 2, 2.0)

@@ -287,12 +287,11 @@ class NativeDocumentDecoder:
         out: dict[int, DecodedBatch] = {}
         ok = status == 0
         # intern string slices in *message order* — ids must match the
-        # Python decoder exactly even when meter types interleave
-        # (rare for L4; hot only on L7/app paths)
+        # Python decoder exactly even when meter types interleave. Only
+        # rows that actually carry strings pay the Python loop (L4 batches
+        # carry none and skip it entirely).
         sid_all = np.zeros((n, 3), dtype=np.uint32)
-        for i in range(n):
-            if not ok[i]:
-                continue
+        for i in np.nonzero(ok & str_lens.any(axis=1))[0]:
             for j in range(3):
                 ln = int(str_lens[i, j])
                 if ln:

@@ -1,0 +1,162 @@
+"""flow_metrics ingester — receiver to storage, the server hot path.
+
+The TPU re-composition of `server/ingester/flow_metrics/flow_metrics.go:50`
++ `unmarshaller/unmarshaller.go:220`: the receiver fans METRICS frames
+into N overwrite queues; each unmarshaller worker drains its queue in
+batches, decodes pb Documents columnar (native C++ decoder when built,
+Python twin otherwise), runs the whole batch through the device
+enrichment kernel (enrich/platform.py — the DocumentExpand analog), and
+hands enriched column batches to the writer.
+
+Like the reference, no re-aggregation happens here — agents pre-aggregate
+and docs are written as-is (flow_metrics.go design); server-side rollups
+are the downsampler's job. `disable_second_write` mirrors
+unmarshaller.go:246.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+import numpy as np
+
+from ..datamodel.code import DocumentFlag
+from ..datamodel.schema import TAG_SCHEMA
+from ..enrich.platform import PlatformState, enrich_docs
+from ..ingest.codec import DecodedBatch, DocumentDecoder
+from ..ingest.framing import HEADER_LEN, FlowHeader, MessageType, split_messages
+from ..ingest.queues import new_queue
+from ..ingest.receiver import Receiver
+from .. import native
+
+
+@dataclasses.dataclass
+class EnrichedBatch:
+    """What the writer receives: decoded docs + device enrichment."""
+
+    header: FlowHeader
+    decoded: DecodedBatch
+    side0: dict[str, np.ndarray] | None
+    side1: dict[str, np.ndarray] | None
+    keep: np.ndarray  # [N] bool (False = other-region drop)
+
+
+class FlowMetricsIngester:
+    """METRICS pipeline: queues → decode → enrich → writer.put(batch)."""
+
+    def __init__(
+        self,
+        receiver: Receiver,
+        writer,
+        *,
+        platform_state: PlatformState | None = None,
+        n_workers: int = 1,
+        queue_capacity: int = 1 << 14,
+        batch_size: int = 256,
+        disable_second_write: bool = False,
+        prefer_native: bool = True,
+    ):
+        self.writer = writer
+        self.platform_state = platform_state
+        self.batch_size = batch_size
+        self.disable_second_write = disable_second_write
+        self._use_native = prefer_native and native.native_available()
+        self.queues = [new_queue(queue_capacity, prefer_native=prefer_native) for _ in range(n_workers)]
+        receiver.register_handler(MessageType.METRICS, self.queues)
+        self.counters = {
+            "frames_in": 0,
+            "docs_in": 0,
+            "docs_written": 0,
+            "decode_errors": 0,
+            "drop_other_region": 0,
+            "drop_second_write": 0,
+        }
+        self._lock = threading.Lock()
+        self._running = True
+        self._threads = [
+            threading.Thread(target=self._worker, args=(q,), daemon=True) for q in self.queues
+        ]
+        for t in self._threads:
+            t.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._running = False
+        for q in self.queues:
+            q.close()
+        for t in self._threads:
+            t.join(timeout=timeout)
+
+    # -- worker ---------------------------------------------------------
+    def _worker(self, q) -> None:
+        decoder = native.NativeDocumentDecoder() if self._use_native else DocumentDecoder()
+        while self._running:
+            frames = q.gets(self.batch_size, timeout_ms=100)
+            if not frames:
+                continue
+            for raw in frames:
+                self._process_frame(decoder, raw)
+
+    def _process_frame(self, decoder, raw: bytes) -> None:
+        header = FlowHeader.parse(raw[:HEADER_LEN])
+        try:
+            msgs = split_messages(raw[HEADER_LEN:])
+        except ValueError:
+            with self._lock:
+                self.counters["decode_errors"] += 1
+            return
+        errors_before = decoder.decode_errors
+        batches = decoder.decode(msgs)
+        with self._lock:
+            self.counters["frames_in"] += 1
+            self.counters["docs_in"] += len(msgs)
+            self.counters["decode_errors"] += decoder.decode_errors - errors_before
+
+        for decoded in batches.values():
+            valid = np.ones(decoded.tags.shape[0], dtype=bool)
+            if self.disable_second_write:
+                # 1s-granularity docs carry PER_SECOND_METRICS
+                # (unmarshaller.go:246 disableSecondWrite)
+                second = (decoded.flags & int(DocumentFlag.PER_SECOND_METRICS)) != 0
+                with self._lock:
+                    self.counters["drop_second_write"] += int(second.sum())
+                valid &= ~second
+            if self.platform_state is not None:
+                # pad rows to a power of two so jit compiles O(log N)
+                # distinct shapes, not one per frame size
+                n = decoded.tags.shape[0]
+                p = 1
+                while p < n:
+                    p *= 2
+                tags_p = np.zeros((p, decoded.tags.shape[1]), dtype=np.uint32)
+                tags_p[:n] = decoded.tags
+                valid_p = np.zeros(p, dtype=bool)
+                valid_p[:n] = valid
+                s0, s1, keep, drops = enrich_docs(self.platform_state, tags_p, valid_p)
+                s0 = {k: np.asarray(v)[:n] for k, v in s0.items()}
+                s1 = {k: np.asarray(v)[:n] for k, v in s1.items()}
+                keep = np.asarray(keep)[:n]
+                with self._lock:
+                    self.counters["drop_other_region"] += int(drops)
+            else:
+                s0 = s1 = None
+                keep = valid
+            with self._lock:
+                self.counters["docs_written"] += int(keep.sum())
+            self.writer.put(EnrichedBatch(header=header, decoded=decoded, side0=s0, side1=s1, keep=keep))
+
+
+class ListWriter:
+    """Test/bring-up writer: collects EnrichedBatches in memory."""
+
+    def __init__(self):
+        self.batches: list[EnrichedBatch] = []
+        self._lock = threading.Lock()
+
+    def put(self, batch: EnrichedBatch) -> None:
+        with self._lock:
+            self.batches.append(batch)
+
+    def doc_count(self) -> int:
+        with self._lock:
+            return sum(int(b.keep.sum()) for b in self.batches)

@@ -160,10 +160,12 @@ def oracle_side(pt: PlatformInfoTable, row, side, is_edge, is_otel):
                 info = pt._epcip.get((is_v6, epc, words))
     have = info is not None
     if have:
-        rec = pt._infos[info - 1]
-        out.update(rec)
-        if pod:
-            out["pod_id"] = pod
+        # info overwrites PodID (handle_document.go:192); otherwise the
+        # original/gpid-filled pod survives
+        out.update(pt._infos[info - 1])
+    else:
+        out["pod_id"] = pod
+    if have:
 
         # pod service (our keyed model: group/node × exact/wildcard)
         is_pod_svc_ip = (
@@ -300,6 +302,17 @@ def doc_rows():
     c = dict(code_id=CodeId.SINGLE_MAC_IP_PORT, l3_epc_id=10,
              mac0_hi=0xBEEF, mac0_lo=0x1, agent_id=1, tap_side=1, direction=1)
     set_ip(c, 0, "10.0.0.1")
+    add(**c)
+    # pod set but missing from pod table (sync lag) → mac info wins and
+    # its PodID (0) overwrites the stale pod id
+    c = dict(code_id=CodeId.SINGLE_MAC_IP_PORT, l3_epc_id=10, pod_id=555,
+             mac0_hi=0x0050, mac0_lo=0x56000001, agent_id=1, tap_side=1, direction=1)
+    set_ip(c, 0, "10.9.9.7")
+    add(**c)
+    # pod set, no lookup hits at all → pod survives for auto_instance
+    c = dict(code_id=CodeId.SINGLE_IP_PORT, l3_epc_id=10, pod_id=556,
+             agent_id=1, tap_side=1, direction=1)
+    set_ip(c, 0, "10.251.0.9")
     add(**c)
     # gprocess fill (agent match) → pod 202 wildcard service
     c = dict(code_id=CodeId.SINGLE_IP_PORT, l3_epc_id=10, gpid0=9001,

@@ -1,0 +1,165 @@
+"""End-to-end ingest boundary: UniformSender → Receiver → unmarshaller
+workers → enrichment → writer, over real sockets.
+
+This is the process-boundary slice of SURVEY §3.2 (agent sender →
+TCP :20033 → receiver → decode queues → DocumentExpand → writer), with
+both transports (TCP framed stream, UDP one-frame-per-datagram) and the
+decode/enrich conformance assertion that what lands in the writer is
+exactly what the pipeline emitted.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from deepflow_tpu.aggregator.pipeline import L4Pipeline, PipelineConfig
+from deepflow_tpu.datamodel.batch import FlowBatch
+from deepflow_tpu.datamodel.schema import TAG_SCHEMA
+from deepflow_tpu.enrich.platform import PlatformInfoTable
+from deepflow_tpu.ingest.codec import encode_docbatch
+from deepflow_tpu.ingest.framing import FlowHeader, MessageType, encode_frame
+from deepflow_tpu.ingest.receiver import Receiver
+from deepflow_tpu.ingest.replay import SyntheticFlowGen
+from deepflow_tpu.ingest.sender import UniformSender
+from deepflow_tpu.server.flow_metrics import FlowMetricsIngester, ListWriter
+
+_T = TAG_SCHEMA
+
+
+def _make_docs():
+    pipe = L4Pipeline(PipelineConfig(batch_size=512))
+    gen = SyntheticFlowGen(num_tuples=40, seed=9)
+    docs = pipe.ingest(FlowBatch.from_records(gen.records(300, 1_700_000_000)))
+    docs += pipe.drain()
+    msgs = []
+    for db in docs:
+        msgs += encode_docbatch(db)
+    total = sum(db.tags.shape[0] for db in docs)
+    return msgs, total, docs
+
+
+def _wait_for(cond, timeout=15.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+@pytest.fixture()
+def stack():
+    recv = Receiver()
+    recv.start()
+    writer = ListWriter()
+    pt = PlatformInfoTable(my_region_id=0)
+    pt.add_info(epc_id=1, ips=["10.1.2.3"], region_id=1, subnet_id=7, az_id=3)
+    ing = FlowMetricsIngester(
+        recv, writer, platform_state=pt.build(), n_workers=2, prefer_native=False
+    )
+    yield recv, writer, ing
+    ing.stop()
+    recv.stop()
+
+
+def test_tcp_roundtrip_preserves_documents(stack):
+    recv, writer, ing = stack
+    msgs, total, _ = _make_docs()
+    sender = UniformSender(
+        [("127.0.0.1", recv.tcp_port)],
+        MessageType.METRICS,
+        agent_id=42,
+        organization_id=5,
+        prefer_native_queue=False,
+    )
+    sender.send(msgs)
+    # first wait spans jit compile of the enrichment kernel (~seconds)
+    assert _wait_for(lambda: writer.doc_count() >= total, timeout=60)
+    sender.close()
+
+    assert ing.counters["decode_errors"] == 0
+    assert writer.doc_count() == total
+    # identity from the flow header survives to the writer
+    hdr = writer.batches[0].header
+    assert (hdr.agent_id, hdr.organization_id) == (42, 5)
+    assert (5, 42) in recv.agents
+    assert recv.agents[(5, 42)].frames >= 1
+    # enrichment columns rode along
+    b = writer.batches[0]
+    assert "auto_service_type" in b.side0 and b.keep.all()
+
+    # round-trip: every sent (fingerprintable) doc row lands exactly once
+    sent_keys = []
+    for db in _make_docs()[2]:
+        for row in db.tags:
+            sent_keys.append(row.tobytes())
+    got_keys = []
+    for eb in writer.batches:
+        for row in eb.decoded.tags:
+            got_keys.append(row.tobytes())
+    assert sorted(sent_keys) == sorted(got_keys)
+
+
+def test_udp_datagram_path(stack):
+    recv, writer, ing = stack
+    msgs, total, _ = _make_docs()
+    frame = encode_frame(FlowHeader(msg_type=MessageType.METRICS, agent_id=7), msgs[:10])
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.sendto(frame, ("127.0.0.1", recv.udp_port))
+    s.close()
+    assert _wait_for(lambda: writer.doc_count() >= 10, timeout=60)
+    assert recv.counters["udp_frames"] >= 1
+    assert writer.doc_count() == 10
+
+
+def test_garbage_resync_and_no_handler(stack):
+    recv, writer, ing = stack
+    msgs, _, _ = _make_docs()
+    good = encode_frame(FlowHeader(msg_type=MessageType.METRICS, agent_id=1), msgs[:5])
+    unhandled = encode_frame(FlowHeader(msg_type=MessageType.PROFILE, agent_id=1), [b"x"])
+    with socket.create_connection(("127.0.0.1", recv.tcp_port)) as c:
+        c.sendall(b"\x00garbage junk\xff" + good + unhandled)
+    assert _wait_for(lambda: writer.doc_count() >= 5, timeout=60)
+    assert recv.counters["bad_frames"] > 0
+    assert _wait_for(lambda: recv.counters["no_handler"] >= 1)
+    assert writer.doc_count() == 5
+
+
+def test_sender_reconnects_after_server_restart():
+    msgs, total, _ = _make_docs()
+    recv1 = Receiver()
+    recv1.start()
+    port = recv1.tcp_port
+    writer1 = ListWriter()
+    ing1 = FlowMetricsIngester(recv1, writer1, n_workers=1, prefer_native=False)
+    sender = UniformSender(
+        [("127.0.0.1", port)], MessageType.METRICS, flush_interval=0.05, prefer_native_queue=False
+    )
+    sender.send(msgs[:20])
+    assert _wait_for(lambda: ing1.counters["docs_in"] >= 20)
+    ing1.stop()
+    recv1.stop()
+
+    # restart on the same port; sender must recover
+    recv2 = Receiver(tcp_port=port)
+    for _ in range(50):
+        try:
+            recv2.start()
+            break
+        except OSError:
+            time.sleep(0.1)
+    writer2 = ListWriter()
+    ing2 = FlowMetricsIngester(recv2, writer2, n_workers=1, prefer_native=False)
+    deadline = time.time() + 15
+    while time.time() < deadline and ing2.counters["docs_in"] < 20:
+        sender.send(msgs[20:40])
+        time.sleep(0.3)
+    assert ing2.counters["docs_in"] >= 20
+    assert sender.counters["reconnects"] >= 1 or sender.counters["send_errors"] >= 1
+    sender.close()
+    ing2.stop()
+    recv2.stop()

@@ -173,3 +173,54 @@ def test_native_string_ids_follow_message_order():
     for mid in py:
         assert py[mid].strings.values == nat[mid].strings.values
         np.testing.assert_array_equal(py[mid].service_ids, nat[mid].service_ids)
+
+
+def test_string_dict_excludes_error_rows():
+    """Rows that fail decode must not pollute the shared StringDict, and
+    both decoders must agree on ids around the dead row (review finding)."""
+    import numpy as np
+    import pytest
+
+    from deepflow_tpu import native
+    from deepflow_tpu.datamodel.code import CodeId, MeterId
+    from deepflow_tpu.datamodel.schema import APP_METER, TAG_SCHEMA
+    from deepflow_tpu.ingest.codec import DocumentDecoder, encode_document
+
+    def doc(svc):
+        tags = np.zeros(TAG_SCHEMA.num_fields, dtype=np.uint32)
+        tags[TAG_SCHEMA.index("meter_id")] = int(MeterId.APP)
+        tags[TAG_SCHEMA.index("code_id")] = int(CodeId.SINGLE_IP_PORT_APP)
+        return encode_document(
+            5, tags, np.zeros(APP_METER.num_fields, np.float32), strings={"app_service": svc}
+        )
+
+    # corrupt the meter submessage of a valid doc: meter_id APP(5) → 9
+    # (field 3 is the meter; its first varint field is the meter_id)
+    from deepflow_tpu.ingest.codec import _iter_fields, _put_tag_bytes, _put_tag_varint
+
+    bad = bytearray()
+    for field, v in _iter_fields(doc("dead")):
+        if field == 3:
+            meter = bytearray()
+            _put_tag_varint(meter, 1, 9)  # unknown meter_id
+            _put_tag_bytes(bad, 3, bytes(meter))
+        elif isinstance(v, (bytes, bytearray)):
+            _put_tag_bytes(bad, field, bytes(v))
+        else:
+            _put_tag_varint(bad, field, v)
+    bad = bytes(bad)
+    good = doc("live")
+    dec = DocumentDecoder()
+    out = dec.decode([bad, good])
+    assert dec.decode_errors == 1
+    strings = out[int(MeterId.APP)].strings
+    assert strings.values == ["live"]
+    assert out[int(MeterId.APP)].service_ids[0, 0] == 1
+
+    if native.native_available():
+        nat = native.NativeDocumentDecoder()
+        nout = nat.decode([bad, good])
+        assert nout[int(MeterId.APP)].strings.values == ["live"]
+        np.testing.assert_array_equal(
+            nout[int(MeterId.APP)].service_ids, out[int(MeterId.APP)].service_ids
+        )
