@@ -147,6 +147,12 @@ class UniformSender:
                     last_flush = now
                     backoff = 0.05
                 except OSError:
+                    # requeue the in-flight chunk: the overwrite queue is
+                    # the only place messages may be shed (at-least-once
+                    # across reconnects, like the reference's resend of
+                    # its current buffer)
+                    pending = chunk + pending
+                    pending_bytes = sum(len(m) + 4 for m in pending)
                     self.counters["send_errors"] += 1
                     self.counters["reconnects"] += 1
                     try:

@@ -1,0 +1,145 @@
+"""Batched table writer — the ckwriter analog.
+
+`CKWriter.Put` queues rows, a per-queue goroutine batches them and flushes
+on size or timeout, with retry and connection reset on failure
+(server/ingester/pkg/ckwriter/ckwriter.go:481-636). `TableWriter` keeps
+that contract against the columnar store: `put(cols)` enqueues a column
+batch; the flusher thread coalesces batches and inserts one part per
+flush, retrying on transient store errors; counters surface
+write-ok/fail/retry like ckwriter's Countable (ckwriter.go:465-479).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+import numpy as np
+
+from ..utils.stats import register_countable
+from .store import ColumnarStore, TableSchema
+
+
+class TableWriter:
+    def __init__(
+        self,
+        store: ColumnarStore,
+        db: str,
+        schema: TableSchema,
+        *,
+        batch_size: int = 1 << 15,
+        flush_interval_s: float = 1.0,
+        queue_capacity: int = 256,
+        retries: int = 3,
+    ):
+        store.create_table(db, schema)
+        self.store = store
+        self.db = db
+        self.schema = schema
+        self.batch_size = batch_size
+        self.flush_interval_s = flush_interval_s
+        self.retries = retries
+        self._q: queue.Queue = queue.Queue(maxsize=queue_capacity)
+        self.counters = {
+            "write_ok": 0,
+            "write_fail": 0,
+            "retry": 0,
+            "dropped_full": 0,
+            "pending_rows": 0,
+        }
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        # register the writer itself (weakly held → auto-deregistered);
+        # stop() also deregisters explicitly for deterministic teardown
+        self._stats_src = register_countable(
+            "table_writer", self, db=db, table=schema.name
+        )
+
+    def get_counters(self):
+        with self._lock:
+            return dict(self.counters)
+
+    # -- producer side --------------------------------------------------
+    def put(self, cols: dict[str, np.ndarray]) -> bool:
+        """Enqueue a column batch; sheds (and counts) when the queue is
+        full — matching the reference's drop-not-block backpressure."""
+        n = len(next(iter(cols.values()))) if cols else 0
+        if n == 0:
+            return True
+        try:
+            self._q.put_nowait(cols)
+            with self._lock:
+                self.counters["pending_rows"] += n
+            return True
+        except queue.Full:
+            with self._lock:
+                self.counters["dropped_full"] += n
+            return False
+
+    # -- flusher --------------------------------------------------------
+    def _run(self):
+        pending: list[dict[str, np.ndarray]] = []
+        pending_rows = 0
+        deadline = time.monotonic() + self.flush_interval_s
+        while True:
+            timeout = max(0.0, deadline - time.monotonic())
+            try:
+                item = self._q.get(timeout=timeout)
+                pending.append(item)
+                pending_rows += len(next(iter(item.values())))
+            except queue.Empty:
+                pass
+            now = time.monotonic()
+            if pending and (pending_rows >= self.batch_size or now >= deadline):
+                self._flush(pending, pending_rows)
+                pending, pending_rows = [], 0
+            if now >= deadline:
+                deadline = now + self.flush_interval_s
+            if self._stop.is_set() and self._q.empty():
+                if pending:
+                    self._flush(pending, pending_rows)
+                return
+
+    def _flush(self, batches: list[dict[str, np.ndarray]], rows: int):
+        names = self.schema.column_names()
+        try:
+            merged = {
+                nm: np.concatenate([np.asarray(b[nm]) for b in batches]) for nm in names
+            }
+            for attempt in range(self.retries):
+                try:
+                    self.store.insert(self.db, self.schema.name, merged)
+                    with self._lock:
+                        self.counters["write_ok"] += rows
+                        self.counters["pending_rows"] -= rows
+                    return
+                except OSError:
+                    with self._lock:
+                        self.counters["retry"] += 1
+                    time.sleep(0.05 * (attempt + 1))
+        except Exception:
+            # malformed batch (missing/ragged columns) — count it as a
+            # failed write; the flusher thread must survive any input
+            pass
+        with self._lock:
+            self.counters["write_fail"] += rows
+            self.counters["pending_rows"] -= rows
+
+    def flush(self, timeout: float = 5.0) -> None:
+        """Drain everything queued so far (tests / shutdown)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self.counters["pending_rows"] == 0 and self._q.empty():
+                    return
+            time.sleep(0.01)
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        self._thread.join(timeout=timeout)
+        from ..utils.stats import default_collector
+
+        default_collector.deregister(self._stats_src)

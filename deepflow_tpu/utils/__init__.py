@@ -1,0 +1,1 @@
+"""Cross-cutting utilities: self-telemetry counters and config."""

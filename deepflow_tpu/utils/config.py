@@ -1,0 +1,135 @@
+"""Server configuration — one YAML file → typed per-module configs.
+
+The reference's server reads a single `/etc/server.yaml` into per-module
+`config.Config` structs with yaml tags + validation
+(server/ingester/config/config.go); the agent adds a dynamic layer pushed
+over gRPC. Here every module config is a frozen dataclass with defaults;
+`load_config` overlays a YAML mapping (unknown keys are collected and
+reported, not silently dropped) and validates ranges.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Any
+
+import yaml
+
+
+class ConfigError(ValueError):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class ReceiverConfig:
+    host: str = "127.0.0.1"
+    tcp_port: int = 20033
+    udp_port: int = 20033
+
+
+@dataclasses.dataclass(frozen=True)
+class IngesterConfig:
+    n_decoders: int = 2
+    queue_capacity: int = 1 << 16
+    batch_size: int = 256
+    disable_second_write: bool = False
+    prefer_native: bool = True
+    # flow_log per-second throttle (ingester.flow_log throttler; 0 = off)
+    l4_throttle: int = 50000
+    l7_throttle: int = 50000
+
+
+@dataclasses.dataclass(frozen=True)
+class StorageConfig:
+    root: str = ""  # "" = in-memory store
+    partition_s: int = 3600
+    ttl_hours: int = 168
+    writer_batch_size: int = 1 << 15
+    writer_flush_s: float = 1.0
+
+
+@dataclasses.dataclass(frozen=True)
+class AggregatorConfig:
+    stash_capacity: int = 1 << 16
+    batch_size: int = 4096
+    window_delay_s: int = 2  # quadruple_generator delay_seconds analog
+    second_enabled: bool = True
+    minute_enabled: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class SketchConfig:
+    num_services: int = 1 << 10
+    hll_precision: int = 14
+    cms_depth: int = 4
+    cms_width: int = 1 << 16
+    hist_bins: int = 128
+    hist_vmin: float = 1.0
+    hist_gamma: float = 1.08
+
+
+@dataclasses.dataclass(frozen=True)
+class ServerConfig:
+    receiver: ReceiverConfig = ReceiverConfig()
+    ingester: IngesterConfig = IngesterConfig()
+    storage: StorageConfig = StorageConfig()
+    aggregator: AggregatorConfig = AggregatorConfig()
+    sketch: SketchConfig = SketchConfig()
+    region_id: int = 0
+    log_level: str = "info"
+
+
+def _overlay(cls, defaults, data: dict[str, Any], path: str, unknown: list[str]):
+    kwargs = {}
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    for key, value in data.items():
+        if key not in fields:
+            unknown.append(f"{path}{key}")
+            continue
+        cur = getattr(defaults, key)
+        if dataclasses.is_dataclass(cur):
+            if not isinstance(value, dict):
+                raise ConfigError(f"{path}{key}: expected mapping")
+            kwargs[key] = _overlay(type(cur), cur, value, f"{path}{key}.", unknown)
+        else:
+            if value is not None and cur is not None and not isinstance(
+                value, (type(cur), int) if isinstance(cur, float) else type(cur)
+            ):
+                raise ConfigError(
+                    f"{path}{key}: expected {type(cur).__name__}, got {type(value).__name__}"
+                )
+            kwargs[key] = type(cur)(value) if cur is not None else value
+    return dataclasses.replace(defaults, **kwargs)
+
+
+def _validate(cfg: ServerConfig) -> None:
+    checks = [
+        (cfg.ingester.n_decoders >= 1, "ingester.n_decoders must be >= 1"),
+        (cfg.storage.partition_s >= 1, "storage.partition_s must be >= 1"),
+        (cfg.aggregator.stash_capacity > 0, "aggregator.stash_capacity must be > 0"),
+        (1 <= cfg.sketch.hll_precision <= 18, "sketch.hll_precision out of range [1,18]"),
+        (cfg.sketch.hist_gamma > 1.0, "sketch.hist_gamma must be > 1"),
+        (0 <= cfg.receiver.tcp_port <= 65535, "receiver.tcp_port out of range"),
+    ]
+    for ok, msg in checks:
+        if not ok:
+            raise ConfigError(msg)
+
+
+def load_config(source: str | Path | dict | None = None) -> tuple[ServerConfig, list[str]]:
+    """Build a ServerConfig from a YAML file path, mapping, or None
+    (pure defaults). Returns (config, unknown_keys)."""
+    if source is None:
+        data: dict = {}
+    elif isinstance(source, dict):
+        data = source
+    else:
+        text = Path(source).read_text()
+        data = yaml.safe_load(text) or {}
+        if not isinstance(data, dict):
+            raise ConfigError("top-level config must be a mapping")
+    unknown: list[str] = []
+    cfg = _overlay(ServerConfig, ServerConfig(), data, "", unknown)
+    _validate(cfg)
+    return cfg, unknown

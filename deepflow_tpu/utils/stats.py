@@ -1,0 +1,164 @@
+"""Self-telemetry counter registry — the universal Countable pattern.
+
+Every component in the reference registers a `RefCountable`/`Countable`
+with a stats collector that periodically snapshots counters and ships them
+as `deepflow_stats` points into its own ext_metrics pipeline
+(server/libs/stats/stats.go:89-202; agent/src/utils/stats.rs). This module
+is the framework-wide twin: components expose `get_counters()` dicts; the
+collector holds *weak* references (a dropped component unregisters itself,
+the RefCountable semantics), ticks on an interval, and hands batched
+`StatsPoint`s to pluggable sinks — in-memory ring for the debug tap, and
+the ext_metrics ingester once it exists.
+
+Counter naming follows the reference convention: a point per (module,
+tags) with an integer/float field map.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Callable, Mapping, Protocol, runtime_checkable
+
+
+@runtime_checkable
+class Countable(Protocol):
+    def get_counters(self) -> Mapping[str, int | float]: ...
+
+
+@dataclasses.dataclass(frozen=True)
+class StatsPoint:
+    timestamp: float
+    module: str
+    tags: tuple[tuple[str, str], ...]
+    fields: dict[str, int | float]
+
+
+class CounterSource:
+    """One registered countable: weakly held, tagged."""
+
+    __slots__ = ("module", "tags", "_ref", "_fn")
+
+    def __init__(self, module: str, tags: dict[str, str], countable):
+        self.module = module
+        self.tags = tuple(sorted(tags.items()))
+        if callable(countable) and not isinstance(countable, Countable):
+            # plain closures can't be weakly bound to a component lifetime;
+            # hold them strongly (caller owns deregistration)
+            self._ref = None
+            self._fn = countable
+        else:
+            self._ref = weakref.ref(countable)
+            self._fn = None
+
+    def sample(self) -> Mapping[str, int | float] | None:
+        if self._fn is not None:
+            return self._fn()
+        obj = self._ref()
+        if obj is None:
+            return None
+        return obj.get_counters()
+
+
+class StatsCollector:
+    """Periodic counter snapshotter with pluggable sinks.
+
+    `register(module, countable, **tags)` — countable is either an object
+    with `get_counters()` (weakly referenced; auto-deregistered when the
+    component is garbage collected) or a zero-arg callable returning the
+    counter map (strongly held; `deregister` to remove).
+    """
+
+    def __init__(self, interval_s: float = 10.0, ring_size: int = 4096):
+        self.interval_s = interval_s
+        self._sources: list[CounterSource] = []
+        self._sinks: list[Callable[[list[StatsPoint]], None]] = []
+        self._ring: deque[StatsPoint] = deque(maxlen=ring_size)
+        self._lock = threading.Lock()
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    # -- registry -------------------------------------------------------
+    def register(self, module: str, countable, **tags: str) -> CounterSource:
+        src = CounterSource(module, tags, countable)
+        with self._lock:
+            self._sources.append(src)
+        return src
+
+    def deregister(self, src: CounterSource) -> None:
+        with self._lock:
+            if src in self._sources:
+                self._sources.remove(src)
+
+    def add_sink(self, sink: Callable[[list[StatsPoint]], None]) -> None:
+        with self._lock:
+            self._sinks.append(sink)
+
+    # -- ticking --------------------------------------------------------
+    def tick(self, now: float | None = None) -> list[StatsPoint]:
+        """Snapshot every live source once (also called by the thread).
+
+        Samples run outside the lock (a callback may register/deregister)
+        and are exception-guarded — one broken component must not kill
+        self-telemetry for the rest.
+        """
+        now = time.time() if now is None else now
+        points: list[StatsPoint] = []
+        with self._lock:
+            sources = list(self._sources)
+        dead: list[CounterSource] = []
+        for src in sources:
+            try:
+                fields = src.sample()
+            except Exception:
+                continue
+            if fields is None:  # component died → auto-deregister
+                dead.append(src)
+                continue
+            if fields:
+                points.append(StatsPoint(now, src.module, src.tags, dict(fields)))
+        with self._lock:
+            if dead:
+                self._sources = [s for s in self._sources if s not in dead]
+            sinks = list(self._sinks)
+            self._ring.extend(points)
+        for sink in sinks:
+            sink(points)
+        return points
+
+    def recent(self, module: str | None = None) -> list[StatsPoint]:
+        with self._lock:
+            pts = list(self._ring)
+        if module is not None:
+            pts = [p for p in pts if p.module == module]
+        return pts
+
+    # -- thread ---------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=self.interval_s + 1)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.tick()
+
+
+# Default process-wide collector, mirroring the reference's package-level
+# RegisterCountable entry points (stats.go:89).
+default_collector = StatsCollector()
+
+
+def register_countable(module: str, countable, **tags: str) -> CounterSource:
+    return default_collector.register(module, countable, **tags)

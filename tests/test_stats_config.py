@@ -1,0 +1,74 @@
+"""utils/stats Countable registry + utils/config loader tests."""
+
+from __future__ import annotations
+
+import gc
+
+import pytest
+
+from deepflow_tpu.utils.config import ConfigError, ServerConfig, load_config
+from deepflow_tpu.utils.stats import StatsCollector
+
+
+class _Comp:
+    def __init__(self):
+        self.n = 0
+
+    def get_counters(self):
+        self.n += 1
+        return {"ticks": self.n}
+
+
+def test_stats_weak_deregistration_and_sinks():
+    col = StatsCollector(interval_s=999)
+    comp = _Comp()
+    col.register("unmarshaller", comp, queue="0")
+    seen = []
+    col.add_sink(seen.extend)
+
+    pts = col.tick(now=123.0)
+    assert len(pts) == 1
+    p = pts[0]
+    assert p.module == "unmarshaller" and p.tags == (("queue", "0"),)
+    assert p.fields == {"ticks": 1} and seen == pts
+
+    # dropping the component auto-deregisters it (RefCountable semantics)
+    del comp
+    gc.collect()
+    assert col.tick(now=124.0) == []
+    assert col.recent("unmarshaller")[0].timestamp == 123.0
+
+
+def test_stats_callable_source():
+    col = StatsCollector(interval_s=999)
+    src = col.register("writer", lambda: {"rows": 7})
+    assert col.tick()[0].fields["rows"] == 7
+    col.deregister(src)
+    assert col.tick() == []
+
+
+def test_config_defaults_and_overlay(tmp_path):
+    cfg, unknown = load_config(None)
+    assert cfg == ServerConfig() and unknown == []
+
+    f = tmp_path / "server.yaml"
+    f.write_text(
+        "ingester:\n  n_decoders: 8\n  mystery: 1\nstorage:\n  ttl_hours: 24\n"
+        "sketch:\n  hll_precision: 12\n"
+    )
+    cfg, unknown = load_config(f)
+    assert cfg.ingester.n_decoders == 8
+    assert cfg.storage.ttl_hours == 24
+    assert cfg.sketch.hll_precision == 12
+    assert unknown == ["ingester.mystery"]
+    # untouched modules keep defaults
+    assert cfg.receiver.tcp_port == 20033
+
+
+def test_config_validation():
+    with pytest.raises(ConfigError):
+        load_config({"sketch": {"hll_precision": 25}})
+    with pytest.raises(ConfigError):
+        load_config({"ingester": {"n_decoders": 0}})
+    with pytest.raises(ConfigError):
+        load_config({"ingester": {"n_decoders": "four"}})
