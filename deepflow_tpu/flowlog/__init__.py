@@ -1,0 +1,4 @@
+"""Flow-log plane: L4 flow logs (minute-merged TaggedFlows) and L7
+request logs, with throttled sampling — the TPU rebuild of
+agent/src/collector/flow_aggr.rs + server/ingester/flow_log.
+"""

@@ -1,0 +1,406 @@
+"""L4 flow-log minute aggregation + throttled sampling.
+
+The reference's FlowAggr thread merges the per-second `TaggedFlow`
+emissions of each flow into one minute-level log row keyed by flow_id
+(`minute_merge`, agent/src/collector/flow_aggr.rs:216 — long-lived flows
+emit every second via inject_flush_ticker; the minute merge folds them so
+l4_flow_log carries one row per flow per minute), then samples the output
+through a per-second reservoir `ThrottlingQueue` (flow_aggr.rs:500,
+send_with_throttling :558).
+
+TPU shape: the merge is the same sort→segment-reduce pattern as the
+metrics stash, extended with the flow-log merge classes (FIRST/LAST/
+MIN/MAX/OR int lanes — see schema.py). Arrival order is the sort
+tiebreak, so FIRST/LAST reproduce the reference's sequential-merge
+"last arrival wins" lifecycle semantics exactly: stash rows concatenate
+before batch rows and `lax.sort` is stable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..ops.hashing import fingerprint64
+from ..ops.segment import SENTINEL_SLOT
+from ..utils.stats import register_countable
+from .schema import L4_FLOW_LOG, LogOp, LogSchema
+
+_OR_BITS = 16  # OR lanes are bitmasks ≤16 bits (TCP flags)
+
+
+@dataclasses.dataclass
+class FlowLogBatch:
+    """SoA flow-log rows: device int/num lanes + host string columns."""
+
+    schema: LogSchema
+    ints: np.ndarray  # [N, Ki] u32
+    nums: np.ndarray  # [N, Kn] f32
+    valid: np.ndarray  # [N] bool
+    strs: dict[str, list[str]] | None = None
+
+    @property
+    def size(self) -> int:
+        return self.ints.shape[0]
+
+    def col(self, name: str) -> np.ndarray:
+        s = self.schema
+        if name in s._int_idx:
+            return self.ints[:, s.int_index(name)]
+        return self.nums[:, s.num_index(name)]
+
+    @staticmethod
+    def from_rows(schema: LogSchema, rows: list[dict]) -> "FlowLogBatch":
+        n = len(rows)
+        ints = np.zeros((n, len(schema.ints)), np.uint32)
+        nums = np.zeros((n, len(schema.nums)), np.float32)
+        strs: dict[str, list[str]] = {f.name: [""] * n for f in schema.strs}
+        for r, row in enumerate(rows):
+            for k, v in row.items():
+                if k in schema._int_idx:
+                    ints[r, schema.int_index(k)] = v
+                elif k in schema._num_idx:
+                    nums[r, schema.num_index(k)] = v
+                elif k in strs:
+                    strs[k][r] = v
+        return FlowLogBatch(schema, ints, nums, np.ones(n, bool), strs or None)
+
+    def to_rows(self) -> list[dict]:
+        out = []
+        for r in range(self.size):
+            if not self.valid[r]:
+                continue
+            d = {f.name: int(self.ints[r, i]) for i, f in enumerate(self.schema.ints)}
+            d.update(
+                {f.name: float(self.nums[r, i]) for i, f in enumerate(self.schema.nums)}
+            )
+            if self.strs:
+                d.update({k: v[r] for k, v in self.strs.items()})
+            out.append(d)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# device kernel
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class LogStashState:
+    slot: jnp.ndarray  # [S] u32 minute index (SENTINEL = empty)
+    key_hi: jnp.ndarray  # [S] u32
+    key_lo: jnp.ndarray  # [S] u32
+    ints: jnp.ndarray  # [S, Ki] u32
+    nums: jnp.ndarray  # [S, Kn] f32
+    valid: jnp.ndarray  # [S] bool
+    dropped_overflow: jnp.ndarray  # scalar i32
+
+    @property
+    def capacity(self) -> int:
+        return self.slot.shape[0]
+
+
+def log_stash_init(capacity: int, schema: LogSchema) -> LogStashState:
+    return LogStashState(
+        slot=jnp.full((capacity,), SENTINEL_SLOT, dtype=jnp.uint32),
+        key_hi=jnp.zeros((capacity,), jnp.uint32),
+        key_lo=jnp.zeros((capacity,), jnp.uint32),
+        ints=jnp.zeros((capacity, len(schema.ints)), jnp.uint32),
+        nums=jnp.zeros((capacity, len(schema.nums)), jnp.float32),
+        valid=jnp.zeros((capacity,), bool),
+        dropped_overflow=jnp.zeros((), jnp.int32),
+    )
+
+
+def _seg_reduce_ints(ints_sorted, seg_id, first_pos, last_pos, n, col_groups):
+    """Apply per-class reductions to the sorted u32 int lanes."""
+    ki = ints_sorted.shape[1]
+    out = jnp.zeros((n, ki), jnp.uint32)
+    first_cols, last_cols, min_cols, max_cols, or_cols = col_groups
+    if first_cols.size:
+        out = out.at[:, first_cols].set(jnp.take(ints_sorted[:, first_cols], first_pos, axis=0))
+    if last_cols.size:
+        out = out.at[:, last_cols].set(jnp.take(ints_sorted[:, last_cols], last_pos, axis=0))
+    # MIN/MAX run on u32 directly; empty segments get the op identity
+    # (0xFFFFFFFF / 0) but are masked invalid downstream regardless.
+    if min_cols.size:
+        part = jax.ops.segment_min(ints_sorted[:, min_cols], seg_id, num_segments=n)
+        out = out.at[:, min_cols].set(part)
+    if max_cols.size:
+        part = jax.ops.segment_max(ints_sorted[:, max_cols], seg_id, num_segments=n)
+        out = out.at[:, max_cols].set(part)
+    if or_cols.size:
+        # OR = per-bit segment_max over _OR_BITS static lanes
+        vals = ints_sorted[:, or_cols]  # [N, O]
+        bits = (vals[:, :, None] >> jnp.arange(_OR_BITS, dtype=jnp.uint32)) & 1
+        red = jax.ops.segment_max(
+            bits.reshape(bits.shape[0], -1).astype(jnp.int32), seg_id, num_segments=n
+        )
+        red = jnp.maximum(red, 0).reshape(n, or_cols.size, _OR_BITS)
+        recombined = jnp.sum(
+            red.astype(jnp.uint32) << jnp.arange(_OR_BITS, dtype=jnp.uint32), axis=-1
+        )
+        out = out.at[:, or_cols].set(recombined)
+    return out
+
+
+def _log_merge_impl(state: LogStashState, slot, key_hi, key_lo, ints, nums, valid, schema: LogSchema):
+    s = state.capacity
+    all_slot = jnp.concatenate([state.slot, slot])
+    all_hi = jnp.concatenate([state.key_hi, key_hi])
+    all_lo = jnp.concatenate([state.key_lo, key_lo])
+    all_ints = jnp.concatenate([state.ints, ints], axis=0)
+    all_nums = jnp.concatenate([state.nums, nums], axis=0)
+    all_valid = jnp.concatenate([state.valid, valid])
+    n = all_slot.shape[0]
+
+    all_slot = jnp.where(all_valid, all_slot, jnp.uint32(SENTINEL_SLOT))
+    all_hi = jnp.where(all_valid, all_hi, jnp.uint32(0xFFFFFFFF))
+    all_lo = jnp.where(all_valid, all_lo, jnp.uint32(0xFFFFFFFF))
+
+    iota = jnp.arange(n, dtype=jnp.int32)
+    # stable sort → ties keep concat (arrival) order: stash before batch
+    s_slot, s_hi, s_lo, perm = lax.sort((all_slot, all_hi, all_lo, iota), num_keys=3)
+
+    first = jnp.concatenate(
+        [
+            jnp.ones((1,), bool),
+            (s_slot[1:] != s_slot[:-1]) | (s_hi[1:] != s_hi[:-1]) | (s_lo[1:] != s_lo[:-1]),
+        ]
+    )
+    seg_id = jnp.cumsum(first.astype(jnp.int32)) - 1
+
+    first_pos = jax.ops.segment_min(iota, seg_id, num_segments=n)
+    last_pos = jax.ops.segment_max(iota, seg_id, num_segments=n)
+    first_pos = jnp.clip(first_pos, 0, n - 1)
+    last_pos = jnp.clip(last_pos, 0, n - 1)
+
+    ints_sorted = jnp.take(all_ints, perm, axis=0)
+    nums_sorted = jnp.take(all_nums, perm, axis=0)
+
+    col_groups = tuple(
+        schema.int_cols_with(op)
+        for op in (LogOp.FIRST, LogOp.LAST, LogOp.MIN, LogOp.MAX, LogOp.OR)
+    )
+    ints_out = _seg_reduce_ints(ints_sorted, seg_id, first_pos, last_pos, n, col_groups)
+
+    kn = nums_sorted.shape[1]
+    nums_out = jnp.zeros((n, kn), jnp.float32)
+    sum_cols = schema.num_cols_with(LogOp.SUM)
+    nmax_cols = schema.num_cols_with(LogOp.MAX)
+    if sum_cols.size:
+        nums_out = nums_out.at[:, sum_cols].set(
+            jax.ops.segment_sum(nums_sorted[:, sum_cols], seg_id, num_segments=n)
+        )
+    if nmax_cols.size:
+        part = jax.ops.segment_max(nums_sorted[:, nmax_cols], seg_id, num_segments=n)
+        nums_out = nums_out.at[:, nmax_cols].set(jnp.where(jnp.isfinite(part), part, 0.0))
+
+    slot_out = jnp.take(s_slot, first_pos)
+    hi_out = jnp.take(s_hi, first_pos)
+    lo_out = jnp.take(s_lo, first_pos)
+    total = jnp.max(seg_id) + 1
+    seg_index = jnp.arange(n, dtype=jnp.int32)
+    seg_valid = (seg_index < total) & (slot_out != SENTINEL_SLOT)
+    slot_out = jnp.where(seg_valid, slot_out, jnp.uint32(SENTINEL_SLOT))
+
+    dropped = jnp.maximum(jnp.sum(seg_valid.astype(jnp.int32)) - s, 0)
+    return LogStashState(
+        slot=slot_out[:s],
+        key_hi=hi_out[:s],
+        key_lo=lo_out[:s],
+        ints=ints_out[:s],
+        nums=nums_out[:s],
+        valid=seg_valid[:s],
+        dropped_overflow=state.dropped_overflow + dropped,
+    )
+
+
+_log_merge = partial(jax.jit, static_argnames=("schema",), donate_argnums=(0,))(
+    _log_merge_impl
+)
+
+
+@jax.jit
+def _log_flush(state: LogStashState, slot_idx):
+    """Close one minute slot: compact its rows to the output prefix on
+    device so the host transfer is O(emitted rows), not O(capacity)."""
+    mask = state.valid & (state.slot == jnp.asarray(slot_idx, jnp.uint32))
+    order = jnp.argsort(jnp.where(mask, 0, 1), stable=True)
+    out = {
+        "count": jnp.sum(mask.astype(jnp.int32)),
+        "ints": jnp.take(state.ints, order, axis=0),
+        "nums": jnp.take(state.nums, order, axis=0),
+    }
+    new_state = dataclasses.replace(
+        state,
+        slot=jnp.where(mask, jnp.uint32(SENTINEL_SLOT), state.slot),
+        valid=state.valid & ~mask,
+    )
+    return new_state, out
+
+
+# ---------------------------------------------------------------------------
+# host drivers
+
+
+class MinuteAggr:
+    """FlowAggr analog: minute_merge of per-second flow emissions.
+
+    Windows: a flow row lands in minute slot end_time//60; slots flush
+    once `now` passes slot end + delay (flow_aggr thread ticks on its
+    input's 1s cadence, flushing the previous minute — flow_aggr.rs:216).
+    """
+
+    def __init__(
+        self,
+        schema: LogSchema = L4_FLOW_LOG,
+        *,
+        capacity: int = 1 << 16,
+        batch_size: int = 4096,
+        delay_s: int = 10,
+    ):
+        self.schema = schema
+        self.batch_size = batch_size
+        self.delay_s = delay_s
+        self.state = log_stash_init(capacity, schema)
+        self._time_col = schema.int_index("end_time")
+        self._max_time = 0
+        self.counters = {"rows_in": 0, "rows_out": 0, "drop_before_window": 0}
+        self._flushed_min = -1  # minutes ≤ this are closed
+        register_countable("flow_aggr", self, schema=schema.name)
+
+    def get_counters(self):
+        c = dict(self.counters)
+        c["dropped_overflow"] = int(np.asarray(self.state.dropped_overflow))
+        return c
+
+    def ingest(self, batch: FlowLogBatch) -> list[FlowLogBatch]:
+        assert batch.schema is self.schema
+        n = batch.size
+        if n > self.batch_size:
+            raise ValueError(f"batch {n} > batch_size {self.batch_size}")
+        pad = self.batch_size - n
+        ints = np.pad(batch.ints, ((0, pad), (0, 0)))
+        nums = np.pad(batch.nums, ((0, pad), (0, 0)))
+        valid = np.pad(batch.valid, (0, pad))
+
+        t = ints[:, self._time_col].astype(np.int64)
+        slot = (t // 60).astype(np.uint32)
+        # late rows for already-flushed minutes are dropped and counted
+        # (drop_before_window stance, collector.rs:386-391)
+        late = valid & (slot <= np.uint32(self._flushed_min)) if self._flushed_min >= 0 else np.zeros_like(valid)
+        self.counters["drop_before_window"] += int(late.sum())
+        valid = valid & ~late
+
+        key_mat = ints[:, self.schema.key_cols]
+        hi, lo = fingerprint64(key_mat, xp=np)
+        self.state = _log_merge(
+            self.state,
+            jnp.asarray(slot),
+            jnp.asarray(hi),
+            jnp.asarray(lo),
+            jnp.asarray(ints),
+            jnp.asarray(nums),
+            jnp.asarray(valid),
+            self.schema,
+        )
+        self.counters["rows_in"] += int(valid.sum())
+        self._max_time = max(self._max_time, int(t[valid].max()) if valid.any() else 0)
+        return self._flush_due()
+
+    def _flush_due(self) -> list[FlowLogBatch]:
+        due_until = (self._max_time - self.delay_s) // 60 - 1
+        if due_until <= self._flushed_min:
+            return []
+        # sync live slots once per closing minute — flush only minutes
+        # that actually hold rows (time jumps don't cause slot sweeps)
+        slot = np.asarray(self.state.slot)
+        live = np.unique(slot[np.asarray(self.state.valid)])
+        out = [self._flush_slot(int(m)) for m in live if int(m) <= due_until]
+        self._flushed_min = due_until
+        return [b for b in out if b.size]
+
+    def _flush_slot(self, minute: int) -> FlowLogBatch:
+        self.state, raw = _log_flush(self.state, np.uint32(minute))
+        n = int(raw["count"])
+        # slicing the device array first keeps the D2H copy at O(n)
+        ints = np.asarray(raw["ints"][:n])
+        nums = np.asarray(raw["nums"][:n])
+        self.counters["rows_out"] += n
+        return FlowLogBatch(self.schema, ints, nums, np.ones(n, bool))
+
+    def drain(self) -> list[FlowLogBatch]:
+        out = []
+        for m in sorted(
+            int(s) for s in np.unique(np.asarray(self.state.slot)[np.asarray(self.state.valid)])
+        ):
+            b = self._flush_slot(m)
+            if b.size:
+                out.append(b)
+            self._flushed_min = max(self._flushed_min, m)
+        return out
+
+
+class ThrottlingQueue:
+    """Per-second reservoir sampler (flow_aggr.rs:500 ThrottlingQueue;
+    server twin throttler/throttling_queue.go).
+
+    Keeps ≤ throttle rows per distinct second bucket; once a bucket
+    overflows, each further row replaces a random kept slot with
+    probability throttle/seen — classic reservoir, deterministic here via
+    a seeded generator.
+    """
+
+    def __init__(self, throttle: int = 1000, seed: int = 0, time_col: str = "end_time"):
+        self.throttle = throttle
+        self.time_col = time_col
+        self._rng = np.random.default_rng(seed)
+        self._buckets: dict[int, tuple[int, list]] = {}
+        self.counters = {"in": 0, "kept": 0, "dropped": 0}
+
+    def put(self, batch: FlowLogBatch) -> None:
+        ts = batch.col(self.time_col)
+        rows = np.nonzero(batch.valid)[0]
+        self.counters["in"] += len(rows)
+        for r in rows:
+            sec = int(ts[r])
+            seen, kept = self._buckets.get(sec, (0, []))
+            if seen < self.throttle:
+                kept.append((batch, int(r)))
+            else:
+                j = int(self._rng.integers(0, seen + 1))
+                if j < self.throttle:
+                    kept[j] = (batch, int(r))
+            self._buckets[sec] = (seen + 1, kept)
+
+    def drain(self, up_to_sec: int | None = None) -> list[FlowLogBatch]:
+        """Emit buckets with second < up_to_sec (None = all)."""
+        out = []
+        for sec in sorted(self._buckets):
+            if up_to_sec is not None and sec >= up_to_sec:
+                continue
+            seen, kept = self._buckets.pop(sec)
+            self.counters["kept"] += len(kept)
+            self.counters["dropped"] += seen - len(kept)
+            if kept:
+                out.append(_gather_rows(kept))
+        return out
+
+
+def _gather_rows(kept: list[tuple[FlowLogBatch, int]]) -> FlowLogBatch:
+    schema = kept[0][0].schema
+    ints = np.stack([b.ints[r] for b, r in kept])
+    nums = np.stack([b.nums[r] for b, r in kept])
+    strs = None
+    if any(b.strs for b, _ in kept):
+        strs = {
+            f.name: [(b.strs[f.name][r] if b.strs else "") for b, r in kept]
+            for f in schema.strs
+        }
+    return FlowLogBatch(schema, ints, nums, np.ones(len(kept), bool), strs)

@@ -88,12 +88,15 @@ def _overlay(cls, defaults, data: dict[str, Any], path: str, unknown: list[str])
             unknown.append(f"{path}{key}")
             continue
         cur = getattr(defaults, key)
+        if value is None:
+            # explicit YAML null (`key:` with no value) keeps the default
+            continue
         if dataclasses.is_dataclass(cur):
             if not isinstance(value, dict):
                 raise ConfigError(f"{path}{key}: expected mapping")
             kwargs[key] = _overlay(type(cur), cur, value, f"{path}{key}.", unknown)
         else:
-            if value is not None and cur is not None and not isinstance(
+            if cur is not None and not isinstance(
                 value, (type(cur), int) if isinstance(cur, float) else type(cur)
             ):
                 raise ConfigError(

@@ -1,0 +1,160 @@
+"""flow_log plane tests: minute-merge conformance vs. the dict oracle,
+throttling reservoir, wire codec round-trip, and the socket e2e into the
+flow_log storage tables."""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from deepflow_tpu.flowlog.aggr import FlowLogBatch, MinuteAggr, ThrottlingQueue
+from deepflow_tpu.flowlog.codec import decode_rows, encode_rows
+from deepflow_tpu.flowlog.oracle import batches_to_dict, minute_merge_oracle
+from deepflow_tpu.flowlog.schema import L4_FLOW_LOG, L7_FLOW_LOG
+from deepflow_tpu.flowlog.server import FlowLogIngester
+from deepflow_tpu.ingest.framing import MessageType
+from deepflow_tpu.ingest.receiver import Receiver
+from deepflow_tpu.ingest.replay import SyntheticL7LogGen, SyntheticTaggedFlowGen
+from deepflow_tpu.ingest.sender import UniformSender
+from deepflow_tpu.storage.store import ColumnarStore
+
+T0 = 1_700_000_000 - (1_700_000_000 % 60)  # minute-aligned epoch
+
+
+def _stream(num_flows=200, seconds=150, seed=1):
+    gen = SyntheticTaggedFlowGen(num_flows=num_flows, seed=seed)
+    return [gen.batches_for_second(T0, s) for s in range(seconds)]
+
+
+def test_minute_merge_matches_oracle():
+    batches = _stream()
+    aggr = MinuteAggr(capacity=1 << 12, batch_size=512, delay_s=5)
+    out = []
+    for b in batches:
+        out += aggr.ingest(b)
+    out += aggr.drain()
+
+    got = batches_to_dict(L4_FLOW_LOG, out)
+    want = minute_merge_oracle(L4_FLOW_LOG, batches)
+    assert set(got) == set(want)
+    ii = L4_FLOW_LOG.int_index
+    for key in want:
+        for name, w in want[key].items():
+            g = got[key][name]
+            assert g == pytest.approx(w, rel=1e-6), (key, name, g, w)
+    # sanity: some flows span minutes → more flows than merged rows/minute
+    minutes = {k[0] for k in got}
+    assert len(minutes) >= 2
+    # lifecycle: every closed flow's final state survived the merge (LAST)
+    closed = [v for v in got.values() if v["close_type"] == 1]
+    assert closed and all(v["state"] == 3 for v in closed)
+    # OR semantics: a closed flow accumulated SYN|ACK|FIN bits
+    assert any(v["tcp_flags_bit_0"] == 0x13 for v in closed)
+
+
+def test_minute_merge_late_row_dropped():
+    aggr = MinuteAggr(capacity=1 << 8, batch_size=64, delay_s=0)
+    gen = SyntheticTaggedFlowGen(num_flows=10, seed=2)
+    for s in range(0, 130):
+        aggr.ingest(gen.batches_for_second(T0, s))
+    # a row for minute 0 long after it flushed
+    late = gen.batches_for_second(T0, 5)
+    n_before = aggr.counters["drop_before_window"]
+    aggr.ingest(late)
+    assert aggr.counters["drop_before_window"] > n_before
+
+
+def test_throttling_reservoir_caps_per_second():
+    q = ThrottlingQueue(throttle=16, seed=0)
+    gen = SyntheticTaggedFlowGen(num_flows=500, seed=3)
+    b = gen.batches_for_second(T0, 40)  # hundreds active at sec 40
+    assert b.size > 16
+    q.put(b)
+    out = q.drain()
+    kept = sum(x.size for x in out)
+    assert kept == 16
+    assert q.counters["dropped"] == b.size - 16
+    # under the cap → everything passes
+    q2 = ThrottlingQueue(throttle=10_000)
+    q2.put(b)
+    assert sum(x.size for x in q2.drain()) == b.size
+
+
+def test_codec_roundtrip_l4_and_l7():
+    b = SyntheticTaggedFlowGen(num_flows=50, seed=4).batches_for_second(T0, 3)
+    msgs = encode_rows(b)
+    dec, errors = decode_rows(L4_FLOW_LOG, msgs)
+    assert errors == 0
+    np.testing.assert_array_equal(dec.ints, b.ints[b.valid])
+    np.testing.assert_array_equal(dec.nums, b.nums[b.valid])
+
+    l7 = SyntheticL7LogGen(num_services=8, seed=5).batch(64, T0)
+    msgs = encode_rows(l7)
+    dec, errors = decode_rows(L7_FLOW_LOG, msgs)
+    assert errors == 0
+    np.testing.assert_array_equal(dec.ints, l7.ints)
+    assert dec.strs["request_domain"] == l7.strs["request_domain"]
+    assert dec.strs["app_service"] == l7.strs["app_service"]
+
+
+def test_codec_corrupt_rows_counted():
+    b = SyntheticTaggedFlowGen(num_flows=20, seed=6).batches_for_second(T0, 31)
+    msgs = encode_rows(b)
+    msgs[0] = b"\xff\xff\xff"  # truncated varint
+    dec, errors = decode_rows(L4_FLOW_LOG, msgs)
+    assert errors == 1
+    assert int(dec.valid.sum()) == len(msgs) - 1
+
+
+def _wait_for(cond, timeout=20.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_flow_log_socket_e2e():
+    recv = Receiver()
+    recv.start()
+    store = ColumnarStore()
+    ing = FlowLogIngester(
+        recv, store, l4_throttle=10_000, l7_throttle=10_000,
+        writer_args={"flush_interval_s": 0.05},
+    )
+    try:
+        # agent side: minute merge → throttling → wire
+        aggr = MinuteAggr(capacity=1 << 12, batch_size=512, delay_s=5)
+        gen = SyntheticTaggedFlowGen(num_flows=100, seed=7)
+        merged = []
+        for s in range(130):
+            merged += aggr.ingest(gen.batches_for_second(T0, s))
+        merged += aggr.drain()
+        l4_msgs = [m for b in merged for m in encode_rows(b)]
+        l7_msgs = encode_rows(SyntheticL7LogGen(num_services=4, seed=8).batch(40, T0))
+
+        s_l4 = UniformSender(
+            [("127.0.0.1", recv.tcp_port)], MessageType.TAGGEDFLOW,
+            agent_id=1, prefer_native_queue=False,
+        )
+        s_l7 = UniformSender(
+            [("127.0.0.1", recv.tcp_port)], MessageType.PROTOCOLLOG,
+            agent_id=1, prefer_native_queue=False,
+        )
+        s_l4.send(l4_msgs)
+        s_l7.send(l7_msgs)
+        total = len(l4_msgs) + len(l7_msgs)
+        assert _wait_for(lambda: ing.get_counters()["rows_written"] >= total), ing.get_counters()
+        ing.flush()
+        assert store.row_count("flow_log", "l4_flow_log") == len(l4_msgs)
+        assert store.row_count("flow_log", "l7_flow_log") == len(l7_msgs)
+        out = store.scan("flow_log", "l7_flow_log", columns=["request_domain", "status_code"])
+        assert all(d.startswith("svc-") for d in out["request_domain"])
+        s_l4.close()
+        s_l7.close()
+    finally:
+        ing.stop()
+        recv.stop()

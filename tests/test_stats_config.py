@@ -72,3 +72,9 @@ def test_config_validation():
         load_config({"ingester": {"n_decoders": 0}})
     with pytest.raises(ConfigError):
         load_config({"ingester": {"n_decoders": "four"}})
+
+
+def test_config_null_keeps_default():
+    cfg, unknown = load_config({"receiver": {"tcp_port": None}, "storage": {"root": None}})
+    assert cfg.receiver.tcp_port == 20033
+    assert cfg.storage.root == ""
