@@ -1,0 +1,7 @@
+"""Query plane: DeepFlow-SQL subset engine over the columnar store —
+the server/querier seat (engine/clickhouse/clickhouse.go:117).
+"""
+
+from .engine import QueryEngine
+
+__all__ = ["QueryEngine"]

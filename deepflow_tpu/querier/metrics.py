@@ -1,0 +1,100 @@
+"""Logical metric registry — the querier's metric expansion layer.
+
+The reference maps user-facing metric names onto storage-column
+expressions per table family (querier/engine/clickhouse/metrics/: e.g.
+`rtt` expands to Sum(rtt_sum)/Sum(rtt_count), `packet` to
+Sum(packet_tx)+Sum(packet_rx)); the engine substitutes these before
+building SQL. Same idea here: derived metrics are SQL snippets parsed
+with our own parser and substituted into the query AST.
+
+`db_descriptions`-style catalogs: `list_metrics(table)` enumerates both
+raw meter columns and derived names so the CLI can surface them.
+"""
+
+from __future__ import annotations
+
+from ..datamodel.schema import APP_METER, FLOW_METER, USAGE_METER, MeterSchema
+from .sqlparse import _Parser
+
+# family → derived metric name → expression snippet over storage columns
+_FLOW_DERIVED = {
+    "packet": "Sum(packet_tx) + Sum(packet_rx)",
+    "byte": "Sum(byte_tx) + Sum(byte_rx)",
+    "l3_byte": "Sum(l3_byte_tx) + Sum(l3_byte_rx)",
+    "l4_byte": "Sum(l4_byte_tx) + Sum(l4_byte_rx)",
+    "rtt_avg": "Sum(rtt_sum) / Sum(rtt_count)",
+    "rtt_client_avg": "Sum(rtt_client_sum) / Sum(rtt_client_count)",
+    "rtt_server_avg": "Sum(rtt_server_sum) / Sum(rtt_server_count)",
+    "srt_avg": "Sum(srt_sum) / Sum(srt_count)",
+    "art_avg": "Sum(art_sum) / Sum(art_count)",
+    "rrt_avg": "Sum(rrt_sum) / Sum(rrt_count)",
+    "cit_avg": "Sum(cit_sum) / Sum(cit_count)",
+    "retrans": "Sum(retrans_tx) + Sum(retrans_rx)",
+    "retrans_ratio": "(Sum(retrans_tx) + Sum(retrans_rx)) / (Sum(packet_tx) + Sum(packet_rx))",
+    "error": "Sum(client_rst_flow) + Sum(server_rst_flow)",
+    "l7_error": "Sum(l7_client_error) + Sum(l7_server_error)",
+}
+
+# NOTE: derived names must not shadow raw storage columns — expansion is
+# by name, and `SELECT request` must mean the raw column, not Sum(request).
+_APP_DERIVED = {
+    "rrt_avg": "Sum(rrt_sum) / Sum(rrt_count)",
+    "error": "Sum(client_error) + Sum(server_error)",
+    "error_ratio": "(Sum(client_error) + Sum(server_error)) / Sum(response)",
+    "client_error_ratio": "Sum(client_error) / Sum(response)",
+    "server_error_ratio": "Sum(server_error) / Sum(response)",
+}
+
+_USAGE_DERIVED = {
+    "packet": "Sum(packet_tx) + Sum(packet_rx)",
+    "byte": "Sum(byte_tx) + Sum(byte_rx)",
+}
+
+_FAMILY_METER: dict[str, tuple[MeterSchema, dict[str, str]]] = {
+    "network": (FLOW_METER, _FLOW_DERIVED),
+    "network_map": (FLOW_METER, _FLOW_DERIVED),
+    "application": (APP_METER, _APP_DERIVED),
+    "application_map": (APP_METER, _APP_DERIVED),
+    "traffic_policy": (USAGE_METER, _USAGE_DERIVED),
+}
+
+
+# shadowing guard: a derived name that matched a raw column would make
+# `SELECT <col>` silently aggregate
+for _meter, _derived in _FAMILY_METER.values():
+    _clash = set(_derived) & set(_meter.field_names())
+    assert not _clash, f"derived metrics shadow raw columns: {_clash}"
+
+
+def _family(table: str) -> str | None:
+    base = table.replace(".", "_")
+    for fam in sorted(_FAMILY_METER, key=len, reverse=True):
+        if base == fam or base.startswith(fam + "_"):
+            return fam
+    return None
+
+
+def derived_metrics(table: str) -> dict[str, str]:
+    fam = _family(table)
+    return _FAMILY_METER[fam][1] if fam else {}
+
+
+def list_metrics(table: str) -> dict[str, str]:
+    """name → kind ("counter"/"gauge"/"derived") for the catalogs."""
+    fam = _family(table)
+    out: dict[str, str] = {}
+    if fam:
+        meter, derived = _FAMILY_METER[fam]
+        for f in meter.fields:
+            out[f.name] = "counter" if f.op.value == "sum" else "gauge"
+        for name in derived:
+            out[name] = "derived"
+    return out
+
+
+def expand(table: str, name: str):
+    """Derived metric name → parsed expression AST, or None."""
+    snippet = derived_metrics(table).get(name)
+    if snippet is None:
+        return None
+    return _Parser(snippet).parse_expr()

@@ -1,0 +1,78 @@
+"""Query-time tag translation — the dictGet seat.
+
+The reference decodes SmartEncoding integer tags back to names at query
+time via ClickHouse dictionaries materialized by tagrecorder
+(`dictGet('flow_tag.pod_map', ...)`, tag/translation.go:95-150). Here
+the same dictionaries live as `flow_tag.<kind>_map` tables in the store
+(written by the controller's tagrecorder); `Translator.translate` loads
+a map lazily, caches it, and gathers names for an id column. Enum-coded
+columns (tap_side, protocol…) translate from static tables.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# tag column (or its _0/_1 sided variants) → dictionary table kind
+_COLUMN_DICT = {
+    "pod_id": "pod",
+    "pod_node_id": "pod_node",
+    "pod_ns_id": "pod_ns",
+    "pod_group_id": "pod_group",
+    "pod_cluster_id": "pod_cluster",
+    "region_id": "region",
+    "az_id": "az",
+    "subnet_id": "subnet",
+    "host_id": "host",
+    "l3_device_id": "device",
+    "l3_epc_id": "l3_epc",
+    "gprocess_id": "gprocess",
+    "auto_service_id": "auto_service",
+    "auto_instance_id": "auto_instance",
+}
+
+_ENUMS = {
+    "tap_side": {0: "rest", 1: "c", 2: "s", 9: "c-nd", 10: "s-nd", 17: "c-hv", 18: "s-hv",
+                 33: "c-gw", 34: "s-gw", 41: "c-p", 42: "s-p", 49: "c-app", 50: "s-app", 48: "app"},
+    "protocol": {0: "unknown", 1: "icmp", 6: "tcp", 17: "udp"},
+    "signal_source": {0: "packet", 1: "xflow", 3: "ebpf", 4: "otel"},
+}
+
+FLOW_TAG_DB = "flow_tag"
+
+
+class Translator:
+    def __init__(self, store):
+        self.store = store
+        self._cache: dict[str, dict[int, str]] = {}
+
+    def _load_map(self, kind: str) -> dict[int, str]:
+        m = self._cache.get(kind)
+        if m is not None:
+            return m
+        m = {}
+        table = f"{kind}_map"
+        try:
+            cols = self.store.scan(FLOW_TAG_DB, table, columns=["id", "name"])
+            m = {int(i): str(s) for i, s in zip(cols["id"], cols["name"])}
+        except KeyError:
+            pass  # dictionary not materialized (no controller) → ids pass through
+        self._cache[kind] = m
+        return m
+
+    def invalidate(self, kind: str | None = None) -> None:
+        if kind is None:
+            self._cache.clear()
+        else:
+            self._cache.pop(kind, None)
+
+    def translate(self, table: str, column: str, ids: np.ndarray) -> np.ndarray:
+        base = column[:-2] if column.endswith(("_0", "_1")) else column
+        if base in _ENUMS:
+            enum = _ENUMS[base]
+            return np.array([enum.get(int(v), str(int(v))) for v in ids])
+        kind = _COLUMN_DICT.get(base)
+        if kind is None:
+            return np.array([str(int(v)) for v in ids])
+        m = self._load_map(kind)
+        return np.array([m.get(int(v), str(int(v))) for v in ids])

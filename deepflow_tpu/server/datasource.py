@@ -77,10 +77,9 @@ def _meter_schema_for(table: str) -> MeterSchema:
 class Downsampler:
     """Owns the DataSource registry; `process()` advances watermarks."""
 
-    def __init__(self, store: ColumnarStore, *, delay_s: int = 60, batch_rows: int = 1 << 17):
+    def __init__(self, store: ColumnarStore, *, delay_s: int = 60):
         self.store = store
         self.delay_s = delay_s
-        self.batch_rows = batch_rows
         self._sources: dict[str, DataSource] = {}
         self._lock = threading.Lock()
         self._proc_lock = threading.Lock()
@@ -146,6 +145,23 @@ class Downsampler:
                 "watermark": np.array([ds.watermark], np.int64),
             },
         )
+        # compact: saves append one-row parts forever otherwise; fold to
+        # one row per datasource once the part count grows
+        if self.store.part_count(ds.db, "datasource_watermark", 0) > 64:
+            rows = self.store.scan(ds.db, "datasource_watermark")
+            best: dict[str, int] = {}
+            for nm, wm in zip(rows["name"], rows["watermark"]):
+                best[str(nm)] = max(best.get(str(nm), -1), int(wm))
+            self.store.drop_partition(ds.db, "datasource_watermark", 0)
+            self.store.insert(
+                ds.db,
+                "datasource_watermark",
+                {
+                    "time": np.zeros(len(best), np.uint32),
+                    "name": np.array(list(best)),
+                    "watermark": np.array(list(best.values()), np.int64),
+                },
+            )
 
     # -- processing -----------------------------------------------------
     def process(self, now: int) -> int:
@@ -178,7 +194,6 @@ class Downsampler:
             }
         )
         written = 0
-        advanced = False
         for c in chunks:
             if not (ds.watermark < c < closed_before):
                 continue
@@ -186,14 +201,16 @@ class Downsampler:
             cols = self.store.scan(ds.db, ds.base_table, time_range=(t0, t1))
             n = len(cols[base_schema.time_column])
             if n:
+                # chunk_s equals the derived table's partition_s, so one
+                # chunk is exactly one target partition: dropping it first
+                # makes re-rolls after a crash idempotent
+                self.store.drop_partition(ds.db, ds.name, c)
                 written += self._rollup(ds, base_schema, cols, n)
             ds.watermark = c
-            advanced = True
+            self._save_watermark(ds)  # per chunk: crash re-rolls ≤1 chunk
             with self._lock:
                 self.counters["partitions"] += 1
                 self.counters["rows_in"] += n
-        if advanced:
-            self._save_watermark(ds)
         with self._lock:
             self.counters["rows_out"] += written
         return written

@@ -264,6 +264,11 @@ class ColumnarStore:
         with self._lock:
             return sorted(t.parts)
 
+    def part_count(self, db: str, table: str, pid: int) -> int:
+        t = self._get(db, table)
+        with self._lock:
+            return len(t.parts.get(pid, []))
+
     def drop_partition(self, db: str, table: str, pid: int) -> None:
         t = self._get(db, table)
         with self._lock:

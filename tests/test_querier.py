@@ -1,0 +1,228 @@
+"""Query engine tests: parser, filters, group-by aggregates vs numpy,
+derived-metric expansion, time-bucketing, tag translation, errors."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from deepflow_tpu.querier import QueryEngine
+from deepflow_tpu.querier.sqlparse import SQLError, parse
+from deepflow_tpu.storage.store import ColumnarStore, ColumnSpec, TableSchema
+
+T0 = 1_700_000_000 - (1_700_000_000 % 3600)
+
+
+@pytest.fixture(scope="module")
+def store():
+    store = ColumnarStore()
+    schema = TableSchema(
+        "application_1s",
+        (
+            ColumnSpec("time", "u4"),
+            ColumnSpec("auto_service_id_0", "u4"),
+            ColumnSpec("tap_side", "u4"),
+            ColumnSpec("app_service", "U64"),
+            ColumnSpec("request", "f4"),
+            ColumnSpec("response", "f4"),
+            ColumnSpec("client_error", "f4"),
+            ColumnSpec("server_error", "f4"),
+            ColumnSpec("rrt_sum", "f4"),
+            ColumnSpec("rrt_count", "f4"),
+            ColumnSpec("rrt_max", "f4"),
+            ColumnSpec("timeout", "f4"),
+            ColumnSpec("direction_score", "f4"),
+        ),
+    )
+    store.create_table("flow_metrics", schema)
+    rng = np.random.default_rng(0)
+    n = 1000
+    store.insert(
+        "flow_metrics",
+        "application_1s",
+        {
+            "time": (T0 + rng.integers(0, 120, n)).astype(np.uint32),
+            "auto_service_id_0": rng.integers(1, 5, n).astype(np.uint32),
+            "tap_side": rng.choice([1, 2], n).astype(np.uint32),
+            "app_service": np.array([f"svc-{i}" for i in rng.integers(0, 4, n)]),
+            "request": np.ones(n, np.float32),
+            "response": np.ones(n, np.float32),
+            "client_error": (rng.random(n) < 0.1).astype(np.float32),
+            "server_error": (rng.random(n) < 0.05).astype(np.float32),
+            "rrt_sum": rng.integers(100, 10_000, n).astype(np.float32),
+            "rrt_count": np.ones(n, np.float32),
+            "rrt_max": rng.integers(100, 10_000, n).astype(np.float32),
+            "timeout": np.zeros(n, np.float32),
+            "direction_score": np.zeros(n, np.float32),
+        },
+    )
+    # flow_tag dictionary for translation
+    store.create_table(
+        "flow_tag",
+        TableSchema(
+            "auto_service_map",
+            (ColumnSpec("time", "u4"), ColumnSpec("id", "u4"), ColumnSpec("name", "U64")),
+        ),
+    )
+    store.insert(
+        "flow_tag",
+        "auto_service_map",
+        {
+            "time": np.zeros(4, np.uint32),
+            "id": np.arange(1, 5, dtype=np.uint32),
+            "name": np.array([f"payments-{i}" for i in range(1, 5)]),
+        },
+    )
+    return store
+
+
+@pytest.fixture(scope="module")
+def raw(store):
+    return store.scan("flow_metrics", "application_1s")
+
+
+def test_parser_shapes():
+    q = parse(
+        "SELECT Sum(request) AS req, app_service FROM application.1s "
+        "WHERE time >= 100 AND tap_side = 1 GROUP BY app_service "
+        "ORDER BY req DESC LIMIT 10 OFFSET 2"
+    )
+    assert q.table == "application.1s"
+    assert q.limit == 10 and q.offset == 2
+    assert q.order_by[0][1] == "desc"
+    with pytest.raises(SQLError):
+        parse("SELECT FROM x")
+    with pytest.raises(SQLError):
+        parse("SELECT a FROM t WHERE a ~ 1")
+
+
+def test_plain_select_with_filter(store, raw):
+    eng = QueryEngine(store)
+    r = eng.execute(
+        f"SELECT time, request FROM application.1s WHERE time >= {T0+10} AND time < {T0+20}"
+    )
+    want = ((raw["time"] >= T0 + 10) & (raw["time"] < T0 + 20)).sum()
+    assert r.rows == want
+    assert all(T0 + 10 <= t < T0 + 20 for t in r.values["time"])
+
+
+def test_group_by_aggregates_match_numpy(store, raw):
+    eng = QueryEngine(store)
+    r = eng.execute(
+        "SELECT app_service, Sum(request) AS req, Avg(rrt_sum) AS a, "
+        "Max(rrt_max) AS mx, Count() AS c, Uniq(auto_service_id_0) AS u "
+        "FROM application.1s GROUP BY app_service ORDER BY app_service"
+    )
+    for i, svc in enumerate(r.values["app_service"]):
+        sel = raw["app_service"] == svc
+        assert r.values["req"][i] == pytest.approx(raw["request"][sel].sum())
+        assert r.values["a"][i] == pytest.approx(raw["rrt_sum"][sel].mean(), rel=1e-5)
+        assert r.values["mx"][i] == raw["rrt_max"][sel].max()
+        assert r.values["c"][i] == sel.sum()
+        assert r.values["u"][i] == len(np.unique(raw["auto_service_id_0"][sel]))
+
+
+def test_derived_metric_expansion(store, raw):
+    eng = QueryEngine(store)
+    r = eng.execute(
+        "SELECT app_service, rrt_avg, error_ratio FROM application.1s "
+        "GROUP BY app_service ORDER BY app_service"
+    )
+    for i, svc in enumerate(r.values["app_service"]):
+        sel = raw["app_service"] == svc
+        assert r.values["rrt_avg"][i] == pytest.approx(
+            raw["rrt_sum"][sel].sum() / raw["rrt_count"][sel].sum(), rel=1e-5
+        )
+        want = (raw["client_error"][sel].sum() + raw["server_error"][sel].sum()) / raw[
+            "response"
+        ][sel].sum()
+        assert r.values["error_ratio"][i] == pytest.approx(want, rel=1e-5)
+
+
+def test_time_bucketing(store, raw):
+    eng = QueryEngine(store)
+    r = eng.execute(
+        "SELECT interval(time, 60) AS t, Sum(request) AS req "
+        "FROM application.1s GROUP BY interval(time, 60) ORDER BY t"
+    )
+    assert r.rows == 2  # 120s of data → two 1m buckets
+    assert r.values["req"].sum() == raw["request"].sum()
+    assert set(r.values["t"] % 60) == {0}
+
+
+def test_tag_translation(store):
+    eng = QueryEngine(store)
+    r = eng.execute(
+        "SELECT name(auto_service_id_0) AS svc, Sum(request) AS req "
+        "FROM application.1s GROUP BY name(auto_service_id_0) ORDER BY svc"
+    )
+    assert list(r.values["svc"]) == [f"payments-{i}" for i in range(1, 5)]
+    # enum translation without dictionaries
+    r2 = eng.execute(
+        "SELECT name(tap_side) AS side, Count() AS c FROM application.1s "
+        "GROUP BY name(tap_side) ORDER BY side"
+    )
+    assert set(r2.values["side"]) == {"c", "s"}
+
+
+def test_in_and_order_limit(store, raw):
+    eng = QueryEngine(store)
+    r = eng.execute(
+        "SELECT app_service, Sum(request) AS req FROM application.1s "
+        "WHERE app_service IN ('svc-0', 'svc-1') GROUP BY app_service "
+        "ORDER BY req DESC LIMIT 1"
+    )
+    assert r.rows == 1
+    assert r.values["app_service"][0] in ("svc-0", "svc-1")
+    s0 = raw["request"][raw["app_service"] == "svc-0"].sum()
+    s1 = raw["request"][raw["app_service"] == "svc-1"].sum()
+    assert r.values["req"][0] == max(s0, s1)
+
+
+def test_errors(store):
+    eng = QueryEngine(store)
+    with pytest.raises(SQLError):
+        eng.execute("SELECT nope FROM application.1s")
+    with pytest.raises(SQLError):
+        eng.execute("SELECT request FROM no_such_table")
+    with pytest.raises(SQLError):
+        eng.execute("SELECT app_service, Sum(request) FROM application.1s GROUP BY time")
+
+
+def test_metrics_catalog(store):
+    eng = QueryEngine(store)
+    m = eng.metrics("application_1s")
+    assert m["request"] == "counter"
+    assert m["rrt_max"] == "gauge"
+    assert m["error_ratio"] == "derived"
+
+
+def test_not_in_after_expression():
+    from deepflow_tpu.querier.sqlparse import InList
+
+    q = parse("SELECT a FROM t WHERE a + b NOT IN (1, 2)")
+    cond = q.where
+    assert isinstance(cond, InList) and cond.negated
+
+
+def test_select_star_with_where(store):
+    eng = QueryEngine(store)
+    r = eng.execute(f"SELECT * FROM application.1s WHERE time >= {T0+60}")
+    schema = store.schema("flow_metrics", "application_1s")
+    assert r.columns == schema.column_names()
+    assert r.rows > 0
+
+
+def test_order_by_alias_plain(store):
+    eng = QueryEngine(store)
+    r = eng.execute(
+        "SELECT rrt_max AS x, time FROM application.1s ORDER BY x DESC LIMIT 5"
+    )
+    vals = r.values["x"]
+    assert all(vals[i] >= vals[i + 1] for i in range(len(vals) - 1))
+
+
+def test_count_only(store, raw):
+    eng = QueryEngine(store)
+    r = eng.execute("SELECT Count() AS c FROM application.1s")
+    assert r.values["c"][0] == len(raw["time"])
