@@ -1,0 +1,4 @@
+"""Agent data plane: vectorized packet parsing (dispatcher seat) and the
+device-resident FlowMap (flow_generator seat) — the TPU rebuild of
+agent/src/dispatcher + agent/src/flow_generator.
+"""

@@ -225,6 +225,10 @@ _log_merge = partial(jax.jit, static_argnames=("schema",), donate_argnums=(0,))(
     _log_merge_impl
 )
 
+# public alias: the agent FlowMap reuses the same schema-driven merge for
+# its flow-state table (one LogStash, slot pinned to 0)
+log_stash_merge = _log_merge
+
 
 @jax.jit
 def _log_flush(state: LogStashState, slot_idx):

@@ -141,6 +141,9 @@ L4_FLOW_LOG = LogSchema(
             _i("status", LogOp.LAST),
             _i("close_type", LogOp.LAST),
             _i("state", LogOp.LAST),
+            # set on the flow's first emission; OR so a minute window
+            # containing the flow's birth keeps the mark
+            _i("is_new_flow", LogOp.OR),
             _i("tcp_flags_bit_0", LogOp.OR),
             _i("tcp_flags_bit_1", LogOp.OR),
             # counters (FlowPerfStats / metrics peers)

@@ -215,8 +215,11 @@ class _Parser:
             self.next()
             return UnaryOp("-", self._parse_unary())
         if k == "kw" and v == "not":
+            # SQL precedence: NOT binds looser than comparisons, so
+            # `NOT a = 1` is NOT(a = 1) — parse the operand at the
+            # precedence level just above AND
             self.next()
-            return UnaryOp("not", self._parse_unary())
+            return UnaryOp("not", self.parse_expr(_PRECEDENCE["and"] + 1))
         return self._parse_primary()
 
     def _parse_primary(self):

@@ -1,0 +1,328 @@
+"""Agent data plane tests: vectorized packet parsing (incl. VLAN/VXLAN),
+pcap round-trip, and FlowMap lifecycle — handshake, counters vs. a dict
+oracle, FIN/RST close, timeout close, retrans detection, RTT."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from deepflow_tpu.agent.flow_map import (
+    CLOSE_FIN,
+    CLOSE_SERVER_RST,
+    CLOSE_TIMEOUT,
+    STATE_ESTABLISHED,
+    FlowMap,
+    FlowTimeouts,
+)
+from deepflow_tpu.agent.packet import (
+    TCP_ACK,
+    TCP_FIN,
+    TCP_PSH,
+    TCP_RST,
+    TCP_SYN,
+    craft_tcp,
+    craft_udp,
+    craft_vxlan,
+    parse_packets,
+    to_batch,
+)
+from deepflow_tpu.agent.pcap import pcap_batches, read_pcap, write_pcap
+from deepflow_tpu.flowlog.schema import L4_FLOW_LOG
+
+CLI = 0x0A000001  # 10.0.0.1
+SRV = 0x0A000002  # 10.0.0.2
+T0 = 1_700_000_000
+
+
+def _parse(pkts, ts=None):
+    ts = ts or [T0] * len(pkts)
+    return parse_packets(*to_batch(pkts, ts))
+
+
+# -- parser -----------------------------------------------------------------
+
+
+def test_parse_tcp_and_udp_fields():
+    pkts = [
+        craft_tcp(CLI, SRV, 40000, 443, flags=TCP_SYN, seq=100),
+        craft_tcp(SRV, CLI, 443, 40000, flags=TCP_SYN | TCP_ACK, seq=7, ack=101),
+        craft_tcp(CLI, SRV, 40000, 443, flags=TCP_ACK | TCP_PSH, seq=101, payload=b"x" * 42),
+        craft_udp(CLI, SRV, 5353, 53, b"q" * 10),
+    ]
+    b = _parse(pkts)
+    assert b.valid.all()
+    assert b.protocol.tolist() == [6, 6, 6, 17]
+    assert b.port_src.tolist() == [40000, 443, 40000, 5353]
+    assert b.port_dst.tolist() == [443, 40000, 443, 53]
+    assert b.ip_src[:, 3].tolist() == [CLI, SRV, CLI, CLI]
+    assert b.tcp_flags.tolist() == [TCP_SYN, TCP_SYN | TCP_ACK, TCP_ACK | TCP_PSH, 0]
+    assert b.seq.tolist() == [100, 7, 101, 0]
+    assert b.payload_len.tolist() == [0, 0, 42, 10]
+
+
+def test_parse_vlan_and_garbage():
+    pkts = [
+        craft_tcp(CLI, SRV, 1234, 80, flags=TCP_ACK, vlan=7),
+        b"\x00" * 20,  # garbage: too short / unknown ethertype
+        craft_tcp(CLI, SRV, 1234, 80, flags=TCP_ACK),
+    ]
+    b = _parse(pkts)
+    assert b.valid.tolist() == [True, False, True]
+    assert b.port_dst[0] == 80  # VLAN offset handled
+
+
+def test_parse_vxlan_decap():
+    inner = craft_tcp(CLI, SRV, 50000, 8080, flags=TCP_ACK, payload=b"hi")
+    pkts = [craft_vxlan(0xC0A80001, 0xC0A80002, vni=42, inner=inner)]
+    b = _parse(pkts)
+    assert b.valid.all()
+    assert b.tunnel_type[0] == 1
+    assert b.ip_src[0, 3] == CLI and b.ip_dst[0, 3] == SRV
+    assert b.port_dst[0] == 8080
+    assert b.payload_len[0] == 2
+
+
+def test_pcap_roundtrip(tmp_path):
+    pkts = [
+        (T0, 1, craft_tcp(CLI, SRV, 40000, 443, flags=TCP_SYN)),
+        (T0 + 1, 2, craft_udp(CLI, SRV, 999, 53, b"abc")),
+    ]
+    f = tmp_path / "t.pcap"
+    write_pcap(f, pkts)
+    assert read_pcap(f) == pkts
+    batches = list(pcap_batches(f, batch_size=10))
+    assert len(batches) == 1
+    b = parse_packets(*batches[0])
+    assert b.valid.all()
+    assert b.timestamp_s.tolist() == [T0, T0 + 1]
+
+
+# -- FlowMap ----------------------------------------------------------------
+
+
+def _session(sport=40000, payload_up=3, payload_down=2, fin=True, rst=False):
+    """One full TCP session's packets (client CLI:sport → SRV:443)."""
+    pkts = [
+        craft_tcp(CLI, SRV, sport, 443, flags=TCP_SYN, seq=1000),
+        craft_tcp(SRV, CLI, 443, sport, flags=TCP_SYN | TCP_ACK, seq=5000, ack=1001),
+        craft_tcp(CLI, SRV, sport, 443, flags=TCP_ACK, seq=1001, ack=5001),
+    ]
+    seq = 1001
+    for _ in range(payload_up):
+        pkts.append(craft_tcp(CLI, SRV, sport, 443, flags=TCP_ACK | TCP_PSH, seq=seq, payload=b"u" * 100))
+        seq += 100
+    dseq = 5001
+    for _ in range(payload_down):
+        pkts.append(craft_tcp(SRV, CLI, 443, sport, flags=TCP_ACK | TCP_PSH, seq=dseq, payload=b"d" * 200))
+        dseq += 200
+    if rst:
+        pkts.append(craft_tcp(SRV, CLI, 443, sport, flags=TCP_RST, seq=dseq))
+    elif fin:
+        pkts.append(craft_tcp(CLI, SRV, sport, 443, flags=TCP_FIN | TCP_ACK, seq=seq))
+        pkts.append(craft_tcp(SRV, CLI, 443, sport, flags=TCP_FIN | TCP_ACK, seq=dseq))
+    return pkts
+
+
+def test_flow_lifecycle_fin_close():
+    fm = FlowMap(capacity=1 << 8, batch_size=64)
+    pkts = _session()
+    fm.inject(_parse(pkts))
+    out = fm.tick(T0 + 1)
+    rows = out.to_rows()
+    assert len(rows) == 1
+    r = rows[0]
+    s = L4_FLOW_LOG
+    assert r["close_type"] == CLOSE_FIN
+    assert r["client_port"] == 40000 and r["server_port"] == 443
+    assert r["ip0_w3"] == CLI and r["ip1_w3"] == SRV
+    # exact packet/byte accounting vs the crafted session
+    up = [p for p in pkts if p[26:30] == CLI.to_bytes(4, "big")]
+    down = [p for p in pkts if p[26:30] == SRV.to_bytes(4, "big")]
+    assert r["packet_tx"] == len(up)
+    assert r["packet_rx"] == len(down)
+    assert r["byte_tx"] == sum(len(p) for p in up)
+    assert r["byte_rx"] == sum(len(p) for p in down)
+    assert r["l4_byte_tx"] == 300 and r["l4_byte_rx"] == 400
+    assert r["syn_count"] == 1 and r["synack_count"] == 1
+    assert r["tcp_flags_bit_0"] & TCP_SYN
+    assert fm.get_counters()["occupancy"] == 0  # closed flow left the table
+
+
+def test_flow_server_rst_close():
+    fm = FlowMap(capacity=1 << 8, batch_size=64)
+    fm.inject(_parse(_session(fin=False, rst=True)))
+    rows = fm.tick(T0 + 1).to_rows()
+    assert rows[0]["close_type"] == CLOSE_SERVER_RST
+
+
+def test_flow_timeout_close_and_periodic_emission():
+    fm = FlowMap(capacity=1 << 8, batch_size=64, timeouts=FlowTimeouts(established=10))
+    # handshake + data, no close
+    fm.inject(_parse(_session(fin=False)))
+    first = fm.tick(T0 + 1).to_rows()
+    assert len(first) == 1
+    assert first[0]["close_type"] == 0  # active emission, not closed
+    assert first[0]["state"] == STATE_ESTABLISHED
+    # second tick with no traffic: no delta → no emission, flow stays
+    assert fm.tick(T0 + 2).to_rows() == []
+    assert fm.get_counters()["occupancy"] == 1
+    # idle past the established timeout → closed with CLOSE_TIMEOUT
+    rows = fm.tick(T0 + 11).to_rows()
+    assert len(rows) == 1
+    assert rows[0]["close_type"] == CLOSE_TIMEOUT
+    # delta counters were zeroed after the first emission
+    assert rows[0]["packet_tx"] == 0
+    assert rows[0]["total_packet_tx"] == first[0]["packet_tx"]
+    assert fm.get_counters()["occupancy"] == 0
+
+
+def test_flow_deltas_across_ticks_sum_to_totals():
+    fm = FlowMap(capacity=1 << 8, batch_size=64, timeouts=FlowTimeouts(established=100))
+    s1 = _session(fin=False)
+    fm.inject(_parse(s1, ts=[T0] * len(s1)))
+    r1 = fm.tick(T0 + 1).to_rows()[0]
+    more = [craft_tcp(CLI, SRV, 40000, 443, flags=TCP_ACK | TCP_PSH, seq=9000, payload=b"z" * 50)]
+    fm.inject(_parse(more, ts=[T0 + 1]))
+    r2 = fm.tick(T0 + 2).to_rows()[0]
+    assert r2["packet_tx"] == 1  # only the new packet in the delta
+    assert r2["total_packet_tx"] == r1["packet_tx"] + 1
+    assert r2["total_byte_tx"] == r1["byte_tx"] + r2["byte_tx"]
+    assert r1["flow_id_lo"] == r2["flow_id_lo"]  # same flow identity
+
+
+def test_retransmission_detected_within_batch():
+    fm = FlowMap(capacity=1 << 8, batch_size=64)
+    pkts = _session(fin=False)
+    # duplicate data segment (same seq range) → one retrans
+    pkts.append(craft_tcp(CLI, SRV, 40000, 443, flags=TCP_ACK | TCP_PSH, seq=1001, payload=b"u" * 100))
+    fm.inject(_parse(pkts))
+    r = fm.tick(T0 + 1).to_rows()[0]
+    assert r["retrans_tx"] == 1
+    assert r["retrans_rx"] == 0
+
+
+def test_rtt_from_handshake_times():
+    fm = FlowMap(capacity=1 << 8, batch_size=64)
+    pkts = [
+        craft_tcp(CLI, SRV, 40000, 443, flags=TCP_SYN, seq=1),
+        craft_tcp(SRV, CLI, 443, 40000, flags=TCP_SYN | TCP_ACK, seq=9, ack=2),
+        craft_tcp(CLI, SRV, 40000, 443, flags=TCP_ACK, seq=2, ack=10),
+    ]
+    fm.inject(_parse(pkts, ts=[T0, T0 + 2, T0 + 3]))
+    r = fm.tick(T0 + 4).to_rows()[0]
+    assert r["rtt_client_max"] == 2  # synack - syn
+    assert r["rtt_server_max"] == 1  # client ack - synack
+    assert r["rtt"] == 3
+
+
+def test_many_concurrent_flows_counted_exactly():
+    fm = FlowMap(capacity=1 << 10, batch_size=1 << 10, timeouts=FlowTimeouts(established=50))
+    rng = np.random.default_rng(0)
+    pkts, counts = [], {}
+    for i in range(100):
+        sport = 30000 + i
+        n_up = int(rng.integers(1, 6))
+        counts[sport] = n_up + 2  # syn + ack + data (client side)
+        sess = _session(sport=sport, payload_up=n_up, payload_down=1, fin=False)
+        pkts += sess
+    order = rng.permutation(len(pkts))
+    parsed = _parse([pkts[i] for i in order])
+    fm.inject(parsed)
+    rows = fm.tick(T0 + 1).to_rows()
+    assert len(rows) == 100
+    for r in rows:
+        assert r["packet_tx"] == counts[r["client_port"]]
+        assert r["packet_rx"] == 2  # synack + one data segment
+    assert fm.get_counters()["occupancy"] == 100
+
+
+def test_udp_flow():
+    fm = FlowMap(capacity=1 << 8, batch_size=64, timeouts=FlowTimeouts(established=5))
+    pkts = [
+        craft_udp(CLI, SRV, 5000, 53, b"query"),
+        craft_udp(SRV, CLI, 53, 5000, b"answer!"),
+    ]
+    fm.inject(_parse(pkts))
+    r = fm.tick(T0 + 1).to_rows()[0]
+    assert r["protocol"] == 17
+    assert r["packet_tx"] == 1 and r["packet_rx"] == 1
+    assert r["l4_byte_tx"] == 5 and r["l4_byte_rx"] == 7
+    # server = lower port heuristic without a handshake
+    assert r["server_port"] == 53
+
+
+def test_agent_to_pipelines_integration():
+    """packets → FlowMap → (bridge → L4 metrics docs) + (MinuteAggr rows):
+    the full agent slice of SURVEY §3.1 on synthetic capture."""
+    from deepflow_tpu.agent.bridge import emissions_to_flow_batch
+    from deepflow_tpu.aggregator.pipeline import L4Pipeline, PipelineConfig
+    from deepflow_tpu.flowlog.aggr import MinuteAggr
+
+    fm = FlowMap(capacity=1 << 10, batch_size=1 << 10, timeouts=FlowTimeouts(established=120))
+    pipe = L4Pipeline(PipelineConfig(batch_size=512))
+    aggr = MinuteAggr(capacity=1 << 12, batch_size=512, delay_s=2)
+
+    docs = []
+    log_rows = 0
+    total_pkts = 0
+    for sec in range(3):
+        pkts = []
+        for i in range(20):
+            pkts += _session(sport=30000 + 100 * sec + i, fin=(sec == 2))
+        total_pkts += len(pkts)
+        fm.inject(_parse(pkts, ts=[T0 + sec] * len(pkts)))
+        em = fm.tick(T0 + sec + 1)
+        if em.size:
+            docs += pipe.ingest(emissions_to_flow_batch(em).pad_to(512))
+            aggr.ingest(em)
+    docs += pipe.drain()
+    for b in aggr.drain():
+        log_rows += b.size
+
+    assert fm.get_counters()["packets_in"] == total_pkts
+    # every emitted doc-window has rows; byte conservation end to end
+    emitted_docs = sum(int(d.valid.sum()) for d in docs)
+    assert emitted_docs > 0
+    assert log_rows == 60  # 20 flows x 3 seconds, all in one minute
+
+
+def test_clock_ahead_does_not_timeout():
+    """Packets stamped after the tick clock must not wrap u32 idle."""
+    fm = FlowMap(capacity=1 << 8, batch_size=64, timeouts=FlowTimeouts(established=100))
+    fm.inject(_parse(_session(fin=False), ts=[T0 + 5] * len(_session(fin=False))))
+    rows = fm.tick(T0 + 1).to_rows()  # tick clock behind capture clock
+    assert len(rows) == 1
+    assert rows[0]["close_type"] == 0
+    assert fm.get_counters()["occupancy"] == 1
+
+
+def test_reordering_is_not_retransmission():
+    fm = FlowMap(capacity=1 << 8, batch_size=64)
+    pkts = _session(payload_up=0, payload_down=0, fin=False)
+    # two disjoint data segments captured out of order
+    pkts.append(craft_tcp(CLI, SRV, 40000, 443, flags=TCP_ACK | TCP_PSH, seq=1101, payload=b"b" * 100))
+    pkts.append(craft_tcp(CLI, SRV, 40000, 443, flags=TCP_ACK | TCP_PSH, seq=1001, payload=b"a" * 100))
+    fm.inject(_parse(pkts))
+    r = fm.tick(T0 + 1).to_rows()[0]
+    assert r["retrans_tx"] == 0
+
+
+def test_malformed_vxlan_never_crashes():
+    # outer UDP:4789 but truncated inner — must yield rows, not raise
+    from deepflow_tpu.agent.packet import craft_udp as _cu
+
+    junk = _cu(CLI, SRV, 1111, 4789, b"\x08\x00\x00\x00\x00\x00\x2a\x00" + b"\x01" * 6)
+    b = _parse([junk, craft_tcp(CLI, SRV, 1, 2, flags=TCP_ACK)])
+    assert len(b.valid) == 2
+    assert b.valid[1]
+
+
+def test_rtt_stamped_once_per_flow():
+    fm = FlowMap(capacity=1 << 8, batch_size=64, timeouts=FlowTimeouts(established=100))
+    fm.inject(_parse(_session(fin=False)))
+    r1 = fm.tick(T0 + 1).to_rows()[0]
+    assert r1["is_new_flow"] == 1
+    fm.inject(_parse([craft_tcp(CLI, SRV, 40000, 443, flags=TCP_ACK | TCP_PSH, seq=5, payload=b"x")], ts=[T0 + 1]))
+    r2 = fm.tick(T0 + 2).to_rows()[0]
+    assert r2["is_new_flow"] == 0
+    assert r2["rtt"] == 0 and r2["rtt_client_max"] == 0  # not re-stamped

@@ -226,3 +226,9 @@ def test_count_only(store, raw):
     eng = QueryEngine(store)
     r = eng.execute("SELECT Count() AS c FROM application.1s")
     assert r.values["c"][0] == len(raw["time"])
+
+
+def test_not_precedence(store, raw):
+    eng = QueryEngine(store)
+    r = eng.execute("SELECT Count() AS c FROM application.1s WHERE NOT tap_side = 1")
+    assert r.values["c"][0] == (raw["tap_side"] != 1).sum()
