@@ -17,7 +17,7 @@ from ..datamodel.code import Direction
 from ..datamodel.schema import FLOW_METER
 from ..flowlog.aggr import FlowLogBatch
 from ..flowlog.schema import L4_FLOW_LOG
-from .flow_map import CLOSE_NONE
+from .flow_map import CLOSE_NONE, CLOSE_TIMEOUT
 
 _M = FLOW_METER.index
 
@@ -67,7 +67,7 @@ def emissions_to_flow_batch(b: FlowLogBatch, *, epc0: int = 0, epc1: int = 0) ->
     close_type = ic("close_type")
     meters[:, _M("closed_flow")] = (close_type != CLOSE_NONE).astype(np.float32)
     meters[:, _M("new_flow")] = (ic("is_new_flow") != 0).astype(np.float32)
-    meters[:, _M("tcp_timeout")] = (close_type == 5).astype(np.float32)
+    meters[:, _M("tcp_timeout")] = (close_type == CLOSE_TIMEOUT).astype(np.float32)
 
     rtt_c = b.col("rtt_client_max")
     rtt_s = b.col("rtt_server_max")

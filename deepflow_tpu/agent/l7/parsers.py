@@ -1,0 +1,308 @@
+"""L7 protocol parsers: HTTP/1.x, DNS, Redis (RESP), MySQL.
+
+The reference implements 20+ parsers behind `L7ProtocolParserInterface`
+(protocol_logs/mod.rs): each exposes a cheap `check_payload` probe used
+for per-flow protocol inference, and a full parse producing request/
+response records with RED fields. Same structure here, host-side —
+byte-string protocol parsing is irreducibly sequential per message, so
+it stays on CPU feeding the device pipelines (exactly where the
+reference runs it). SQL text is obfuscated before leaving the parser
+(sql_obfuscate.rs stance: literals never reach storage).
+
+Parsers cited: http.rs, dns.rs, redis.rs, mysql.rs under
+/root/reference/agent/src/flow_generator/protocol_logs/.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+from ...datamodel.code import L7Protocol
+
+MSG_REQUEST = 0
+MSG_RESPONSE = 1
+
+# L7ResponseStatus (protocol_logs/pb_adapter.rs semantics, condensed)
+STATUS_OK = 1
+STATUS_CLIENT_ERROR = 3
+STATUS_SERVER_ERROR = 4
+
+
+@dataclasses.dataclass
+class L7Message:
+    protocol: int
+    msg_type: int  # MSG_REQUEST / MSG_RESPONSE
+    version: str = ""
+    request_type: str = ""  # method / command / qtype
+    request_domain: str = ""  # host / db / query name
+    request_resource: str = ""  # path / statement / key
+    endpoint: str = ""  # normalized resource
+    request_id: int = 0  # dns id / mysql seq — pairs req↔resp
+    status: int = STATUS_OK
+    status_code: int = 0
+
+
+# ---------------------------------------------------------------------------
+# HTTP/1.x (http.rs)
+
+_HTTP_METHODS = (
+    b"GET ", b"POST ", b"PUT ", b"DELETE ", b"HEAD ", b"OPTIONS ",
+    b"PATCH ", b"TRACE ", b"CONNECT ",
+)
+_N_PATH_SEGMENTS = 2  # endpoint = first two path segments (http.rs endpoint trim)
+
+
+def check_http(payload: bytes) -> bool:
+    return payload.startswith(_HTTP_METHODS) or payload.startswith(b"HTTP/1.")
+
+
+def parse_http(payload: bytes) -> L7Message | None:
+    try:
+        head, _, _ = payload.partition(b"\r\n\r\n")
+        lines = head.split(b"\r\n")
+        first = lines[0]
+        if first.startswith(b"HTTP/1."):
+            parts = first.split(b" ", 2)
+            code = int(parts[1]) if len(parts) > 1 and parts[1].isdigit() else 0
+            status = (
+                STATUS_CLIENT_ERROR
+                if 400 <= code < 500
+                else STATUS_SERVER_ERROR if code >= 500 else STATUS_OK
+            )
+            return L7Message(
+                protocol=L7Protocol.HTTP1,
+                msg_type=MSG_RESPONSE,
+                version=first[5:8].decode(errors="replace"),
+                status=status,
+                status_code=code,
+            )
+        for m in _HTTP_METHODS:
+            if first.startswith(m):
+                method = m.strip().decode()
+                parts = first.split(b" ", 2)
+                uri = parts[1].decode(errors="replace") if len(parts) > 1 else ""
+                version = (
+                    parts[2][5:8].decode(errors="replace") if len(parts) > 2 else ""
+                )
+                host = ""
+                for ln in lines[1:]:
+                    if ln[:5].lower() == b"host:":
+                        host = ln[5:].strip().decode(errors="replace")
+                        break
+                path = uri.split("?", 1)[0]
+                segs = [s for s in path.split("/") if s]
+                endpoint = "/" + "/".join(segs[:_N_PATH_SEGMENTS])
+                return L7Message(
+                    protocol=L7Protocol.HTTP1,
+                    msg_type=MSG_REQUEST,
+                    version=version,
+                    request_type=method,
+                    request_domain=host,
+                    request_resource=path,
+                    endpoint=endpoint,
+                )
+        return None
+    except Exception:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# DNS (dns.rs) — UDP payload
+
+_QTYPES = {1: "A", 2: "NS", 5: "CNAME", 6: "SOA", 12: "PTR", 15: "MX", 16: "TXT", 28: "AAAA", 33: "SRV"}
+
+
+def check_dns(payload: bytes, port: int = 0) -> bool:
+    if len(payload) < 12:
+        return False
+    qd = int.from_bytes(payload[4:6], "big")
+    opcode_ok = (payload[2] >> 3) & 0xF in (0, 1, 2)
+    return (port == 53 or 1 <= qd <= 4) and opcode_ok and qd >= 1
+
+
+def parse_dns(payload: bytes) -> L7Message | None:
+    try:
+        if len(payload) < 12:
+            return None
+        txid = int.from_bytes(payload[0:2], "big")
+        flags = int.from_bytes(payload[2:4], "big")
+        is_resp = bool(flags & 0x8000)
+        rcode = flags & 0xF
+        # parse the first question name
+        labels = []
+        off = 12
+        while off < len(payload):
+            ln = payload[off]
+            if ln == 0:
+                off += 1
+                break
+            if ln >= 0xC0 or off + 1 + ln > len(payload):  # compression in QD is invalid
+                return None
+            labels.append(payload[off + 1 : off + 1 + ln].decode(errors="replace"))
+            off += 1 + ln
+        qtype = int.from_bytes(payload[off : off + 2], "big") if off + 2 <= len(payload) else 0
+        name = ".".join(labels)
+        if is_resp:
+            status = (
+                STATUS_OK
+                if rcode == 0
+                else STATUS_CLIENT_ERROR if rcode == 3 else STATUS_SERVER_ERROR
+            )
+            return L7Message(
+                protocol=L7Protocol.DNS,
+                msg_type=MSG_RESPONSE,
+                request_id=txid,
+                request_domain=name,
+                status=status,
+                status_code=rcode,
+            )
+        return L7Message(
+            protocol=L7Protocol.DNS,
+            msg_type=MSG_REQUEST,
+            request_id=txid,
+            request_type=_QTYPES.get(qtype, str(qtype)),
+            request_domain=name,
+            request_resource=name,
+            endpoint=name,
+        )
+    except Exception:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Redis RESP (redis.rs)
+
+
+def check_redis(payload: bytes) -> bool:
+    return len(payload) >= 4 and payload[:1] in (b"*", b"+", b"-", b"$", b":") and b"\r\n" in payload[:64]
+
+
+def parse_redis(payload: bytes) -> L7Message | None:
+    try:
+        first = payload[:1]
+        if first == b"*":  # request: array of bulk strings
+            lines = payload.split(b"\r\n")
+            # lines: *N, $len, CMD, $len, arg...
+            if len(lines) < 3 or not lines[1].startswith(b"$"):
+                return None
+            cmd = lines[2].decode(errors="replace").upper()
+            args = [
+                lines[i].decode(errors="replace")
+                for i in range(4, min(len(lines), 8), 2)
+                if i < len(lines) and not lines[i].startswith((b"$", b"*"))
+            ]
+            return L7Message(
+                protocol=L7Protocol.REDIS,
+                msg_type=MSG_REQUEST,
+                request_type=cmd,
+                request_resource=" ".join([cmd] + args[:1]),
+                endpoint=cmd,
+            )
+        if first == b"-":  # error reply
+            return L7Message(
+                protocol=L7Protocol.REDIS,
+                msg_type=MSG_RESPONSE,
+                status=STATUS_SERVER_ERROR,
+                request_resource=payload[1:].split(b"\r\n")[0].decode(errors="replace"),
+            )
+        if first in (b"+", b"$", b":"):
+            return L7Message(protocol=L7Protocol.REDIS, msg_type=MSG_RESPONSE)
+        return None
+    except Exception:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# MySQL (mysql.rs) — classic protocol, header [len u24 LE][seq u8]
+
+_COM_QUERY = 0x03
+_COM_STMT_PREPARE = 0x16
+_COM_STMT_EXECUTE = 0x17
+_COM_NAMES = {0x01: "COM_QUIT", 0x03: "COM_QUERY", 0x0E: "COM_PING", 0x16: "COM_STMT_PREPARE", 0x17: "COM_STMT_EXECUTE"}
+
+_SQL_STR = re.compile(r"'(?:[^'\\]|\\.)*'|\"(?:[^\"\\]|\\.)*\"")
+_SQL_NUM = re.compile(r"\b\d+(?:\.\d+)?\b")
+
+
+def obfuscate_sql(stmt: str) -> str:
+    """Literal stripping (sql/sql_obfuscate.rs): values never stored."""
+    stmt = _SQL_STR.sub("?", stmt)
+    return _SQL_NUM.sub("?", stmt)
+
+
+def check_mysql(payload: bytes, port: int = 0) -> bool:
+    if len(payload) < 5:
+        return False
+    ln = int.from_bytes(payload[0:3], "little")
+    return port == 3306 and 0 < ln <= len(payload) - 4
+
+
+def parse_mysql(payload: bytes) -> L7Message | None:
+    try:
+        if len(payload) < 5:
+            return None
+        seq = payload[3]
+        cmd = payload[4]
+        if seq == 0 and cmd in _COM_NAMES:  # request
+            stmt = ""
+            if cmd in (_COM_QUERY, _COM_STMT_PREPARE):
+                stmt = obfuscate_sql(payload[5:].decode(errors="replace"))
+            verb = stmt.split(" ", 1)[0].upper() if stmt else _COM_NAMES[cmd]
+            return L7Message(
+                protocol=L7Protocol.MYSQL,
+                msg_type=MSG_REQUEST,
+                request_type=verb,
+                request_resource=stmt,
+                endpoint=verb,
+            )
+        if cmd == 0x00 and seq > 0:  # OK packet
+            return L7Message(protocol=L7Protocol.MYSQL, msg_type=MSG_RESPONSE)
+        if cmd == 0xFF and seq > 0:  # ERR packet
+            code = int.from_bytes(payload[5:7], "little") if len(payload) >= 7 else 0
+            status = STATUS_CLIENT_ERROR if 1000 <= code < 2000 else STATUS_SERVER_ERROR
+            return L7Message(
+                protocol=L7Protocol.MYSQL,
+                msg_type=MSG_RESPONSE,
+                status=status,
+                status_code=code,
+            )
+        return None
+    except Exception:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# registry + inference (the check_payload trial loop, protocol_logs/mod.rs)
+
+_PARSERS: list[tuple[int, object, object]] = [
+    (L7Protocol.HTTP1, check_http, parse_http),
+    (L7Protocol.DNS, check_dns, parse_dns),
+    (L7Protocol.REDIS, check_redis, parse_redis),
+    (L7Protocol.MYSQL, check_mysql, parse_mysql),
+]
+
+_PORT_HINTS = {53: L7Protocol.DNS, 3306: L7Protocol.MYSQL, 6379: L7Protocol.REDIS}
+
+
+def infer_protocol(payload: bytes, server_port: int = 0) -> int:
+    """Try each parser's cheap probe; port hints break ties first."""
+    hint = _PORT_HINTS.get(server_port)
+    ordered = sorted(_PARSERS, key=lambda p: 0 if p[0] == hint else 1)
+    for proto, check, _ in ordered:
+        try:
+            if check.__code__.co_argcount > 1:  # port-aware probes
+                if check(payload, server_port):
+                    return proto
+            elif check(payload):
+                return proto
+        except Exception:
+            continue
+    return L7Protocol.UNKNOWN
+
+
+def parse_payload(protocol: int, payload: bytes) -> L7Message | None:
+    for proto, _, parse in _PARSERS:
+        if proto == protocol:
+            return parse(payload)
+    return None

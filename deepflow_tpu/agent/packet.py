@@ -54,6 +54,7 @@ class PacketBatch:
     seq: np.ndarray  # [N] u32
     ack: np.ndarray  # [N] u32
     payload_len: np.ndarray  # [N] u32 (L4 payload bytes)
+    payload_off: np.ndarray  # [N] u32 (offset of the L4 payload in the snap)
     packet_len: np.ndarray  # [N] u32 (on-wire length incl. L2)
     tunnel_type: np.ndarray  # [N] u32 (0 none, 1 vxlan)
     valid: np.ndarray  # [N] bool
@@ -88,6 +89,7 @@ class _Headers:
     ack: np.ndarray
     flags: np.ndarray
     payload: np.ndarray
+    payload_off: np.ndarray
     is_udp: np.ndarray
     l4_off: np.ndarray
 
@@ -153,6 +155,7 @@ def _parse_headers(buf: np.ndarray, lengths: np.ndarray, l2_off: np.ndarray) -> 
         ack=np.where(is_tcp, ackn, 0).astype(np.uint32),
         flags=np.where(is_tcp, flags, 0).astype(np.uint32),
         payload=payload.astype(np.uint32),
+        payload_off=(l4_c + l4_hdr).astype(np.uint32),
         is_udp=is_udp,
         l4_off=l4_off,
     )
@@ -205,6 +208,7 @@ def parse_packets(
         seq=h.seq,
         ack=h.ack,
         payload_len=h.payload,
+        payload_off=h.payload_off,
         packet_len=lengths,
         tunnel_type=tunnel,
         valid=h.ok,

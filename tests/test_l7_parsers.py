@@ -1,0 +1,191 @@
+"""L7 parser/engine tests: golden parses per protocol, inference,
+obfuscation, session pairing with RRT, timeout sessions, engine e2e
+from crafted packets into both emission shapes."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from deepflow_tpu.agent.l7.engine import STATUS_TIMEOUT, TYPE_SESSION, L7Engine
+from deepflow_tpu.agent.l7.parsers import (
+    MSG_REQUEST,
+    MSG_RESPONSE,
+    STATUS_CLIENT_ERROR,
+    STATUS_OK,
+    STATUS_SERVER_ERROR,
+    infer_protocol,
+    obfuscate_sql,
+    parse_dns,
+    parse_http,
+    parse_mysql,
+    parse_redis,
+)
+from deepflow_tpu.agent.packet import TCP_ACK, TCP_PSH, craft_tcp, craft_udp, parse_packets, to_batch
+from deepflow_tpu.datamodel.code import L7Protocol
+from deepflow_tpu.datamodel.schema import APP_METER
+
+T0 = 1_700_000_000
+CLI, SRV = 0x0A000001, 0x0A000002
+
+HTTP_REQ = (
+    b"GET /api/v1/items/42?page=2 HTTP/1.1\r\nHost: shop.example.com\r\n"
+    b"User-Agent: x\r\n\r\n"
+)
+HTTP_RESP = b"HTTP/1.1 404 Not Found\r\nContent-Length: 0\r\n\r\n"
+
+
+def _dns_query(txid=0x1234, name=b"api.example.com", qtype=1):
+    head = txid.to_bytes(2, "big") + b"\x01\x00\x00\x01\x00\x00\x00\x00\x00\x00"
+    q = b"".join(len(p).to_bytes(1, "big") + p for p in name.split(b".")) + b"\x00"
+    return head + q + qtype.to_bytes(2, "big") + b"\x00\x01"
+
+
+def _dns_resp(txid=0x1234, name=b"api.example.com", rcode=0):
+    head = txid.to_bytes(2, "big") + (0x8180 | rcode).to_bytes(2, "big") + b"\x00\x01\x00\x01\x00\x00\x00\x00"
+    q = b"".join(len(p).to_bytes(1, "big") + p for p in name.split(b".")) + b"\x00"
+    return head + q + b"\x00\x01\x00\x01"
+
+
+def test_http_parse():
+    req = parse_http(HTTP_REQ)
+    assert req.msg_type == MSG_REQUEST
+    assert req.request_type == "GET"
+    assert req.request_domain == "shop.example.com"
+    assert req.request_resource == "/api/v1/items/42"
+    assert req.endpoint == "/api/v1"  # first two segments
+    resp = parse_http(HTTP_RESP)
+    assert resp.msg_type == MSG_RESPONSE
+    assert resp.status_code == 404 and resp.status == STATUS_CLIENT_ERROR
+
+
+def test_dns_parse():
+    q = parse_dns(_dns_query())
+    assert q.msg_type == MSG_REQUEST
+    assert q.request_domain == "api.example.com"
+    assert q.request_type == "A" and q.request_id == 0x1234
+    r = parse_dns(_dns_resp(rcode=3))
+    assert r.msg_type == MSG_RESPONSE
+    assert r.status == STATUS_CLIENT_ERROR  # NXDOMAIN
+
+
+def test_redis_parse():
+    req = parse_redis(b"*2\r\n$3\r\nGET\r\n$7\r\nuser:42\r\n")
+    assert req.msg_type == MSG_REQUEST
+    assert req.request_type == "GET" and req.endpoint == "GET"
+    err = parse_redis(b"-ERR unknown command\r\n")
+    assert err.status == STATUS_SERVER_ERROR
+    ok = parse_redis(b"+OK\r\n")
+    assert ok.msg_type == MSG_RESPONSE and ok.status == STATUS_OK
+
+
+def test_mysql_parse_and_obfuscation():
+    stmt = b"SELECT * FROM users WHERE id = 42 AND name = 'bob'"
+    pkt = (len(stmt) + 1).to_bytes(3, "little") + b"\x00\x03" + stmt
+    req = parse_mysql(pkt)
+    assert req.msg_type == MSG_REQUEST
+    assert req.request_type == "SELECT"
+    assert "42" not in req.request_resource and "bob" not in req.request_resource
+    err = parse_mysql(b"\x09\x00\x00\x01\xff\x28\x04error")
+    assert err.msg_type == MSG_RESPONSE and err.status_code == 0x428
+    assert obfuscate_sql("a = 'x', b = 12.5") == "a = ?, b = ?"
+
+
+def test_inference():
+    assert infer_protocol(HTTP_REQ) == L7Protocol.HTTP1
+    assert infer_protocol(_dns_query(), 53) == L7Protocol.DNS
+    assert infer_protocol(b"*1\r\n$4\r\nPING\r\n", 6379) == L7Protocol.REDIS
+    stmt = b"\x06\x00\x00\x00\x03SELECT"
+    assert infer_protocol(stmt, 3306) == L7Protocol.MYSQL
+    assert infer_protocol(b"\x00\x01\x02\x03garbage") == L7Protocol.UNKNOWN
+
+
+def _packets(specs):
+    """specs: (src, dst, sport, dport, payload, ts_s, ts_us)"""
+    pkts = [
+        craft_tcp(s, d, sp, dp, flags=TCP_ACK | TCP_PSH, seq=100 + 10 * i, payload=pl)
+        if dp != 53 and sp != 53
+        else craft_udp(s, d, sp, dp, pl)
+        for i, (s, d, sp, dp, pl, *_t) in enumerate(specs)
+    ]
+    buf, lengths, ts_s, ts_us = to_batch(
+        pkts, [t[5] for t in specs], [t[6] for t in specs], snap=512
+    )
+    return buf, parse_packets(buf, lengths, ts_s, ts_us)
+
+
+def test_engine_http_session_rrt():
+    eng = L7Engine()
+    buf, p = _packets(
+        [
+            (CLI, SRV, 40000, 8080, HTTP_REQ, T0, 1000),
+            (SRV, CLI, 8080, 40000, HTTP_RESP, T0, 251000),
+        ]
+    )
+    logs, apps = eng.process(buf, p)
+    rows = logs.to_rows()
+    assert len(rows) == 1
+    r = rows[0]
+    assert r["type"] == TYPE_SESSION
+    assert r["response_duration"] == 250000  # µs
+    assert r["request_domain"] == "shop.example.com"
+    assert r["endpoint"] == "/api/v1"
+    assert r["status_code"] == 404
+    m = apps.meters[0]
+    assert m[APP_METER.index("request")] == 1
+    assert m[APP_METER.index("response")] == 1
+    assert m[APP_METER.index("rrt_sum")] == 250000
+    assert m[APP_METER.index("client_error")] == 1
+
+
+def test_engine_dns_pairing_by_txid():
+    eng = L7Engine()
+    # interleaved queries answered out of order — txid pairing
+    buf, p = _packets(
+        [
+            (CLI, SRV, 5000, 53, _dns_query(txid=1, name=b"a.example.com"), T0, 0),
+            (CLI, SRV, 5000, 53, _dns_query(txid=2, name=b"b.example.com"), T0, 1000),
+            (SRV, CLI, 53, 5000, _dns_resp(txid=2, name=b"b.example.com"), T0, 5000),
+            (SRV, CLI, 53, 5000, _dns_resp(txid=1, name=b"a.example.com"), T0, 9000),
+        ]
+    )
+    logs, _ = eng.process(buf, p)
+    rows = {r["request_domain"]: r for r in logs.to_rows()}
+    assert rows["b.example.com"]["response_duration"] == 4000
+    assert rows["a.example.com"]["response_duration"] == 9000
+
+
+def test_engine_timeout_session():
+    eng = L7Engine(session_timeout_s=5)
+    buf, p = _packets([(CLI, SRV, 40000, 8080, HTTP_REQ, T0, 0)])
+    logs, _ = eng.process(buf, p)
+    assert logs.to_rows() == []  # pending
+    # later batch advances the clock past the timeout
+    buf2, p2 = _packets([(CLI, SRV, 41000, 9999, b"\x00unparseable", T0 + 10, 0)])
+    logs2, apps2 = eng.process(buf2, p2)
+    rows = logs2.to_rows()
+    assert len(rows) == 1
+    assert rows[0]["status"] == STATUS_TIMEOUT
+    assert apps2.meters[0][APP_METER.index("timeout")] == 1
+    assert apps2.meters[0][APP_METER.index("response")] == 0
+
+
+def test_engine_evicts_idle_flows_and_orphan_identity():
+    eng = L7Engine(session_timeout_s=5)
+    buf, p = _packets(
+        [
+            (CLI, SRV, 40000, 8080, HTTP_REQ, T0, 0),
+            (SRV, CLI, 8080, 40000, HTTP_RESP, T0, 1000),
+            # orphan response on another flow (request never captured)
+            (SRV, CLI, 8080, 41000, HTTP_RESP, T0, 2000),
+        ]
+    )
+    logs, _ = eng.process(buf, p)
+    rows = logs.to_rows()
+    orphan = [r for r in rows if r["type"] == 1][0]
+    # identity swapped: client port is the ephemeral side
+    assert orphan["client_port"] == 41000 and orphan["server_port"] == 8080
+    assert orphan["ip0_w3"] == CLI and orphan["ip1_w3"] == SRV
+    # flows evicted once idle beyond 2x session timeout
+    buf2, p2 = _packets([(CLI, SRV, 42000, 9999, b"\x00x", T0 + 30, 0)])
+    eng.process(buf2, p2)
+    assert len(eng._flows) <= 1  # only the fresh unparseable flow remains
