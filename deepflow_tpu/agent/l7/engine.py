@@ -49,11 +49,9 @@ TYPE_RESPONSE = 1
 TYPE_SESSION = 2
 
 
-def _hash_str(s: str) -> int:
-    h = 2166136261
-    for b in s.encode():
-        h = ((h ^ b) * 16777619) & 0xFFFFFFFF
-    return h
+# the wire codec's endpoint hash — ONE hash per endpoint string across
+# the packet path and the wire-decode path, or per-endpoint series split
+from ...ingest.codec import _hash_str
 
 
 @dataclasses.dataclass
@@ -150,23 +148,27 @@ class L7Engine:
         if msg.msg_type == MSG_REQUEST:
             if len(fl.pending) >= _MAX_PENDING:
                 evicted = fl.pending.popleft()
-                if evicted.msg.request_id:  # keep by_id in sync
+                if evicted.msg.request_id is not None:  # keep by_id in sync
                     fl.by_id.pop(evicted.msg.request_id, None)
             entry = _Pending(msg, ts_us, ident)
             fl.pending.append(entry)
-            if msg.request_id:
+            if msg.request_id is not None:
                 fl.by_id[msg.request_id] = entry
         else:
+            if 100 <= msg.status_code < 200:
+                # informational (100 Continue): not a final response —
+                # pairing on it would orphan the real one
+                return
             entry = None
-            if msg.request_id and msg.request_id in fl.by_id:
+            if msg.request_id is not None and msg.request_id in fl.by_id:
                 entry = fl.by_id.pop(msg.request_id)
                 try:
                     fl.pending.remove(entry)
                 except ValueError:
                     pass
-            elif fl.pending:
+            elif msg.request_id is None and fl.pending:
                 entry = fl.pending.popleft()
-                if entry.msg.request_id:
+                if entry.msg.request_id is not None:
                     fl.by_id.pop(entry.msg.request_id, None)
             self.counters["sessions"] += 1
             if entry is None:
@@ -199,7 +201,7 @@ class L7Engine:
         for key, fl in list(self._flows.items()):
             while fl.pending and now_us - fl.pending[0].ts_us > limit:
                 entry = fl.pending.popleft()
-                if entry.msg.request_id:
+                if entry.msg.request_id is not None:
                     fl.by_id.pop(entry.msg.request_id, None)
                 self.counters["timeouts"] += 1
                 sessions.append(
@@ -244,7 +246,7 @@ class L7Engine:
             ints[r, ii("type")] = (
                 TYPE_SESSION if req and resp else TYPE_REQUEST if req else TYPE_RESPONSE
             )
-            ints[r, ii("request_id")] = head.request_id if head else 0
+            ints[r, ii("request_id")] = (head.request_id or 0) if head else 0
             ints[r, ii("status")] = status
             ints[r, ii("status_code")] = resp.status_code if resp else 0
             ints[r, ii("start_time")] = sess.get("req_ts_us", sess["ts_us"]) // 1_000_000
@@ -256,7 +258,10 @@ class L7Engine:
                 strs["request_domain"][r] = req.request_domain
                 strs["request_resource"][r] = req.request_resource
                 strs["endpoint"][r] = req.endpoint
-            if resp and resp.request_resource and not req:
+            if resp and resp.request_resource and resp.status in (
+                STATUS_CLIENT_ERROR,
+                STATUS_SERVER_ERROR,
+            ):
                 strs["response_exception"][r] = resp.request_resource
 
             # AppMeter record (fill_l7_stats inputs)

@@ -38,7 +38,9 @@ class L7Message:
     request_domain: str = ""  # host / db / query name
     request_resource: str = ""  # path / statement / key
     endpoint: str = ""  # normalized resource
-    request_id: int = 0  # dns id / mysql seq — pairs req↔resp
+    # pairing id (DNS txid…). None = protocol has no ids (FIFO pairing);
+    # 0 is a VALID id — DNS txids may legitimately be zero
+    request_id: int | None = None
     status: int = STATUS_OK
     status_code: int = 0
 
@@ -267,6 +269,11 @@ def parse_mysql(payload: bytes) -> L7Message | None:
                 status=status,
                 status_code=code,
             )
+        if seq > 0 and 0x01 <= cmd <= 0xFA:
+            # resultset reply: first packet carries the column count —
+            # SELECTs answer with these, not OK packets (mysql.rs
+            # resultset handling); success response
+            return L7Message(protocol=L7Protocol.MYSQL, msg_type=MSG_RESPONSE)
         return None
     except Exception:
         return None

@@ -1,0 +1,4 @@
+"""Third-party telemetry integration: agent-side HTTP intake
+(integration_collector.rs seat) and the wire decoders shared with the
+server-side ingesters (ext_metrics / prometheus / profile / OTel).
+"""

@@ -1,0 +1,42 @@
+"""Self-telemetry loop closure: StatsCollector → dfstats wire frames.
+
+The reference serializes every component's counters as InfluxDB points
+and ships them into its own ext_metrics pipeline as `deepflow_stats`
+(server/libs/stats/stats.go:89-202). `stats_sink(sender)` is that loop
+for this framework: attach it to a StatsCollector and counter snapshots
+flow over DFSTATS frames into the deepflow_stats tables, queryable with
+the same SQL engine as everything else.
+"""
+
+from __future__ import annotations
+
+from ..ingest.sender import UniformSender
+from ..utils.stats import StatsPoint
+
+
+def points_to_influx(points: list[StatsPoint]) -> str:
+    lines = []
+    for p in points:
+        tags = "".join(
+            f",{k}={str(v).replace(' ', '_').replace(',', '_')}" for k, v in p.tags
+        )
+        fields = ",".join(
+            f"{k}={float(v)}" for k, v in p.fields.items() if isinstance(v, (int, float))
+        )
+        if not fields:
+            continue
+        lines.append(f"{p.module}{tags} {fields} {int(p.timestamp * 1e9)}")
+    return "\n".join(lines)
+
+
+def stats_sink(sender: UniformSender):
+    """→ a sink callable for StatsCollector.add_sink."""
+
+    def sink(points: list[StatsPoint]) -> None:
+        if not points:
+            return
+        text = points_to_influx(points)
+        if text:
+            sender.send([text.encode()])
+
+    return sink

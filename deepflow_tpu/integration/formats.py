@@ -1,0 +1,337 @@
+"""Wire-format decoders for integration telemetry.
+
+Four formats, each hand-rolled and dependency-free like the rest of the
+codec layer:
+
+* InfluxDB line protocol (Telegraf) — `integration_collector.rs`
+  forwards raw lines; the server's ext_metrics decoder parses them
+  (server/ingester/ext_metrics/decoder.go).
+* Prometheus remote-write WriteRequest protobuf (prometheus/decoder).
+  Snappy framing is NOT implemented (no snappy in the image) — senders
+  must use Content-Encoding: identity or gzip; the HTTP layer gates it.
+* OTLP ExportTraceServiceRequest protobuf subset — enough of
+  opentelemetry.proto.trace.v1 to build l7_flow_log span rows
+  (flow_log/decoder.go:244 OTel path).
+* Pyroscope "folded" stacks text (profile/decoder).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..ingest.codec import _get_varint, _iter_fields
+
+# ---------------------------------------------------------------------------
+# InfluxDB line protocol
+
+
+@dataclasses.dataclass
+class InfluxPoint:
+    measurement: str
+    tags: dict[str, str]
+    fields: dict[str, float]
+    timestamp_ns: int  # 0 = unset
+
+
+def _split_escaped(s: str, sep: str) -> list[str]:
+    out, cur, esc = [], [], False
+    for ch in s:
+        if esc:
+            cur.append(ch)
+            esc = False
+        elif ch == "\\":
+            esc = True
+        elif ch == sep:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    out.append("".join(cur))
+    return out
+
+
+def parse_influx_lines(text: str) -> tuple[list[InfluxPoint], int]:
+    """→ (points, error_count). One bad line never kills the batch."""
+    points, errors = [], 0
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            points.append(_parse_influx_line(line))
+        except Exception:
+            errors += 1
+    return points, errors
+
+
+def _parse_influx_line(line: str) -> InfluxPoint:
+    # measurement[,tag=v...] field=v[,field=v...] [timestamp]
+    # split on unescaped spaces into ≤3 parts
+    parts, cur, esc, quoted = [], [], False, False
+    for ch in line:
+        if esc:
+            cur.append(ch)
+            esc = False
+        elif ch == "\\":
+            cur.append(ch)
+            esc = True
+        elif ch == '"':
+            quoted = not quoted
+            cur.append(ch)
+        elif ch == " " and not quoted and len(parts) < 2:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    parts.append("".join(cur))
+    if len(parts) < 2:
+        raise ValueError("missing fields")
+    head = _split_escaped(parts[0], ",")
+    measurement = head[0]
+    if not measurement:
+        raise ValueError("empty measurement")
+    tags = {}
+    for t in head[1:]:
+        k, _, v = t.partition("=")
+        if k:
+            tags[k] = v
+    fields: dict[str, float] = {}
+    for f in _split_escaped(parts[1], ","):
+        k, _, v = f.partition("=")
+        if not k or v == "":
+            raise ValueError(f"bad field {f!r}")
+        if v.startswith('"'):
+            continue  # string fields are not numeric metrics
+        if v.endswith(("i", "u")):
+            fields[k] = float(int(v[:-1]))
+        elif v in ("t", "T", "true", "True"):
+            fields[k] = 1.0
+        elif v in ("f", "F", "false", "False"):
+            fields[k] = 0.0
+        else:
+            fields[k] = float(v)
+    if not fields:
+        raise ValueError("no numeric fields")
+    ts = int(parts[2]) if len(parts) > 2 and parts[2] else 0
+    return InfluxPoint(measurement, tags, fields, ts)
+
+
+# ---------------------------------------------------------------------------
+# Prometheus remote-write protobuf (prompb.WriteRequest)
+
+
+@dataclasses.dataclass
+class PromSeries:
+    labels: dict[str, str]  # includes __name__
+    samples: list[tuple[int, float]]  # (timestamp_ms, value)
+
+
+def parse_remote_write(body: bytes) -> list[PromSeries]:
+    """prompb: WriteRequest{timeseries=1}; TimeSeries{labels=1 Label
+    {name=1,value=2}, samples=2 Sample{value=1 double, timestamp=2}}."""
+    import struct
+
+    series = []
+    for field, ts_bytes in _iter_fields(body):
+        if field != 1 or not isinstance(ts_bytes, (bytes, bytearray)):
+            continue
+        labels: dict[str, str] = {}
+        samples: list[tuple[int, float]] = []
+        for f2, v2 in _iter_fields(bytes(ts_bytes)):
+            if f2 == 1 and isinstance(v2, (bytes, bytearray)):
+                name = value = ""
+                for f3, v3 in _iter_fields(bytes(v2)):
+                    if f3 == 1:
+                        name = bytes(v3).decode(errors="replace")
+                    elif f3 == 2:
+                        value = bytes(v3).decode(errors="replace")
+                if name:
+                    labels[name] = value
+            elif f2 == 2 and isinstance(v2, (bytes, bytearray)):
+                val = 0.0
+                ts = 0
+                for f3, v3 in _iter_fields(bytes(v2)):
+                    if f3 == 1:  # fixed64 double
+                        val = struct.unpack("<d", int(v3).to_bytes(8, "little"))[0]
+                    elif f3 == 2:
+                        ts = _zigzag_free_i64(v3)
+                samples.append((ts, val))
+        if labels:
+            series.append(PromSeries(labels, samples))
+    return series
+
+
+def _zigzag_free_i64(v: int) -> int:
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def encode_remote_write(series: list[PromSeries]) -> bytes:
+    """Test/SDK-side encoder for the same subset."""
+    import struct
+
+    from ..ingest.codec import _put_varint
+
+    out = bytearray()
+    for s in series:
+        ts_buf = bytearray()
+        for name, value in s.labels.items():
+            lb = bytearray()
+            _put_varint(lb, 1 << 3 | 2)
+            _put_varint(lb, len(name.encode()))
+            lb += name.encode()
+            _put_varint(lb, 2 << 3 | 2)
+            _put_varint(lb, len(value.encode()))
+            lb += value.encode()
+            _put_varint(ts_buf, 1 << 3 | 2)
+            _put_varint(ts_buf, len(lb))
+            ts_buf += lb
+        for ts, val in s.samples:
+            sb = bytearray()
+            _put_varint(sb, 1 << 3 | 1)  # fixed64
+            sb += struct.pack("<d", val)
+            _put_varint(sb, 2 << 3 | 0)
+            _put_varint(sb, ts & ((1 << 64) - 1))
+            _put_varint(ts_buf, 2 << 3 | 2)
+            _put_varint(ts_buf, len(sb))
+            ts_buf += sb
+        _put_varint(out, 1 << 3 | 2)
+        _put_varint(out, len(ts_buf))
+        out += ts_buf
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# OTLP trace protobuf subset
+
+
+@dataclasses.dataclass
+class OtelSpan:
+    service: str
+    name: str
+    trace_id: str
+    span_id: str
+    parent_span_id: str
+    kind: int  # 2=server, 3=client
+    start_us: int
+    end_us: int
+    status_code: int  # 0 unset, 1 ok, 2 error
+    attributes: dict[str, str]
+
+
+def _any_value(buf: bytes) -> str:
+    for f, v in _iter_fields(buf):
+        if f == 1:
+            return bytes(v).decode(errors="replace")
+        if f == 2:
+            return "true" if v else "false"
+        if f == 3:
+            return str(_zigzag_free_i64(v))
+        if f == 4:
+            import struct
+
+            return str(struct.unpack("<d", int(v).to_bytes(8, "little"))[0])
+    return ""
+
+
+def _attributes(buf_list: list[bytes]) -> dict[str, str]:
+    out = {}
+    for kv in buf_list:
+        key, val = "", ""
+        try:
+            for f, v in _iter_fields(kv):
+                if f == 1:
+                    key = bytes(v).decode(errors="replace")
+                elif f == 2:
+                    val = _any_value(bytes(v))
+        except Exception:
+            continue
+        if key:
+            out[key] = val
+    return out
+
+
+def parse_otlp_traces(body: bytes) -> list[OtelSpan]:
+    """Malformed sub-messages are skipped, never raised — ingest frames
+    are untrusted."""
+    spans: list[OtelSpan] = []
+    try:
+        resource_spans = [bytes(v) for f, v in _iter_fields(body) if f == 1]
+    except Exception:
+        return spans
+    for rs in resource_spans:
+        service = ""
+        scope_spans = []
+        try:
+            for f2, v2 in _iter_fields(rs):
+                if f2 == 1:  # resource
+                    attrs = [bytes(v3) for f3, v3 in _iter_fields(bytes(v2)) if f3 == 1]
+                    service = _attributes(attrs).get("service.name", "")
+                elif f2 == 2:
+                    scope_spans.append(bytes(v2))
+        except Exception:
+            continue
+        for ss in scope_spans:
+            try:
+                span_bufs = [bytes(v) for f, v in _iter_fields(ss) if f == 2]
+            except Exception:
+                continue
+            for sb in span_bufs:
+                s = _parse_span(service, sb)
+                if s is not None:
+                    spans.append(s)
+    return spans
+
+
+def _parse_span(service: str, buf: bytes) -> OtelSpan | None:
+    s = OtelSpan(service, "", "", "", "", 0, 0, 0, 0, {})
+    attrs = []
+    try:
+        for f3, v3 in _iter_fields(buf):
+            if f3 == 1:
+                s.trace_id = bytes(v3).hex()
+            elif f3 == 2:
+                s.span_id = bytes(v3).hex()
+            elif f3 == 4:
+                s.parent_span_id = bytes(v3).hex()
+            elif f3 == 5:
+                s.name = bytes(v3).decode(errors="replace")
+            elif f3 == 6:
+                s.kind = int(v3)
+            elif f3 == 7:
+                s.start_us = int(v3) // 1000
+            elif f3 == 8:
+                s.end_us = int(v3) // 1000
+            elif f3 == 9:
+                attrs.append(bytes(v3))
+            elif f3 == 15:
+                for f4, v4 in _iter_fields(bytes(v3)):
+                    if f4 == 2:
+                        s.status_code = int(v4)
+        s.attributes = _attributes(attrs)
+        return s
+    except Exception:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Pyroscope folded stacks
+
+
+@dataclasses.dataclass
+class ProfileSample:
+    stack: str  # "a;b;c"
+    value: int
+
+
+def parse_folded(text: str) -> tuple[list[ProfileSample], int]:
+    out, errors = [], 0
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        stack, _, v = line.rpartition(" ")
+        try:
+            out.append(ProfileSample(stack, int(v)))
+        except ValueError:
+            errors += 1
+    return out, errors

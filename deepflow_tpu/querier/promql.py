@@ -1,0 +1,140 @@
+"""PromQL subset over the prometheus.samples table — the app/prometheus
+seat (the reference compiles PromQL onto its CK engine; we evaluate
+directly).
+
+Supported:  [agg by (l1, l2)] (metric{label="v", label!="v"})
+            and rate(metric{...}[Ns])  inside the aggregation
+Instant queries: evaluate at time `t` with a lookback window (last
+sample per series wins, Prometheus staleness semantics simplified).
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from ..storage.store import ColumnarStore
+
+_QUERY_RE = re.compile(
+    r"^\s*(?:(?P<agg>sum|avg|max|min|count)\s*(?:by\s*\((?P<by>[^)]*)\)\s*)?\(\s*)?"
+    r"(?:(?P<rate>rate)\s*\(\s*)?"
+    r"(?P<metric>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<matchers>[^}]*)\})?"
+    r"(?:\[(?P<range>\d+)(?P<range_unit>[smh])\])?"
+    r"(?:\s*\))?(?:\s*\))?\s*$"
+)
+
+_UNIT_S = {"s": 1, "m": 60, "h": 3600}
+
+
+class PromQLError(ValueError):
+    pass
+
+
+def _parse_matchers(text: str | None) -> list[tuple[str, str, str]]:
+    out = []
+    if not text:
+        return out
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        m = re.match(r'^([a-zA-Z_][a-zA-Z0-9_]*)\s*(=~|!=|=)\s*"([^"]*)"$', part)
+        if not m:
+            raise PromQLError(f"bad matcher {part!r}")
+        out.append((m.group(1), m.group(2), m.group(3)))
+    return out
+
+
+def _label_dict(packed: str) -> dict[str, str]:
+    out = {}
+    for kv in packed.split(","):
+        if kv:
+            k, _, v = kv.partition("=")
+            out[k] = v
+    return out
+
+
+def query_instant(
+    store: ColumnarStore,
+    query: str,
+    t: int,
+    *,
+    lookback_s: int = 300,
+    db: str = "prometheus",
+) -> list[dict]:
+    """→ [{"labels": {...}, "value": float}] — instant vector at time t."""
+    m = _QUERY_RE.match(query)
+    if not m:
+        raise PromQLError(f"unsupported query {query!r}")
+    agg = m.group("agg")
+    by = [s.strip() for s in (m.group("by") or "").split(",") if s.strip()]
+    is_rate = bool(m.group("rate"))
+    window = (
+        int(m.group("range")) * _UNIT_S[m.group("range_unit")]
+        if m.group("range")
+        else lookback_s
+    )
+    matchers = _parse_matchers(m.group("matchers"))
+    if is_rate and not m.group("range"):
+        raise PromQLError("rate() needs a [range]")
+
+    cols = store.scan(db, "samples", time_range=(t - window, t + 1))
+    sel = cols["metric"] == m.group("metric")
+    labels_packed = cols["labels"]
+    rows = np.nonzero(sel)[0]
+    series: dict[str, list[tuple[int, float]]] = {}
+    for i in rows:
+        packed = str(labels_packed[i])
+        lab = _label_dict(packed)
+        keep = True
+        for name, op, val in matchers:
+            have = lab.get(name, "")
+            if op == "=" and have != val:
+                keep = False
+            elif op == "!=" and have == val:
+                keep = False
+            elif op == "=~" and not re.fullmatch(val, have):
+                keep = False
+        if keep:
+            series.setdefault(packed, []).append(
+                (int(cols["time"][i]), float(cols["value"][i]))
+            )
+
+    # per-series instant value
+    per_series: dict[str, float] = {}
+    for packed, samples in series.items():
+        samples.sort()
+        if is_rate:
+            if len(samples) < 2:
+                continue
+            dt = samples[-1][0] - samples[0][0]
+            dv = samples[-1][1] - samples[0][1]
+            per_series[packed] = dv / dt if dt > 0 else 0.0
+        else:
+            per_series[packed] = samples[-1][1]
+
+    if agg is None:
+        return [
+            {"labels": _label_dict(p), "value": v} for p, v in sorted(per_series.items())
+        ]
+    groups: dict[tuple, list[float]] = {}
+    for packed, v in per_series.items():
+        lab = _label_dict(packed)
+        key = tuple((b, lab.get(b, "")) for b in by)
+        groups.setdefault(key, []).append(v)
+    out = []
+    for key, vals in sorted(groups.items()):
+        if agg == "sum":
+            v = sum(vals)
+        elif agg == "avg":
+            v = sum(vals) / len(vals)
+        elif agg == "max":
+            v = max(vals)
+        elif agg == "min":
+            v = min(vals)
+        else:
+            v = float(len(vals))
+        out.append({"labels": dict(key), "value": v})
+    return out

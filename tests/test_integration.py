@@ -1,0 +1,238 @@
+"""Integration plane tests: format decoders, HTTP collector → server
+ingesters over real sockets, dfstats self-telemetry loop, PromQL subset,
+flame graphs."""
+
+from __future__ import annotations
+
+import gzip
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deepflow_tpu.integration.collector import IntegrationCollector
+from deepflow_tpu.integration.dfstats import points_to_influx, stats_sink
+from deepflow_tpu.integration.formats import (
+    PromSeries,
+    encode_remote_write,
+    parse_influx_lines,
+    parse_otlp_traces,
+    parse_remote_write,
+    parse_folded,
+)
+from deepflow_tpu.ingest.framing import MessageType
+from deepflow_tpu.ingest.receiver import Receiver
+from deepflow_tpu.ingest.sender import UniformSender
+from deepflow_tpu.querier.profile import flame_tree, query_flame
+from deepflow_tpu.querier.promql import PromQLError, query_instant
+from deepflow_tpu.server.integration import IntegrationIngester
+from deepflow_tpu.storage.store import ColumnarStore
+from deepflow_tpu.utils.stats import StatsCollector
+
+T0 = 1_700_000_000
+
+
+def test_influx_line_parse():
+    pts, errors = parse_influx_lines(
+        'cpu,host=web-1,az=a usage=0.5,count=3i 1700000000000000000\n'
+        'mem,host=web-1 used=12.5\n'
+        'bad line without fields\n'
+        'esc\\,aped,t=v\\ x f=1i'
+    )
+    assert errors == 1
+    assert pts[0].measurement == "cpu"
+    assert pts[0].tags == {"host": "web-1", "az": "a"}
+    assert pts[0].fields == {"usage": 0.5, "count": 3.0}
+    assert pts[0].timestamp_ns == 1700000000000000000
+    assert pts[2].measurement == "esc,aped"
+    assert pts[2].tags == {"t": "v x"}
+
+
+def test_remote_write_roundtrip():
+    series = [
+        PromSeries({"__name__": "http_requests_total", "job": "api", "code": "200"},
+                   [(T0 * 1000, 10.0), ((T0 + 30) * 1000, 25.0)]),
+        PromSeries({"__name__": "up", "job": "api"}, [(T0 * 1000, 1.0)]),
+    ]
+    dec = parse_remote_write(encode_remote_write(series))
+    assert len(dec) == 2
+    assert dec[0].labels["__name__"] == "http_requests_total"
+    assert dec[0].samples == [(T0 * 1000, 10.0), ((T0 + 30) * 1000, 25.0)]
+
+
+def _otlp_body():
+    # build via the generic pb helpers: one resource span with service.name
+    from deepflow_tpu.ingest.codec import _put_varint
+
+    def ld(field, payload):
+        b = bytearray()
+        _put_varint(b, field << 3 | 2)
+        _put_varint(b, len(payload))
+        b += payload
+        return bytes(b)
+
+    def vi(field, v):
+        b = bytearray()
+        _put_varint(b, field << 3 | 0)
+        _put_varint(b, v)
+        return bytes(b)
+
+    sname = ld(1, b"service.name") + ld(2, ld(1, b"checkout"))
+    resource = ld(1, ld(1, sname))  # ResourceSpans.resource = Resource{attributes}
+    span = (
+        ld(1, bytes(16))  # trace_id
+        + ld(2, bytes.fromhex("00000000000000aa"))
+        + ld(5, b"GET /cart")
+        + vi(6, 2)  # SPAN_KIND_SERVER
+        + vi(7, T0 * 10**9)
+        + vi(8, (T0 * 10**9) + 5_000_000)  # 5ms
+        + ld(9, ld(1, b"http.method") + ld(2, ld(1, b"GET")))
+        + ld(9, ld(1, b"http.status_code") + ld(2, ld(1, b"200")))
+        + ld(15, vi(2, 0))
+    )
+    scope_spans = ld(2, ld(2, span))  # ResourceSpans.scope_spans = ScopeSpans{spans}
+    return ld(1, resource + scope_spans)
+
+
+def test_otlp_parse():
+    spans = parse_otlp_traces(_otlp_body())
+    assert len(spans) == 1
+    s = spans[0]
+    assert s.service == "checkout"
+    assert s.name == "GET /cart"
+    assert s.kind == 2
+    assert s.end_us - s.start_us == 5000
+    assert s.attributes["http.method"] == "GET"
+
+
+def test_folded_parse_and_flame_tree():
+    samples, errors = parse_folded("a;b;c 10\na;b 5\na;b;d 1\nbad\n")
+    assert errors == 1
+    tree = flame_tree([s.stack for s in samples], [s.value for s in samples])
+    assert tree["total_value"] == 16
+    a = tree["children"][0]
+    assert a["name"] == "a" and a["total_value"] == 16
+    b = a["children"][0]
+    assert b["self_value"] == 5 and b["total_value"] == 16
+
+
+@pytest.fixture()
+def stack():
+    recv = Receiver()
+    recv.start()
+    store = ColumnarStore()
+    ing = IntegrationIngester(recv, store, writer_args={"flush_interval_s": 0.05})
+    col = IntegrationCollector([("127.0.0.1", recv.tcp_port)])
+    yield recv, store, ing, col
+    col.stop()
+    ing.stop()
+    recv.stop()
+
+
+def _post(port, path, body, headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=body, headers=headers or {}
+    )
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status
+    except urllib.error.HTTPError as e:
+        return e.code
+
+
+def _wait(cond, timeout=20.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_http_collector_to_ingesters_e2e(stack):
+    recv, store, ing, col = stack
+    # telegraf (gzip), prometheus (identity), profile, otel
+    influx = f"cpu,host=h1 usage=0.7 {T0}000000000\ncpu,host=h2 usage=0.2 {T0}000000000"
+    assert _post(col.port, "/influxdb/api/v2/write", gzip.compress(influx.encode()),
+                 {"Content-Encoding": "gzip"}) == 200
+    rw = encode_remote_write(
+        [PromSeries({"__name__": "up", "job": "api"}, [(T0 * 1000, 1.0)])]
+    )
+    assert _post(col.port, "/api/v1/prom/write", rw) == 204
+    assert _post(col.port, "/api/v1/prom/write", b"x", {"Content-Encoding": "snappy"}) == 415
+    prof = f"svc-a\x00cpu\x00{T0}\nmain;work;hot 90\nmain;idle 10".encode()
+    assert _post(col.port, "/api/v1/profile", prof) == 200
+    assert _post(col.port, "/v1/traces", _otlp_body()) == 200
+    assert _post(col.port, "/nope", b"") == 404
+
+    assert _wait(lambda: ing.get_counters()["rows_written"] >= 2 + 1 + 2 + 1), ing.get_counters()
+    ing.flush()
+
+    ext = store.scan("ext_metrics", "metrics")
+    assert len(ext["time"]) == 2 and set(ext["field_name"]) == {"usage"}
+    prom = store.scan("prometheus", "samples")
+    assert prom["metric"][0] == "up" and prom["value"][0] == 1.0
+    tree = query_flame(store, app_service="svc-a")
+    assert tree["total_value"] == 100
+    l7 = store.scan("flow_log", "l7_flow_log", columns=["app_service", "endpoint", "response_duration"])
+    assert l7["app_service"][0] == "checkout"
+    assert l7["response_duration"][0] == 5000
+
+
+def test_dfstats_loop(stack):
+    recv, store, ing, col = stack
+    sc = StatsCollector(interval_s=999)
+    sc.register("flow_map", lambda: {"packets_in": 42})
+    snd = UniformSender([("127.0.0.1", recv.tcp_port)], MessageType.DFSTATS,
+                        agent_id=1, prefer_native_queue=False)
+    sc.add_sink(stats_sink(snd))
+    sc.tick(now=float(T0))
+    assert _wait(lambda: ing.get_counters()["rows_written"] >= 1)
+    ing.flush()
+    rows = store.scan("deepflow_stats", "stats")
+    assert rows["virtual_table"][0] == "flow_map"
+    assert rows["value"][0] == 42.0
+    snd.close()
+
+
+def test_points_to_influx_format():
+    from deepflow_tpu.utils.stats import StatsPoint
+
+    text = points_to_influx(
+        [StatsPoint(float(T0), "writer", (("db", "flow metrics"),), {"rows": 5})]
+    )
+    assert text == f"writer,db=flow_metrics rows=5.0 {T0}000000000"
+
+
+def test_promql_queries():
+    store = ColumnarStore()
+    from deepflow_tpu.server.integration import PROM_SCHEMA
+
+    store.create_table("prometheus", PROM_SCHEMA)
+    rows = []
+    for job, inst, base in (("api", "i1", 100), ("api", "i2", 200), ("db", "i3", 50)):
+        for k in range(5):
+            rows.append((T0 + 15 * k, "http_total", f"instance={inst},job={job}", base + 10 * k))
+    store.insert(
+        "prometheus",
+        "samples",
+        {
+            "time": np.asarray([r[0] for r in rows], np.uint32),
+            "metric": np.asarray([r[1] for r in rows]),
+            "labels": np.asarray([r[2] for r in rows]),
+            "value": np.asarray([r[3] for r in rows], np.float64),
+        },
+    )
+    t = T0 + 100
+    out = query_instant(store, 'http_total{job="api"}', t)
+    assert len(out) == 2 and {o["value"] for o in out} == {140.0, 240.0}
+    out = query_instant(store, 'sum by (job) (http_total)', t)
+    assert {(o["labels"]["job"], o["value"]) for o in out} == {("api", 380.0), ("db", 90.0)}
+    out = query_instant(store, 'sum by (job) (rate(http_total[2m]))', t)
+    api = [o for o in out if o["labels"]["job"] == "api"][0]
+    assert api["value"] == pytest.approx(2 * (40 / 60))
+    with pytest.raises(PromQLError):
+        query_instant(store, "rate(http_total)", t)
+    with pytest.raises(PromQLError):
+        query_instant(store, "sum by job http_total{", t)

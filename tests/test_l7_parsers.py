@@ -189,3 +189,58 @@ def test_engine_evicts_idle_flows_and_orphan_identity():
     buf2, p2 = _packets([(CLI, SRV, 42000, 9999, b"\x00x", T0 + 30, 0)])
     eng.process(buf2, p2)
     assert len(eng._flows) <= 1  # only the fresh unparseable flow remains
+
+
+def test_mysql_resultset_is_success_response():
+    from deepflow_tpu.agent.l7.parsers import parse_mysql
+
+    # column-count packet (1 column), seq=1 — a SELECT's resultset reply
+    rs = b"\x01\x00\x00\x01\x01"
+    msg = parse_mysql(rs)
+    assert msg is not None and msg.msg_type == MSG_RESPONSE and msg.status == STATUS_OK
+
+
+def test_http_100_continue_not_paired():
+    eng = L7Engine()
+    cont = b"HTTP/1.1 100 Continue\r\n\r\n"
+    final = b"HTTP/1.1 500 Oops\r\n\r\n"
+    buf, p = _packets(
+        [
+            (CLI, SRV, 40000, 8080, HTTP_REQ, T0, 0),
+            (SRV, CLI, 8080, 40000, cont, T0, 100),
+            (SRV, CLI, 8080, 40000, final, T0, 500),
+        ]
+    )
+    logs, apps = eng.process(buf, p)
+    rows = logs.to_rows()
+    assert len(rows) == 1
+    assert rows[0]["status_code"] == 500  # paired with the FINAL response
+    assert apps.meters[0][APP_METER.index("server_error")] == 1
+
+
+def test_dns_txid_zero_pairs_by_id():
+    eng = L7Engine()
+    buf, p = _packets(
+        [
+            (CLI, SRV, 5000, 53, _dns_query(txid=0, name=b"z.example.com"), T0, 0),
+            (CLI, SRV, 5000, 53, _dns_query(txid=7, name=b"q.example.com"), T0, 100),
+            (SRV, CLI, 53, 5000, _dns_resp(txid=0, name=b"z.example.com"), T0, 300),
+        ]
+    )
+    logs, _ = eng.process(buf, p)
+    rows = logs.to_rows()
+    assert len(rows) == 1
+    assert rows[0]["request_domain"] == "z.example.com"
+    assert rows[0]["response_duration"] == 300
+
+
+def test_paired_error_records_exception():
+    eng = L7Engine()
+    buf, p = _packets(
+        [
+            (CLI, SRV, 40000, 6379, b"*1\r\n$4\r\nPING\r\n", T0, 0),
+            (SRV, CLI, 6379, 40000, b"-ERR bad command\r\n", T0, 100),
+        ]
+    )
+    logs, _ = eng.process(buf, p)
+    assert logs.to_rows()[0]["response_exception"] == "ERR bad command"
