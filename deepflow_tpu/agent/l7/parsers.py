@@ -269,10 +269,12 @@ def parse_mysql(payload: bytes) -> L7Message | None:
                 status=status,
                 status_code=code,
             )
-        if seq > 0 and 0x01 <= cmd <= 0xFA:
-            # resultset reply: first packet carries the column count —
-            # SELECTs answer with these, not OK packets (mysql.rs
-            # resultset handling); success response
+        ln = int.from_bytes(payload[0:3], "little")
+        if seq == 1 and 0x01 <= cmd <= 0xFA and ln <= 9:
+            # resultset reply: the FIRST response packet (seq=1) is a tiny
+            # lenenc column count — SELECTs answer with these, not OK
+            # packets (mysql.rs resultset handling). seq==1 + length≤9
+            # excludes multi-packet request continuations and row packets
             return L7Message(protocol=L7Protocol.MYSQL, msg_type=MSG_RESPONSE)
         return None
     except Exception:

@@ -1,0 +1,136 @@
+"""dfctl — the deepflow-ctl seat (cli/ctl/).
+
+Commands mirror the reference CLI surface that applies to this build:
+
+  dfctl server -f config.yaml            run the composed server
+  dfctl query  --store DIR "SQL"         SQL over a store
+  dfctl promql --store DIR "EXPR" -t T   PromQL instant query
+  dfctl metrics --store DIR TABLE        metric catalog for a table
+  dfctl tables --store DIR               db/table/row inventory
+  dfctl flame  --store DIR --service S   flame tree JSON
+  dfctl counters --port P [--module M]   live counter dump (debug UDP)
+  dfctl agents --port P                  agent liveness (debug UDP)
+  dfctl datasource ... (list/add)        downsampler management
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _store(args):
+    from .storage.store import ColumnarStore
+
+    if not args.store:
+        sys.exit("--store DIR is required for this command")
+    return ColumnarStore(args.store)
+
+
+def cmd_query(args):
+    from .querier import QueryEngine
+
+    r = QueryEngine(_store(args)).execute(args.sql)
+    print(json.dumps(r.to_dicts(), default=str, indent=None))
+
+
+def cmd_promql(args):
+    from .querier.promql import query_instant
+
+    t = args.time or int(time.time())
+    out = query_instant(_store(args), args.expr, t)
+    print(json.dumps(out, default=str))
+
+
+def cmd_metrics(args):
+    from .querier.metrics import list_metrics
+
+    print(json.dumps(list_metrics(args.table), indent=2))
+
+
+def cmd_tables(args):
+    store = _store(args)
+    out = {db: {t: store.row_count(db, t) for t in store.tables(db)} for db in store.databases()}
+    print(json.dumps(out, indent=2))
+
+
+def cmd_flame(args):
+    from .querier.profile import query_flame
+
+    print(json.dumps(query_flame(_store(args), app_service=args.service)))
+
+
+def cmd_debug(args, cmd: str, **extra):
+    from .server.debug import debug_request
+
+    print(json.dumps(debug_request(args.host, args.port, {"cmd": cmd, **extra}), indent=2))
+
+
+def cmd_server(args):
+    from .server.main import Server
+    from .utils.config import load_config
+
+    cfg, unknown = load_config(args.config)
+    for k in unknown:
+        print(f"warning: unknown config key {k}", file=sys.stderr)
+    srv = Server(cfg).start()
+    print(
+        f"server up: receiver tcp/udp :{srv.receiver.tcp_port}/:{srv.receiver.udp_port} "
+        f"debug :{srv.debug.port} trisolaris :{srv.trisolaris.port}"
+    )
+    try:
+        while True:
+            time.sleep(10)
+            srv.tick()
+    except KeyboardInterrupt:
+        srv.stop()
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="dfctl")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sp = sub.add_parser("server")
+    sp.add_argument("-f", "--config", default=None)
+    sp.set_defaults(fn=cmd_server)
+
+    for name, fn, extra in (
+        ("query", cmd_query, [("sql",)]),
+        ("promql", cmd_promql, [("expr",)]),
+        ("metrics", cmd_metrics, [("table",)]),
+        ("tables", cmd_tables, []),
+        ("flame", cmd_flame, []),
+    ):
+        sp = sub.add_parser(name)
+        sp.add_argument("--store", default="")
+        for a in extra:
+            sp.add_argument(*a)
+        if name == "promql":
+            sp.add_argument("-t", "--time", type=int, default=0)
+        if name == "flame":
+            sp.add_argument("--service", required=True)
+        sp.set_defaults(fn=fn)
+
+    for name in ("counters", "agents", "datasources", "ping"):
+        sp = sub.add_parser(name)
+        sp.add_argument("--host", default="127.0.0.1")
+        sp.add_argument("--port", type=int, required=True)
+        if name == "counters":
+            sp.add_argument("--module", default=None)
+        sp.set_defaults(
+            fn=lambda a, _n=name: cmd_debug(
+                a, _n, **({"module": a.module} if _n == "counters" and a.module else {})
+            )
+        )
+
+    args = p.parse_args(argv)
+    try:
+        args.fn(args)
+    except BrokenPipeError:  # `dfctl ... | head` is normal usage
+        sys.stderr.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,4 @@
+"""Control plane: resource registry (MySQL seat), tagrecorder
+(SmartEncoding dictionary materialization), trisolaris-style agent and
+ingester sync, and leader election — the server/controller seat.
+"""

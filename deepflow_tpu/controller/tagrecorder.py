@@ -1,0 +1,69 @@
+"""tagrecorder — materializes resources into flow_tag dictionaries.
+
+The reference runs ~50 `ch_*.go` updaters that diff MySQL resource
+tables into ClickHouse `flow_tag.*_map` dictionaries consumed by the
+querier's dictGet translation (controller/tagrecorder/; SURVEY §3.5).
+Here one updater serves every kind: on a resource-version change it
+rewrites the `<kind>_map` tables in the flow_tag db (id, name + the
+attrs the querier surfaces) and invalidates the translator cache.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..storage.store import ColumnarStore, ColumnSpec, TableSchema
+from .resources import KINDS, ResourceDB
+
+FLOW_TAG_DB = "flow_tag"
+
+
+def _map_schema(kind: str) -> TableSchema:
+    return TableSchema(
+        f"{kind}_map",
+        (
+            ColumnSpec("time", "u4"),
+            ColumnSpec("id", "u4"),
+            ColumnSpec("name", "U256"),
+        ),
+        partition_s=1 << 30,
+    )
+
+
+class TagRecorder:
+    def __init__(self, db: ResourceDB, store: ColumnarStore, translator=None):
+        self.db = db
+        self.store = store
+        self.translator = translator
+        self._synced_version = 0
+        self.counters = {"syncs": 0, "rows": 0}
+
+    def sync(self) -> bool:
+        """Rewrite dictionaries if resources changed; returns whether a
+        sync ran. Full rewrite per changed sync — dictionaries are small
+        relative to telemetry and the reference's incremental diffing is
+        an optimization, not semantics."""
+        version = self.db.version
+        if version == self._synced_version:
+            return False
+        for kind, resources in self.db.iter_kinds():
+            schema = _map_schema(kind)
+            self.store.create_table(FLOW_TAG_DB, schema)
+            for pid in self.store.partitions(FLOW_TAG_DB, schema.name):
+                self.store.drop_partition(FLOW_TAG_DB, schema.name, pid)
+            if resources:
+                self.store.insert(
+                    FLOW_TAG_DB,
+                    schema.name,
+                    {
+                        "time": np.zeros(len(resources), np.uint32),
+                        "id": np.asarray([r.id for r in resources], np.uint32),
+                        "name": np.asarray([r.name for r in resources]),
+                    },
+                )
+                self.counters["rows"] += len(resources)
+        self._synced_version = version
+        self.counters["syncs"] += 1
+        if self.translator is not None:
+            self.translator.invalidate()
+        return True

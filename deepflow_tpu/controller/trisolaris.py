@@ -1,0 +1,194 @@
+"""Agent management & sync — the trisolaris seat.
+
+The reference's trisolaris gRPC service pushes versioned agent configs
+and platform-data snapshots; agents poll `Sync` with their current
+revisions and receive updates only on change, and keep running on the
+last config for `max_escape_duration` when the controller is gone
+(agent/src/config/config.rs:2580; controller/trisolaris/). Same
+contract here over a line-JSON TCP endpoint (the transport is not the
+semantics): `SyncRequest{agent_id, config_rev, platform_version}` →
+`SyncResponse` carrying only what changed, plus agent liveness
+bookkeeping for the controller's monitor.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import socket
+import threading
+import time
+
+from .resources import ResourceDB
+
+
+@dataclasses.dataclass
+class AgentGroupConfig:
+    revision: int = 1
+    # the dynamic UserConfig payload (flat dict; agents overlay it on
+    # their static YAML)
+    config: dict = dataclasses.field(default_factory=dict)
+
+
+class TrisolarisService:
+    def __init__(self, db: ResourceDB, *, host: str = "127.0.0.1", port: int = 0):
+        self.db = db
+        self._groups: dict[str, AgentGroupConfig] = {"default": AgentGroupConfig()}
+        self._agent_group: dict[int, str] = {}
+        self.agents: dict[int, dict] = {}  # liveness registry
+        self._lock = threading.Lock()
+        self.counters = {"syncs": 0, "config_pushes": 0, "platform_pushes": 0}
+
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(16)
+        self.port = self._srv.getsockname()[1]
+        self._running = True
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    # -- config management (REST/agent-group seat) ----------------------
+    def set_group_config(self, group: str, config: dict) -> int:
+        with self._lock:
+            g = self._groups.setdefault(group, AgentGroupConfig())
+            g.config = dict(config)
+            g.revision += 1
+            return g.revision
+
+    def assign_agent(self, agent_id: int, group: str) -> None:
+        with self._lock:
+            self._agent_group[agent_id] = group
+
+    # -- sync protocol --------------------------------------------------
+    def handle_sync(self, req: dict) -> dict:
+        agent_id = int(req.get("agent_id", 0))
+        with self._lock:
+            group = self._agent_group.get(agent_id, "default")
+            g = self._groups.setdefault(group, AgentGroupConfig())
+            self.agents[agent_id] = {
+                "last_seen": time.time(),
+                "group": group,
+                "config_rev": req.get("config_rev", 0),
+            }
+            self.counters["syncs"] += 1
+            resp: dict = {
+                "config_rev": g.revision,
+                "platform_version": self.db.version,
+            }
+            if req.get("config_rev", 0) != g.revision:
+                resp["config"] = g.config
+                self.counters["config_pushes"] += 1
+        if req.get("platform_version", 0) != self.db.version:
+            resp["platform"] = self._platform_snapshot()
+            self.counters["platform_pushes"] += 1
+        return resp
+
+    def _platform_snapshot(self) -> dict:
+        """Compact platform payload: what agents need for local tagging
+        (interfaces + EPCs), not the full info matrix."""
+        vifs = []
+        with self.db._lock:
+            for v in self.db._vifs:
+                vifs.append(
+                    {"epc_id": v["epc_id"], "ips": v["ips"], "mac": v["mac"], "pod_id": v["pod_id"]}
+                )
+        return {"interfaces": vifs}
+
+    # -- TCP line-JSON server -------------------------------------------
+    def _serve(self):
+        while self._running:
+            try:
+                self._srv.settimeout(0.2)
+                conn, _ = self._srv.accept()
+            except (TimeoutError, OSError):
+                continue
+            threading.Thread(target=self._client, args=(conn,), daemon=True).start()
+
+    def _client(self, conn: socket.socket):
+        with conn:
+            f = conn.makefile("rwb")
+            for line in f:
+                try:
+                    req = json.loads(line)
+                    resp = self.handle_sync(req)
+                except Exception:
+                    resp = {"error": "bad request"}
+                f.write(json.dumps(resp).encode() + b"\n")
+                f.flush()
+
+    def stop(self):
+        self._running = False
+        self._thread.join(timeout=2)
+        self._srv.close()
+
+
+class AgentSyncClient:
+    """Agent-side sync loop state with max_escape semantics: the last
+    good config stays active while the controller is unreachable, up to
+    `max_escape_s`, after which the agent reverts to defaults and marks
+    itself disconnected (config.rs:2580 behavior)."""
+
+    def __init__(
+        self,
+        servers: list[tuple[str, int]],
+        agent_id: int,
+        *,
+        max_escape_s: float = 3600.0,
+        defaults: dict | None = None,
+    ):
+        self.servers = servers
+        self.agent_id = agent_id
+        self.max_escape_s = max_escape_s
+        self.defaults = dict(defaults or {})
+        self.config = dict(self.defaults)
+        self.config_rev = 0
+        self.platform_version = 0
+        self.platform: dict = {}
+        self.last_success: float | None = None
+        self.escaped = False
+        self.counters = {"syncs_ok": 0, "syncs_failed": 0, "escapes": 0}
+
+    def sync_once(self, now: float | None = None) -> bool:
+        now = time.time() if now is None else now
+        req = {
+            "agent_id": self.agent_id,
+            "config_rev": self.config_rev,
+            "platform_version": self.platform_version,
+        }
+        for host, port in self.servers:
+            try:
+                with socket.create_connection((host, port), timeout=2.0) as s:
+                    f = s.makefile("rwb")
+                    f.write(json.dumps(req).encode() + b"\n")
+                    f.flush()
+                    resp = json.loads(f.readline())
+            except (OSError, ValueError):
+                continue
+            if "error" in resp:
+                continue
+            if "config" in resp:
+                self.config = {**self.defaults, **resp["config"]}
+            if "platform" in resp:
+                self.platform = resp["platform"]
+            self.config_rev = resp["config_rev"]
+            self.platform_version = resp["platform_version"]
+            self.last_success = now
+            self.escaped = False
+            self.counters["syncs_ok"] += 1
+            return True
+        self.counters["syncs_failed"] += 1
+        self._check_escape(now)
+        return False
+
+    def _check_escape(self, now: float) -> None:
+        if self.last_success is None:
+            return
+        if not self.escaped and now - self.last_success > self.max_escape_s:
+            # escape: revert to static defaults (config.rs:2580). The
+            # revision resets too — a returning controller with an
+            # unchanged revision must still re-push the real config
+            self.config = dict(self.defaults)
+            self.config_rev = 0
+            self.escaped = True
+            self.counters["escapes"] += 1

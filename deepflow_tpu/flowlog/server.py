@@ -67,6 +67,37 @@ def log_table_schema(schema: LogSchema, partition_s: int = 3600) -> TableSchema:
     return TableSchema(schema.name, tuple(cols), partition_s=partition_s)
 
 
+def log_batch_to_columns(
+    batch: FlowLogBatch, enrich0: dict | None = None, enrich1: dict | None = None
+) -> dict[str, np.ndarray]:
+    """FlowLogBatch → storage columns for log_table_schema tables.
+
+    THE one assembly for every l7/l4 log write path (throttled ingest,
+    OTel spans, future sources): time from end_time, raw ints except the
+    enrichment-owned columns, nums, strings, and per-side enrich columns
+    (from the given dicts, falling back to raw agent-reported ints, then
+    zeros)."""
+    schema = batch.schema
+    cols: dict[str, np.ndarray] = {"time": batch.col("end_time").astype(np.uint32)}
+    for i, f in enumerate(schema.ints):
+        if f.name not in _ENRICH_COLS:
+            cols[f.name] = batch.ints[:, i]
+    for i, f in enumerate(schema.nums):
+        cols[f.name] = batch.nums[:, i]
+    for f in schema.strs:
+        cols[f.name] = np.array(batch.strs[f.name] if batch.strs else [""] * batch.size)
+    for side, enriched in ((0, enrich0), (1, enrich1)):
+        for f in ENRICH_FIELDS:
+            name = f"{f}_{side}"
+            if enriched is not None:
+                cols[name] = np.asarray(enriched[f])[: batch.size]
+            elif name in schema._int_idx:
+                cols[name] = batch.ints[:, schema.int_index(name)]
+            else:
+                cols[name] = np.zeros(batch.size, np.uint32)
+    return cols
+
+
 def _tags_for_enrich(batch: FlowLogBatch) -> np.ndarray:
     n = batch.size
     p = max(1, 1 << (n - 1).bit_length())  # pad to pow2 → O(log N) jit shapes
@@ -213,32 +244,11 @@ class FlowLogIngester:
         db = org_db(FLOW_LOG_DB, org)
         schema = self._schemas[mt]
         for batch in sampled:
-            cols: dict[str, np.ndarray] = {"time": batch.col("end_time").astype(np.uint32)}
-            for i, f in enumerate(schema.ints):
-                if f.name not in _ENRICH_COLS:
-                    cols[f.name] = batch.ints[:, i]
-            for i, f in enumerate(schema.nums):
-                cols[f.name] = batch.nums[:, i]
-            for f in schema.strs:
-                cols[f.name] = np.array(
-                    batch.strs[f.name] if batch.strs else [""] * batch.size
-                )
+            s0 = s1 = None
             if self.platform_state is not None:
-                tags, valid, n = _tags_for_enrich(batch)
+                tags, valid, _n = _tags_for_enrich(batch)
                 s0, s1, _keep, _drops = enrich_docs(self.platform_state, tags, valid)
-                for side, sd in ((0, s0), (1, s1)):
-                    for f in ENRICH_FIELDS:
-                        cols[f"{f}_{side}"] = np.asarray(sd[f])[:n]
-            else:
-                for side in (0, 1):
-                    for f in ENRICH_FIELDS:
-                        name = f"{f}_{side}"
-                        # no platform table → raw agent-reported value
-                        # survives where the log carries one
-                        if name in schema._int_idx:
-                            cols[name] = batch.ints[:, schema.int_index(name)]
-                        else:
-                            cols[name] = np.zeros(batch.size, np.uint32)
+            cols = log_batch_to_columns(batch, s0, s1)
             self._writer(db, schema).put(cols)
             with self._lock:
                 self.counters["rows_written"] += batch.size

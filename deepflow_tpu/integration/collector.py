@@ -73,7 +73,7 @@ class IntegrationCollector:
                 if enc == "gzip":
                     try:
                         body = gzip.decompress(body)
-                    except OSError:
+                    except (OSError, EOFError):  # truncated gzip → EOFError
                         collector.counters["bad_requests"] += 1
                         self.send_error(400, "bad gzip body")
                         return

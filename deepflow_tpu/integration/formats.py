@@ -117,6 +117,42 @@ def _parse_influx_line(line: str) -> InfluxPoint:
 
 
 # ---------------------------------------------------------------------------
+# packed dynamic-tag strings (the CK map-column stand-in): values may
+# contain ',' '=' '\' — escape on pack, unescape on parse, one pair of
+# functions shared by the ingesters and the PromQL evaluator
+
+
+def pack_tags(tags: dict[str, str]) -> str:
+    def esc(s: str) -> str:
+        return s.replace("\\", "\\\\").replace(",", "\\,").replace("=", "\\=")
+
+    return ",".join(f"{esc(k)}={esc(v)}" for k, v in sorted(tags.items()))
+
+
+def unpack_tags(packed: str) -> dict[str, str]:
+    out: dict[str, str] = {}
+    key, cur, esc_on = None, [], False
+    for ch in packed:
+        if esc_on:
+            cur.append(ch)
+            esc_on = False
+        elif ch == "\\":
+            esc_on = True
+        elif ch == "=" and key is None:
+            key = "".join(cur)
+            cur = []
+        elif ch == ",":
+            if key is not None:
+                out[key] = "".join(cur)
+            key, cur = None, []
+        else:
+            cur.append(ch)
+    if key is not None:
+        out[key] = "".join(cur)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Prometheus remote-write protobuf (prompb.WriteRequest)
 
 

@@ -47,13 +47,7 @@ def _parse_matchers(text: str | None) -> list[tuple[str, str, str]]:
     return out
 
 
-def _label_dict(packed: str) -> dict[str, str]:
-    out = {}
-    for kv in packed.split(","):
-        if kv:
-            k, _, v = kv.partition("=")
-            out[k] = v
-    return out
+from ..integration.formats import unpack_tags as _label_dict
 
 
 def query_instant(

@@ -79,6 +79,13 @@ class FlowMetricsIngester:
         ]
         for t in self._threads:
             t.start()
+        from ..utils.stats import register_countable
+
+        register_countable("flow_metrics_ingester", self)
+
+    def get_counters(self):
+        with self._lock:
+            return dict(self.counters)
 
     def stop(self, timeout: float = 5.0) -> None:
         self._running = False

@@ -19,18 +19,20 @@ dictionary — queryable by exact match or via the flow_tag catalog):
 from __future__ import annotations
 
 import threading
+import time
 
 import numpy as np
 
 from ..datamodel.code import L7Protocol, SignalSource
 from ..flowlog.aggr import FlowLogBatch
 from ..flowlog.schema import L7_FLOW_LOG
-from ..flowlog.server import log_table_schema
+from ..flowlog.server import log_batch_to_columns, log_table_schema
 from ..ingest.framing import HEADER_LEN, FlowHeader, MessageType, split_messages
 from ..ingest.queues import new_queue
 from ..ingest.receiver import Receiver
 from ..integration.formats import (
     InfluxPoint,
+    pack_tags,
     parse_folded,
     parse_influx_lines,
     parse_otlp_traces,
@@ -72,10 +74,6 @@ PROFILE_SCHEMA = TableSchema(
         ColumnSpec("value", "u8"),
     ),
 )
-
-
-def pack_tags(tags: dict[str, str]) -> str:
-    return ",".join(f"{k}={v}" for k, v in sorted(tags.items()))
 
 
 class IntegrationIngester:
@@ -182,7 +180,10 @@ class IntegrationIngester:
             return
         db = org_db(base_db, org)
         rows = {"time": [], "virtual_table": [], "tags": [], "field_name": [], "value": []}
-        now_fallback = 0
+        # timestamp-less lines get receipt time (line-protocol spec: the
+        # server assigns its clock) — epoch 0 would hide them from every
+        # time-ranged scan
+        now_fallback = int(time.time())
         tag_catalog: dict[str, dict[str, dict[str, int]]] = {}
         for p in points:
             sec = p.timestamp_ns // 1_000_000_000 if p.timestamp_ns else now_fallback
@@ -296,21 +297,7 @@ class IntegrationIngester:
         batch = FlowLogBatch(s, ints, nums, np.ones(n, bool), strs)
         db = org_db("flow_log", org)
         w = self._writer(db, log_table_schema(s))
-        cols: dict[str, np.ndarray] = {"time": batch.col("end_time").astype(np.uint32)}
-        from ..flowlog.server import _ENRICH_COLS
-        from ..enrich.platform import ENRICH_FIELDS
-
-        for i, f in enumerate(s.ints):
-            if f.name not in _ENRICH_COLS:
-                cols[f.name] = batch.ints[:, i]
-        for i, f in enumerate(s.nums):
-            cols[f.name] = batch.nums[:, i]
-        for f in s.strs:
-            cols[f.name] = np.asarray(batch.strs[f.name])
-        for side in (0, 1):
-            for f in ENRICH_FIELDS:
-                cols[f"{f}_{side}"] = np.zeros(n, np.uint32)
-        w.put(cols)
+        w.put(log_batch_to_columns(batch))
         with self._lock:
             self.counters["rows_written"] += n
 

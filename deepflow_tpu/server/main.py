@@ -1,0 +1,154 @@
+"""Server composition root — the cmd/server/main.go seat.
+
+One process wiring every plane the way the reference boots
+(main.go:31-40: controller.Start → ingester.Start → querier.Start):
+config → store → controller (resources, tagrecorder, trisolaris,
+election) → receiver + ingesters (flow metrics, flow logs,
+integrations) → downsampler → debug endpoint → query engine.
+`Server.start()` brings it all up; `tick()` drives the periodic work
+(tagrecorder sync, downsampler, stats) so tests and the CLI can step
+time deterministically; `stop()` tears down in reverse.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from ..controller.election import LeaderElection
+from ..controller.resources import ResourceDB
+from ..controller.tagrecorder import TagRecorder
+from ..controller.trisolaris import TrisolarisService
+from ..flowlog.server import FlowLogIngester
+from ..ingest.receiver import Receiver
+from ..querier import QueryEngine
+from ..querier.translation import Translator
+from ..server.datasource import DataSource, Downsampler
+from ..server.debug import DebugServer
+from ..server.exporters import ExporterHub
+from ..server.flow_metrics import FlowMetricsIngester
+from ..server.integration import IntegrationIngester
+from ..server.metrics_tables import DocStoreWriter
+from ..storage.store import ColumnarStore
+from ..utils.config import ServerConfig, load_config
+from ..utils.stats import default_collector
+
+
+class Server:
+    def __init__(self, config: ServerConfig | None = None, *, exporters=None, lease_path=None):
+        self.config = config or load_config(None)[0]
+        self.exporters = exporters or []
+        self.lease_path = lease_path
+        self.started = False
+
+    def start(self) -> "Server":
+        cfg = self.config
+        self.store = ColumnarStore(cfg.storage.root)
+        self.resources = ResourceDB()
+        self.translator = Translator(self.store)
+        self.tagrecorder = TagRecorder(self.resources, self.store, translator=self.translator)
+        self.trisolaris = TrisolarisService(self.resources)
+        # holder must be unique ACROSS processes — heap addresses collide
+        self.election = (
+            LeaderElection(self.lease_path, holder=f"server-{os.getpid()}-{id(self):x}")
+            if self.lease_path
+            else None
+        )
+        self._platform_version = self.resources.version
+
+        self.receiver = Receiver(
+            host=cfg.receiver.host,
+            tcp_port=cfg.receiver.tcp_port,
+            udp_port=cfg.receiver.udp_port,
+        )
+        self.receiver.start()
+
+        writer_args = {
+            "batch_size": cfg.storage.writer_batch_size,
+            "flush_interval_s": cfg.storage.writer_flush_s,
+        }
+        self.exporter_hub = ExporterHub(self.exporters) if self.exporters else None
+        self.doc_writer = DocStoreWriter(
+            self.store,
+            partition_s=cfg.storage.partition_s,
+            ttl_hours=cfg.storage.ttl_hours,
+            writer_args=writer_args,
+            exporter_hub=self.exporter_hub,
+        )
+        platform_state = self.resources.build_platform_table(cfg.region_id).build()
+        self.flow_metrics = FlowMetricsIngester(
+            self.receiver,
+            self.doc_writer,
+            platform_state=platform_state,
+            n_workers=cfg.ingester.n_decoders,
+            queue_capacity=cfg.ingester.queue_capacity,
+            batch_size=cfg.ingester.batch_size,
+            disable_second_write=cfg.ingester.disable_second_write,
+            prefer_native=cfg.ingester.prefer_native,
+        )
+        self.flow_log = FlowLogIngester(
+            self.receiver,
+            self.store,
+            platform_state=platform_state,
+            l4_throttle=cfg.ingester.l4_throttle,
+            l7_throttle=cfg.ingester.l7_throttle,
+            writer_args=writer_args,
+        )
+        self.integration = IntegrationIngester(self.receiver, self.store, writer_args=writer_args)
+        self.downsampler = Downsampler(self.store)
+        self.debug = DebugServer(
+            context={
+                "store": self.store,
+                "trisolaris": self.trisolaris,
+                "downsampler": self.downsampler,
+            }
+        )
+        self.query = QueryEngine(self.store, translator=self.translator)
+        if self.election:
+            self.election.start()
+        self.started = True
+        return self
+
+    # -- periodic work (the reference's internal tickers) ---------------
+    def tick(self, now: int | None = None) -> dict:
+        now = int(time.time()) if now is None else now
+        leader = self.election.is_leader() if self.election else True
+        did = {"leader": leader, "tagrecorder": False, "downsampled": 0, "platform": False}
+        # enrichment follows resources, every node (the periodic
+        # PlatformInfoTable refresh — not leader-gated in the reference)
+        if self.resources.version != self._platform_version:
+            self.refresh_platform()
+            did["platform"] = True
+        if leader:
+            did["tagrecorder"] = self.tagrecorder.sync()
+            did["downsampled"] = self.downsampler.process(now)
+        default_collector.tick()
+        return did
+
+    def refresh_platform(self) -> None:
+        """Resource changes → new enrichment generation (the periodic
+        PlatformInfoTable refresh, grpc_platformdata.go:147)."""
+        state = self.resources.build_platform_table(self.config.region_id).build()
+        self.flow_metrics.platform_state = state
+        self.flow_log.platform_state = state
+        self._platform_version = self.resources.version
+
+    def add_datasource(self, **kw) -> DataSource:
+        return self.downsampler.add(DataSource(**kw))
+
+    def stop(self) -> None:
+        if not self.started:
+            return
+        if self.election:
+            self.election.stop()
+        self.flow_metrics.stop()
+        self.flow_log.stop()
+        self.integration.stop()
+        self.doc_writer.flush()
+        self.doc_writer.stop()
+        if self.exporter_hub is not None:
+            self.exporter_hub.stop()
+        self.debug.stop()
+        self.trisolaris.stop()
+        self.receiver.stop()
+        self.started = False

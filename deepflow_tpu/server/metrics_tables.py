@@ -120,11 +120,13 @@ class DocStoreWriter:
         partition_s: int = 3600,
         ttl_hours: int = 168,
         writer_args: dict | None = None,
+        exporter_hub=None,
     ):
         self.store = store
         self.partition_s = partition_s
         self.ttl_hours = ttl_hours
         self.writer_args = writer_args or {}
+        self.exporter_hub = exporter_hub
         self._writers: dict[tuple[str, MetricsTableID], TableWriter] = {}
         self._app_tags = AppServiceTagWriter(store)
         self._lock = threading.Lock()
@@ -173,6 +175,10 @@ class DocStoreWriter:
             for j, f in enumerate(METER_OF_TABLE[tid].fields):
                 cols[f.name] = d.meters[sel, j]
             self._writer(db, tid).put(cols)
+            if self.exporter_hub is not None:
+                # exporters tap enriched columns post-routing
+                # (unmarshaller.go:284-303 export point)
+                self.exporter_hub.export(TABLE_NAMES[tid].replace(".", "_"), cols)
             # app_service sidecar rows for docs that carry a service string
             pairs = {
                 (strings.lookup(int(s)), strings.lookup(int(i)))

@@ -1,0 +1,125 @@
+"""Controller tests: resource versioning, tagrecorder → querier
+translation, trisolaris sync + escape semantics, leader election,
+platform refresh into the enrichment kernel."""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from deepflow_tpu.controller.election import LeaderElection
+from deepflow_tpu.controller.resources import ResourceDB
+from deepflow_tpu.controller.tagrecorder import TagRecorder
+from deepflow_tpu.controller.trisolaris import AgentSyncClient, TrisolarisService
+from deepflow_tpu.querier import QueryEngine
+from deepflow_tpu.querier.translation import Translator
+from deepflow_tpu.storage.store import ColumnarStore, ColumnSpec, TableSchema
+
+T0 = 1_700_000_000
+
+
+def test_resource_versioning_and_reads():
+    db = ResourceDB()
+    v0 = db.version
+    db.put("pod", 101, "web-0", pod_node_id=3)
+    db.put("region", 1, "us-west")
+    assert db.version == v0 + 2
+    assert db.get("pod", 101).name == "web-0"
+    assert [r.name for r in db.list("region")] == ["us-west"]
+    db.delete("pod", 101)
+    assert db.get("pod", 101) is None
+    v1 = db.version
+    db.delete("pod", 999)  # no-op doesn't bump
+    assert db.version == v1
+
+
+def test_tagrecorder_feeds_querier_translation():
+    db = ResourceDB()
+    store = ColumnarStore()
+    tr = Translator(store)
+    rec = TagRecorder(db, store, translator=tr)
+    db.put("pod", 7, "checkout-7f9c")
+    db.put("auto_service", 33, "payments")
+    assert rec.sync() is True
+    assert rec.sync() is False  # unchanged version → no work
+
+    out = tr.translate("application_1s", "pod_id_0", np.array([7, 8]))
+    assert list(out) == ["checkout-7f9c", "8"]
+    out = tr.translate("application_1s", "auto_service_id_0", np.array([33]))
+    assert list(out) == ["payments"]
+
+    # rename propagates after the next sync (cache invalidated)
+    db.put("pod", 7, "checkout-new")
+    assert rec.sync() is True
+    assert list(tr.translate("t", "pod_id_0", np.array([7]))) == ["checkout-new"]
+
+
+def test_trisolaris_sync_and_escape():
+    db = ResourceDB()
+    db.add_vinterface(epc_id=5, ips=["10.0.0.9"], pod_id=42)
+    svc = TrisolarisService(db)
+    try:
+        cli = AgentSyncClient([("127.0.0.1", svc.port)], agent_id=3,
+                              max_escape_s=100.0, defaults={"sampling": 1})
+        assert cli.sync_once(now=1000.0)
+        assert cli.platform["interfaces"][0]["pod_id"] == 42
+        assert cli.config == {"sampling": 1}
+
+        # config push: revision change delivers the new config once
+        svc.set_group_config("default", {"sampling": 16})
+        assert cli.sync_once(now=1001.0)
+        assert cli.config == {"sampling": 1, "sampling": 16} or cli.config["sampling"] == 16
+        rev = cli.config_rev
+        assert cli.sync_once(now=1002.0)
+        assert cli.config_rev == rev  # unchanged → no re-push
+        assert svc.agents[3]["group"] == "default"
+
+        # controller death: config survives until max_escape, then reverts
+        svc.stop()
+        assert not cli.sync_once(now=1050.0)
+        assert cli.config["sampling"] == 16 and not cli.escaped
+        assert not cli.sync_once(now=1200.0)
+        assert cli.escaped and cli.config == {"sampling": 1}
+    finally:
+        svc.stop()
+
+
+def test_leader_election(tmp_path):
+    lease = tmp_path / "leader.lease"
+    a = LeaderElection(lease, "ctrl-a", lease_s=2.0)
+    b = LeaderElection(lease, "ctrl-b", lease_s=2.0)
+    assert a.try_acquire(now=100.0)
+    assert not b.try_acquire(now=100.5)  # a holds a live lease
+    assert a.try_acquire(now=101.0)  # renewal
+    assert a.counters["renewals"] == 1
+    # a stops renewing → stale lease taken over after expiry
+    assert b.try_acquire(now=103.5)
+    assert b.is_leader()
+    assert not a.try_acquire(now=103.6)
+    assert a.counters["losses"] == 1
+    # graceful release hands off immediately
+    b._leader = True
+    b.stop()
+    assert a.try_acquire(now=103.7)
+
+
+def test_platform_refresh_into_enrichment():
+    from deepflow_tpu.enrich.platform import enrich_docs
+    from deepflow_tpu.datamodel.schema import TAG_SCHEMA
+    from deepflow_tpu.datamodel.code import CodeId
+
+    db = ResourceDB()
+    db.add_vinterface(
+        epc_id=9, ips=["10.1.1.1"], pod_id=55, region_id=2, az_id=4,
+        subnet_id=6, pod_cluster_id=1,
+    )
+    state = db.build_platform_table().build()
+    tags = np.zeros((4, TAG_SCHEMA.num_fields), np.uint32)
+    tags[:, TAG_SCHEMA.index("code_id")] = int(CodeId.SINGLE_IP_PORT)
+    tags[:, TAG_SCHEMA.index("l3_epc_id")] = 9
+    tags[:, TAG_SCHEMA.index("ip0_w3")] = 0x0A010101
+    s0, _s1, keep, _ = enrich_docs(state, tags, np.ones(4, bool))
+    assert int(np.asarray(s0["pod_id"])[0]) == 55
+    assert int(np.asarray(s0["az_id"])[0]) == 4
+    assert keep.all()

@@ -236,3 +236,10 @@ def test_promql_queries():
         query_instant(store, "rate(http_total)", t)
     with pytest.raises(PromQLError):
         query_instant(store, "sum by job http_total{", t)
+
+
+def test_pack_tags_escaping_roundtrip():
+    from deepflow_tpu.integration.formats import pack_tags, unpack_tags
+
+    tags = {"url": "/search?a=1,b=2", "k=y": "v\\x", "plain": "ok"}
+    assert unpack_tags(pack_tags(tags)) == tags
