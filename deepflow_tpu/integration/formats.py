@@ -340,8 +340,10 @@ def _parse_span(service: str, buf: bytes) -> OtelSpan | None:
             elif f3 == 9:
                 attrs.append(bytes(v3))
             elif f3 == 15:
+                # Status: field 2 is `message` (string), field 3 is `code`
+                # (opentelemetry/proto/trace/v1/trace.proto Status)
                 for f4, v4 in _iter_fields(bytes(v3)):
-                    if f4 == 2:
+                    if f4 == 3:
                         s.status_code = int(v4)
         s.attributes = _attributes(attrs)
         return s

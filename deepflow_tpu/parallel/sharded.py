@@ -70,6 +70,7 @@ class ShardedPipeline:
         self.axes = tuple(mesh.axis_names)  # ("host", "chip")
         self._step = self._build_step()
         self._close = self._build_window_close()
+        self._flush = self._build_flush()
 
     # -- state ----------------------------------------------------------
     def init_state(self) -> tuple[StashState, SketchPlanes]:
@@ -189,3 +190,141 @@ class ShardedPipeline:
         planes, globally-merged planes replicated per device, pod-wide 1m
         HLL). Call at each window boundary."""
         return self._close(sketches)
+
+    # -- doc flush ------------------------------------------------------
+    def _build_flush(self):
+        from ..aggregator.stash import stash_flush
+
+        def flush(stash, window_idx):
+            stash1 = jax.tree.map(lambda x: x[0], stash)
+            new_state, out = stash_flush(stash1, window_idx)
+            expand = lambda x: x[None]
+            return jax.tree.map(expand, new_state), jax.tree.map(expand, out)
+
+        pspec = P(self.axes)
+        mapped = shard_map(
+            flush,
+            mesh=self.mesh,
+            in_specs=(pspec, P()),
+            out_specs=(pspec, pspec),
+        )
+        return jax.jit(mapped)
+
+    def flush_window(self, stash, window_idx):
+        """Flush one closed window from every device stash.
+
+        Returns (new_stash, out) where out's arrays carry a leading
+        device dim ([D, S] mask/slot/keys, [D, S, T] tags, ...). Exact
+        doc stashes are per-device (the reference isolates per-pipeline
+        docs the same way via global_thread_id, document.rs:293); the
+        host compacts all shards into one DocBatch.
+        """
+        return self._flush(stash, jnp.asarray(window_idx, dtype=jnp.uint32))
+
+
+class ShardedWindowManager:
+    """Host-driven window controller for the mesh path — the sharded twin
+    of aggregator/window.WindowManager (same open-span/late-drop/flush
+    protocol, quadruple_generator.rs:275-352), producing writer-ready
+    DocBatches from the per-device stashes at every window close.
+    """
+
+    def __init__(self, pipe: ShardedPipeline, delay: int = 2):
+        self.pipe = pipe
+        self.interval = pipe.config.interval
+        self.delay = delay
+        self.stash, self.sketches = pipe.init_state()
+        self.start_window: int | None = None
+        self.drop_before_window = 0
+        self.total_flushed = 0
+        # merged sketch views of the last closed window (None until one closes)
+        self.global_view = None
+        self.pod_1m = None
+
+    def _flush_one(self, w: int):
+        """Flush window w from every device stash → DocBatch | None."""
+        from ..datamodel.batch import DocBatch
+        from ..datamodel.schema import FLOW_METER, TAG_SCHEMA
+
+        self.stash, out = self.pipe.flush_window(self.stash, np.uint32(w))
+        mask = np.asarray(out["mask"])  # [D, S]
+        if not mask.any():
+            return None
+        tags_out = np.asarray(out["tags"])[mask]  # [n, T]
+        meters_out = np.asarray(out["meters"])[mask]
+        n = tags_out.shape[0]
+        self.total_flushed += n
+        return DocBatch(
+            tags=tags_out,
+            meters=meters_out,
+            timestamp=np.full((n,), w * self.interval, dtype=np.uint32),
+            valid=np.ones((n,), dtype=bool),
+            tag_schema=TAG_SCHEMA,
+            meter_schema=FLOW_METER,
+        )
+
+    def _occupied_windows(self):
+        slots = np.asarray(self.stash.slot)
+        valid_rows = np.asarray(self.stash.valid)
+        if not valid_rows.any():
+            return []
+        return sorted(int(w) for w in np.unique(slots[valid_rows]))
+
+    def ingest(self, tags, meters, valid):
+        """Feed one flow batch (leading dim divisible by device count);
+        returns DocBatches for any windows that closed."""
+        ts_np = np.asarray(tags["timestamp"])
+        valid_np = np.asarray(valid)
+        if not valid_np.any():
+            return []
+        t_max = int(ts_np[valid_np].max())
+        if self.start_window is None:
+            t_min = int(ts_np[valid_np].min())
+            self.start_window = max(0, min(t_min, t_max - self.delay)) // self.interval
+
+        window_np = ts_np // self.interval
+        late = valid_np & (window_np < self.start_window)
+        if late.any():
+            self.drop_before_window += int(late.sum())
+            valid = np.asarray(valid) & ~late
+
+        # Window advance is decided before the merge: the batch at t_max
+        # belongs to the new window, so closing sketch planes first keeps
+        # its contributions out of the closing view and inside the fresh
+        # one (doc flush still happens after the merge — late rows within
+        # `delay` must land in their window before it flushes).
+        new_start = max(t_max - self.delay, 0) // self.interval
+        advancing = self.start_window < new_start
+        if advancing:
+            self.sketches, self.global_view, self.pod_1m = self.pipe.window_close(
+                self.sketches
+            )
+
+        self.stash, self.sketches = self.pipe.step(
+            self.stash, self.sketches, tags, meters, valid
+        )
+
+        flushed = []
+        if advancing:
+            for w in self._occupied_windows():
+                if w >= new_start:
+                    continue
+                db = self._flush_one(w)
+                if db is not None:
+                    flushed.append(db)
+            self.start_window = new_start
+        return flushed
+
+    def drain(self):
+        """Flush every open window (shutdown path). Advances the open
+        span past each drained window so a straggler ingest cannot
+        re-open and re-emit it (same invariant as WindowManager.flush_all,
+        window.py:159)."""
+        flushed = []
+        for w in self._occupied_windows():
+            db = self._flush_one(w)
+            if db is not None:
+                flushed.append(db)
+            if self.start_window is not None:
+                self.start_window = max(self.start_window, w + 1)
+        return flushed
