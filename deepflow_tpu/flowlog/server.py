@@ -213,14 +213,14 @@ class FlowLogIngester:
                 continue
             idle_since = None
             for raw in frames:
-                header = FlowHeader.parse(raw[:HEADER_LEN])
-                org = header.organization_id
                 try:
+                    header = FlowHeader.parse(raw[:HEADER_LEN])
                     msgs = split_messages(raw[HEADER_LEN:])
                 except ValueError:
                     with self._lock:
                         self.counters["decode_errors"] += 1
                     continue
+                org = header.organization_id
                 batch, errors = decode_rows(schema, msgs)
                 with self._lock:
                     self.counters["frames_in"] += 1

@@ -19,7 +19,9 @@ Endpoints (reference paths, integration_collector.rs routes):
 from __future__ import annotations
 
 import gzip
+import io
 import threading
+import zlib
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..ingest.framing import MessageType
@@ -32,6 +34,11 @@ _ROUTES = {
     "/influxdb/api/v2/write": MessageType.TELEGRAF,
     "/api/v1/profile": MessageType.PROFILE,
 }
+
+# request-size guards (the reference bounds bodies via hyper defaults;
+# the bind is configurable so a bomb must not exhaust memory)
+MAX_BODY_BYTES = 32 << 20
+MAX_DECODED_BYTES = 128 << 20
 
 
 class IntegrationCollector:
@@ -67,13 +74,34 @@ class IntegrationCollector:
                     collector.counters["bad_requests"] += 1
                     self.send_error(404)
                     return
-                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                except ValueError:
+                    collector.counters["bad_requests"] += 1
+                    self.send_error(400, "bad Content-Length")
+                    return
+                if length < 0:
+                    collector.counters["bad_requests"] += 1
+                    self.send_error(400, "bad Content-Length")
+                    return
+                if length > MAX_BODY_BYTES:
+                    collector.counters["bad_requests"] += 1
+                    self.send_error(413, "body too large")
+                    return
                 body = self.rfile.read(length)
                 enc = (self.headers.get("Content-Encoding") or "identity").lower()
                 if enc == "gzip":
                     try:
-                        body = gzip.decompress(body)
-                    except (OSError, EOFError):  # truncated gzip → EOFError
+                        # bounded streaming decompress — a gzip bomb must not
+                        # expand past MAX_DECODED_BYTES in memory
+                        d = gzip.GzipFile(fileobj=io.BytesIO(body))
+                        body = d.read(MAX_DECODED_BYTES + 1)
+                        if len(body) > MAX_DECODED_BYTES:
+                            collector.counters["bad_requests"] += 1
+                            self.send_error(413, "decoded body too large")
+                            return
+                    except (OSError, EOFError, zlib.error):
+                        # truncated → EOFError; corrupt deflate → zlib.error
                         collector.counters["bad_requests"] += 1
                         self.send_error(400, "bad gzip body")
                         return

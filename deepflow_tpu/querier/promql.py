@@ -104,7 +104,12 @@ def query_instant(
             if len(samples) < 2:
                 continue
             dt = samples[-1][0] - samples[0][0]
-            dv = samples[-1][1] - samples[0][1]
+            # counter-reset correction (Prometheus extrapolatedRate): a
+            # decrease means the counter restarted from ~0, so the true
+            # increase across the reset is the new value itself
+            dv = 0.0
+            for (_, prev), (_, cur) in zip(samples, samples[1:]):
+                dv += cur - prev if cur >= prev else cur
             per_series[packed] = dv / dt if dt > 0 else 0.0
         else:
             per_series[packed] = samples[-1][1]

@@ -172,11 +172,37 @@ class Downsampler:
             return 0
         try:
             total = 0
-            for ds in self.list():
+            for ds in self._topo_sources():
                 total += self._process_one(ds, now)
             return total
         finally:
             self._proc_lock.release()
+
+    def _topo_sources(self) -> list[DataSource]:
+        """Dependency order: a datasource whose base table is itself a
+        registered datasource (e.g. network_1h over network_1m) must run
+        after that base has rolled the chunk, or it would scan the base
+        table before the finer rollup wrote it, advance its watermark,
+        and never re-roll the missing rows."""
+        sources = self.list()
+        by_name = {ds.name: ds for ds in sources}
+        ordered: list[DataSource] = []
+        seen: set[str] = set()
+
+        def visit(ds: DataSource, chain: tuple[str, ...] = ()):
+            if ds.name in seen:
+                return
+            if ds.name in chain:  # defensive: cycles can't roll anyway
+                return
+            base = by_name.get(ds.base_table)
+            if base is not None:
+                visit(base, chain + (ds.name,))
+            seen.add(ds.name)
+            ordered.append(ds)
+
+        for ds in sources:
+            visit(ds)
+        return ordered
 
     def _process_one(self, ds: DataSource, now: int) -> int:
         """Scan in chunks of max(interval, partition) so every output

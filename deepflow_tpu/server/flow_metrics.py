@@ -105,10 +105,10 @@ class FlowMetricsIngester:
                 self._process_frame(decoder, raw)
 
     def _process_frame(self, decoder, raw: bytes) -> None:
-        header = FlowHeader.parse(raw[:HEADER_LEN])
         try:
+            header = FlowHeader.parse(raw[:HEADER_LEN])
             msgs = split_messages(raw[HEADER_LEN:])
-        except ValueError:
+        except ValueError:  # short/garbage frame must not kill the worker
             with self._lock:
                 self.counters["decode_errors"] += 1
             return

@@ -200,3 +200,31 @@ def test_day_rollup_single_row_per_group():
     out = store.scan("flow_metrics", "network_1d", columns=["time", "packet_tx"])
     assert len(out["time"]) == 1
     assert float(out["packet_tx"][0]) == 30.0
+
+
+def test_chained_datasource_processes_in_dependency_order():
+    """network_1d over network_1h over network_1s: registering the
+    coarsest FIRST must still roll fine→coarse within one pass, so the
+    1d table sees the 1h rows written moments earlier (ADVICE r1)."""
+    store = _make_store(hours=25, rows_per_hour=40)
+    dsm = Downsampler(store, delay_s=0)
+    dsm.add(DataSource(base_table="network_1s", interval="1h"))
+    dsm.add(DataSource(base_table="network_1h", interval="1d"))
+    # invert registration order (delete + re-add) so naive dict-order
+    # processing would run the 1d source before its 1h base
+    dsm.delete("network_1h")
+    dsm.add(DataSource(base_table="network_1s", interval="1h"))
+    assert [d.name for d in dsm.list()] == ["network_1d", "network_1h"]
+    now = T0 + 25 * 3600 + 100
+    dsm.process(now)
+
+    day_rows = store.scan("flow_metrics", "network_1d", columns=["time", "packet_tx"])
+    hour_rows = store.scan("flow_metrics", "network_1h", columns=["time", "packet_tx"])
+    # the 1d rollup must cover every closed day of the 1h table
+    closed_day_end = ((now - 0) // 86400) * 86400
+    covered_hours = hour_rows["time"] < closed_day_end
+    assert covered_hours.any()
+    assert len(day_rows["time"]) > 0
+    assert float(day_rows["packet_tx"].sum()) == pytest.approx(
+        float(hour_rows["packet_tx"][covered_hours].sum()), rel=1e-5
+    )

@@ -89,7 +89,9 @@ def _otlp_body():
         + vi(8, (T0 * 10**9) + 5_000_000)  # 5ms
         + ld(9, ld(1, b"http.method") + ld(2, ld(1, b"GET")))
         + ld(9, ld(1, b"http.status_code") + ld(2, ld(1, b"200")))
-        + ld(15, vi(2, 0))
+        # Status{message="deadline exceeded", code=STATUS_CODE_ERROR}:
+        # code is field 3; field 2 is the message string and must be skipped
+        + ld(15, ld(2, b"deadline exceeded") + vi(3, 2))
     )
     scope_spans = ld(2, ld(2, span))  # ResourceSpans.scope_spans = ScopeSpans{spans}
     return ld(1, resource + scope_spans)
@@ -104,6 +106,7 @@ def test_otlp_parse():
     assert s.kind == 2
     assert s.end_us - s.start_us == 5000
     assert s.attributes["http.method"] == "GET"
+    assert s.status_code == 2  # STATUS_CODE_ERROR survives a message string
 
 
 def test_folded_parse_and_flame_tree():
@@ -236,6 +239,31 @@ def test_promql_queries():
         query_instant(store, "rate(http_total)", t)
     with pytest.raises(PromQLError):
         query_instant(store, "sum by job http_total{", t)
+
+
+def test_promql_rate_counter_reset():
+    """A process restart inside the window (counter drops to ~0) must
+    yield the reset-adjusted positive rate, not a negative one."""
+    store = ColumnarStore()
+    from deepflow_tpu.server.integration import PROM_SCHEMA
+
+    store.create_table("prometheus", PROM_SCHEMA)
+    # 1000 → 1060 → restart → 5 → 65; increases: 60 + 5 + 60 = 125 over 45s
+    times = [T0, T0 + 15, T0 + 30, T0 + 45]
+    vals = [1000.0, 1060.0, 5.0, 65.0]
+    store.insert(
+        "prometheus",
+        "samples",
+        {
+            "time": np.asarray(times, np.uint32),
+            "metric": np.asarray(["restarts_total"] * 4),
+            "labels": np.asarray(["job=api"] * 4),
+            "value": np.asarray(vals, np.float64),
+        },
+    )
+    out = query_instant(store, "rate(restarts_total[2m])", T0 + 50)
+    assert len(out) == 1
+    assert out[0]["value"] == pytest.approx(125 / 45)
 
 
 def test_pack_tags_escaping_roundtrip():
