@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Step-time breakdown for the L4 rollup hot path (feeds PERF.md).
+
+Times each stage of the ingest step in isolation on the attached chip:
+dispatch overhead, fanout, fingerprint, batch-local sort+reduce, and the
+full stash fold, across batch sizes. Run from repo root:
+
+    python bench/profile_step.py [--cpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+if "--cpu" in sys.argv:
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+
+from deepflow_tpu.aggregator.fanout import FanoutConfig, fanout_l4
+from deepflow_tpu.aggregator.pipeline import _KEY_COLS, make_ingest_step
+from deepflow_tpu.aggregator.stash import stash_init
+from deepflow_tpu.datamodel.schema import FLOW_METER, TAG_SCHEMA
+from deepflow_tpu.ingest.replay import SyntheticFlowGen
+from deepflow_tpu.ops.hashing import fingerprint64
+from deepflow_tpu.ops.segment import groupby_reduce
+
+
+def timeit(fn, *args, iters=20, warmup=3, donate=None):
+    jfn = jax.jit(fn, donate_argnums=donate) if donate else jax.jit(fn)
+    out = None
+    for _ in range(warmup):
+        out = jfn(*args)
+        if donate:
+            args = (out,) + args[1:]
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = jfn(*args)
+        if donate:
+            args = (out,) + args[1:]
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--cpu", action="store_true")
+    p.add_argument("--batches", type=int, nargs="*", default=[1 << 14, 1 << 16, 1 << 18])
+    args = p.parse_args()
+
+    print(f"platform={jax.devices()[0].platform} device={jax.devices()[0]}")
+    sum_cols = np.nonzero(FLOW_METER.sum_mask)[0].astype(np.int32)
+    max_cols = np.nonzero(FLOW_METER.max_mask)[0].astype(np.int32)
+
+    for batch in args.batches:
+        gen = SyntheticFlowGen(num_tuples=10_000, seed=0)
+        fb = gen.flow_batch(batch, 1_700_000_000)
+        tags = {k: jnp.asarray(v) for k, v in fb.tags.items()}
+        meters = jnp.asarray(fb.meters)
+        valid = jnp.asarray(fb.valid)
+        capacity = 1 << 16
+
+        res = {}
+
+        # 0. dispatch floor: trivial donated state update
+        state0 = jnp.zeros((capacity,), jnp.float32)
+        res["dispatch_floor"] = timeit(lambda s: s + 1.0, state0, donate=(0,))
+
+        # 1. fanout alone
+        fo = FanoutConfig()
+        res["fanout"] = timeit(lambda t, m, v: fanout_l4(t, m, v, fo), tags, meters, valid)
+
+        # 2. fingerprint alone (on fanned-out tags)
+        doc_tags, doc_meters, ts, doc_valid = jax.jit(
+            lambda t, m, v: fanout_l4(t, m, v, fo)
+        )(tags, meters, valid)
+        jax.block_until_ready(doc_tags)
+        key_cols = jnp.asarray(_KEY_COLS)
+
+        def fp(dt):
+            km = jnp.take(dt, key_cols, axis=1)
+            return fingerprint64(km)
+
+        res["fingerprint"] = timeit(fp, doc_tags)
+
+        # 3. batch-local sort+reduce ([4N] rows)
+        hi, lo = jax.jit(fp)(doc_tags)
+        window = (ts // jnp.uint32(1)).astype(jnp.uint32)
+
+        def local_reduce(w, h, l, dt, dm, dv):
+            return groupby_reduce(w, h, l, dt, dm, dv, sum_cols, max_cols)
+
+        res["local_sort_reduce_4N"] = timeit(
+            local_reduce, window, hi, lo, doc_tags, doc_meters, doc_valid
+        )
+
+        # 3b. sort only, key lanes only ([4N])
+        def sort_only(w, h, l):
+            iota = jnp.arange(w.shape[0], dtype=jnp.int32)
+            return jax.lax.sort((w, h, l, iota), num_keys=3)
+
+        res["sort_keys_4N"] = timeit(sort_only, window, hi, lo)
+
+        # 3c. sort at fold size ([4N + capacity])
+        wq = jnp.concatenate([window, jnp.zeros((capacity,), jnp.uint32)])
+        hq = jnp.concatenate([hi, jnp.zeros((capacity,), jnp.uint32)])
+        lq = jnp.concatenate([lo, jnp.zeros((capacity,), jnp.uint32)])
+        res["sort_keys_4N+cap"] = timeit(sort_only, wq, hq, lq)
+
+        # 4. full current step (fanout+fp+concat+sort+reduce into stash)
+        step_fn = make_ingest_step(FanoutConfig(), interval=1)
+        state = stash_init(capacity, TAG_SCHEMA, FLOW_METER)
+        res["full_step"] = timeit(step_fn, state, tags, meters, valid, donate=(0,))
+
+        print(f"\nbatch={batch} ({4 * batch} doc rows, capacity={capacity}):")
+        for k, v in res.items():
+            rate = batch / res["full_step"]
+            print(f"  {k:24s} {v * 1e3:8.3f} ms")
+        print(f"  -> full-step rate: {batch / res['full_step'] / 1e6:.2f} M flows/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,204 @@
+"""Blocked, scatter-free sorted-segment reduction — the v2 hot loop.
+
+Round 1's `groupby_reduce` (ops/segment.py) lowers `segment_sum` /
+`segment_max` and the column re-assembly to XLA scatter, which runs at
+~45M rows/s on this chip and dominated the step time (see PERF.md).
+This module reduces sorted runs with TPU-friendly primitives only —
+sort, static shifts, cumsum, gathers, and exactly one 1-lane scatter
+for the compaction index:
+
+  * rows are sorted by (slot, key_hi, key_lo) as before;
+  * the sorted array is tiled into blocks of `BLOCK` rows; within each
+    block a masked log-shift *suffix* scan reduces equal-key runs, so
+    the run's first row ends up holding the run's in-block total;
+  * segments straddling block boundaries are fixed with a tiny
+    segmented suffix scan over the [num_blocks] per-block head
+    partials (the continuation chain of a segment is exactly the run
+    of following blocks whose first id equals this block's last id);
+  * every segment's total is then available at its *global* first row:
+    emitted rows are compacted to a static-size prefix with one cumsum
+    + one 1-lane scatter (positions) + payload gathers.
+
+The result contract matches ops/segment.groupby_reduce (`Grouped`), so
+stash/window machinery is unchanged. Semantics mirror the reference's
+stash merges (collector.rs:810, quadruple_generator.rs:544): SUM lanes
+add, MAX lanes max, tags come from the segment's first sorted row.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .segment import Grouped, SENTINEL_SLOT
+
+BLOCK = 256  # rows per tile (power of two)
+
+
+def _suffix_segscan_block(vals: jnp.ndarray, ids: jnp.ndarray, op: str) -> jnp.ndarray:
+    """vals [NB, B, C], ids [NB, B] sorted within block. Returns the
+    suffix reduction of each equal-id run: out[b, r] = op over
+    vals[b, r:end_of_run(r)]. log2(B) masked shift steps, no scatter."""
+    v = vals
+    d = 1
+    while d < BLOCK:
+        same = ids[:, :-d] == ids[:, d:]  # [NB, B-d]
+        head = v[:, :-d]
+        tail = v[:, d:]
+        if op == "sum":
+            upd = head + jnp.where(same[..., None], tail, 0)
+        else:
+            upd = jnp.where(same[..., None], jnp.maximum(head, tail), head)
+        v = jnp.concatenate([upd, v[:, -d:]], axis=1)
+        d *= 2
+    return v
+
+
+def _suffix_segscan_flat(vals: jnp.ndarray, keys: jnp.ndarray, op: str) -> jnp.ndarray:
+    """1-D variant over [NB, C] block carries keyed by keys [NB]."""
+    v = vals
+    n = keys.shape[0]
+    d = 1
+    while d < n:
+        same = keys[:-d] == keys[d:]
+        head = v[:-d]
+        tail = v[d:]
+        if op == "sum":
+            upd = head + jnp.where(same[:, None], tail, 0)
+        else:
+            upd = jnp.where(same[:, None], jnp.maximum(head, tail), head)
+        v = jnp.concatenate([upd, v[-d:]], axis=0)
+        d *= 2
+    return v
+
+
+def _reduce_lanes(meters_sorted, g_ids, gmax, chain_next, is_last_run, cols, op):
+    """Per-segment totals (at global-first rows) for one op class.
+
+    meters_sorted [N, M] in sorted order; cols: static np indices.
+    Returns [N, len(cols)] where only global-first rows are meaningful.
+    """
+    if cols.size == 0:
+        n = meters_sorted.shape[0]
+        return jnp.zeros((n, 0), meters_sorted.dtype)
+    nb = g_ids.shape[0]
+    sub = jnp.take(meters_sorted, jnp.asarray(cols), axis=1)
+    sub_b = sub.reshape(nb, BLOCK, -1)
+    scanned = _suffix_segscan_block(sub_b, g_ids, op)
+    # head partial of each block = partial of the segment containing row 0
+    head = scanned[:, 0, :]  # [NB, C]
+    gmin = g_ids[:, 0]
+    cont = jnp.concatenate([jnp.zeros((1,), bool), gmin[1:] == gmax[:-1]])
+    chain_vals = jnp.where(cont[:, None], head, 0 if op == "sum" else head * 0)
+    chain = _suffix_segscan_flat(chain_vals, gmin, op)
+    # extra for block b = combined chain starting at b+1 (if continuing)
+    nxt = jnp.concatenate([chain[1:], jnp.zeros_like(chain[:1])], axis=0)
+    extra = jnp.where(chain_next[:, None], nxt, 0)  # [NB, C]
+    if op == "sum":
+        out = scanned + jnp.where(is_last_run[..., None], extra[:, None, :], 0)
+    else:
+        out = jnp.where(
+            is_last_run[..., None],
+            jnp.maximum(scanned, jnp.where(chain_next[:, None, None], extra[:, None, :], scanned)),
+            scanned,
+        )
+    return out.reshape(-1, cols.size)
+
+
+def blocked_groupby_reduce(
+    slot,
+    key_hi,
+    key_lo,
+    tags,
+    meters,
+    valid,
+    sum_cols: np.ndarray,
+    max_cols: np.ndarray,
+    out_capacity: int | None = None,
+) -> Grouped:
+    """Drop-in replacement for ops.segment.groupby_reduce with output
+    arrays sized `out_capacity` (default N). Segments beyond capacity
+    (in ascending (slot, key) order) are dropped from the output but
+    still counted in num_segments, so callers can account overflow."""
+    n_in = slot.shape[0]
+    cap = int(out_capacity or n_in)
+    m_cols = meters.shape[1]
+    sum_cols = np.asarray(sum_cols, np.int32)
+    max_cols = np.asarray(max_cols, np.int32)
+
+    slot = jnp.where(valid, slot, jnp.uint32(SENTINEL_SLOT))
+    key_hi = jnp.where(valid, key_hi, jnp.uint32(0xFFFFFFFF))
+    key_lo = jnp.where(valid, key_lo, jnp.uint32(0xFFFFFFFF))
+
+    # pad to a BLOCK multiple with sentinel rows
+    n = ((n_in + BLOCK - 1) // BLOCK) * BLOCK
+    pad = n - n_in
+    if pad:
+        slot = jnp.concatenate([slot, jnp.full((pad,), SENTINEL_SLOT, jnp.uint32)])
+        key_hi = jnp.concatenate([key_hi, jnp.full((pad,), 0xFFFFFFFF, jnp.uint32)])
+        key_lo = jnp.concatenate([key_lo, jnp.full((pad,), 0xFFFFFFFF, jnp.uint32)])
+        meters = jnp.concatenate([meters, jnp.zeros((pad, m_cols), meters.dtype)])
+        tags = jnp.concatenate([tags, jnp.zeros((pad, tags.shape[1]), tags.dtype)])
+    nb = n // BLOCK
+
+    iota = jnp.arange(n, dtype=jnp.int32)
+    s_slot, s_hi, s_lo, perm = lax.sort((slot, key_hi, key_lo, iota), num_keys=3)
+
+    first = jnp.concatenate(
+        [
+            jnp.ones((1,), bool),
+            (s_slot[1:] != s_slot[:-1])
+            | (s_hi[1:] != s_hi[:-1])
+            | (s_lo[1:] != s_lo[:-1]),
+        ]
+    )
+    seg_id = jnp.cumsum(first.astype(jnp.int32)) - 1  # [n] ascending
+
+    meters_sorted = jnp.take(meters, perm, axis=0)
+
+    g_ids = seg_id.reshape(nb, BLOCK)
+    gmax = g_ids[:, -1]
+    gmin = g_ids[:, 0]
+    # does block b's last segment continue into b+1?
+    chain_next = jnp.concatenate([gmin[1:] == gmax[:-1], jnp.zeros((1,), bool)])
+    is_last_run = g_ids == gmax[:, None]  # [NB, B]
+
+    sums = _reduce_lanes(meters_sorted, g_ids, gmax, chain_next, is_last_run, sum_cols, "sum")
+    maxs = _reduce_lanes(meters_sorted, g_ids, gmax, chain_next, is_last_run, max_cols, "max")
+
+    # reassemble [n, M] in schema order via static concat permutation
+    pieces = [None] * m_cols
+    for j, c in enumerate(sum_cols):
+        pieces[int(c)] = sums[:, j : j + 1]
+    for j, c in enumerate(max_cols):
+        pieces[int(c)] = maxs[:, j : j + 1]
+    totals = jnp.concatenate(pieces, axis=1)  # meaningful at first rows only
+
+    # --- compaction: emit global-first rows of live segments ----------
+    live = first & (s_slot != jnp.uint32(SENTINEL_SLOT))
+    pos = jnp.cumsum(live.astype(jnp.int32)) - 1
+    num_live = pos[-1] + 1
+    write_pos = jnp.where(live, pos, cap + 1)
+    src = jnp.full((cap,), -1, jnp.int32)
+    src = src.at[write_pos].set(iota, mode="drop")
+    got = src >= 0
+    taken = jnp.maximum(src, 0)
+
+    out_meters = jnp.where(got[:, None], jnp.take(totals, taken, axis=0), 0)
+    out_slot = jnp.where(got, jnp.take(s_slot, taken), jnp.uint32(SENTINEL_SLOT))
+    out_hi = jnp.where(got, jnp.take(s_hi, taken), 0)
+    out_lo = jnp.where(got, jnp.take(s_lo, taken), 0)
+    rep_rows = jnp.take(perm, taken)
+    out_tags = jnp.where(got[:, None], jnp.take(tags, rep_rows, axis=0), 0)
+
+    return Grouped(
+        slot=out_slot,
+        key_hi=out_hi,
+        key_lo=out_lo,
+        tags=out_tags,
+        meters=out_meters,
+        seg_valid=got,
+        num_segments=num_live,
+    )

@@ -1,0 +1,155 @@
+"""blocked_groupby_reduce conformance: same contract as groupby_reduce,
+validated against the dict oracle including multi-block straddles and
+capacity truncation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepflow_tpu.ops.blockreduce import BLOCK, blocked_groupby_reduce
+from deepflow_tpu.ops.segment import SENTINEL_SLOT
+
+from tests.test_segment import _np_reference
+
+
+def _run_and_compare(n, t, m, n_keys, seed, valid_frac=1.0, cap=None):
+    rng = np.random.default_rng(seed)
+    key_ids = rng.integers(0, n_keys, size=n)
+    uniq_tags = rng.integers(0, 2**31, size=(n_keys, t), dtype=np.uint32)
+    tags = uniq_tags[key_ids]
+    slot = (rng.integers(0, 3, size=n)).astype(np.uint32)
+    hi = uniq_tags[key_ids, 0]
+    lo = uniq_tags[key_ids, 1 % t]
+    meters = rng.integers(0, 1000, size=(n, m)).astype(np.float32)
+    valid = rng.random(n) < valid_frac
+    sum_cols = np.arange(0, m - 2, dtype=np.int32)
+    max_cols = np.arange(m - 2, m, dtype=np.int32)
+
+    g = jax.jit(
+        lambda *a: blocked_groupby_reduce(
+            *a, sum_cols=sum_cols, max_cols=max_cols, out_capacity=cap
+        )
+    )(
+        jnp.asarray(slot),
+        jnp.asarray(hi),
+        jnp.asarray(lo),
+        jnp.asarray(tags),
+        jnp.asarray(meters),
+        jnp.asarray(valid),
+    )
+
+    ref = _np_reference(slot, hi, lo, tags, meters, valid, sum_cols, max_cols)
+    nseg = int(g.num_segments)
+    assert nseg == len(ref)
+
+    got_slots = np.asarray(g.slot)
+    got_hi = np.asarray(g.key_hi)
+    got_lo = np.asarray(g.key_lo)
+    got_meters = np.asarray(g.meters)
+    got_tags = np.asarray(g.tags)
+    got_valid = np.asarray(g.seg_valid)
+    kept = min(nseg, cap) if cap else nseg
+    assert got_valid[:kept].all() and not got_valid[kept:].any()
+
+    ref_sorted = sorted(ref)  # ascending (slot, hi, lo) — emission order
+    for j in range(kept):
+        k = (int(got_slots[j]), int(got_hi[j]), int(got_lo[j]))
+        assert k == ref_sorted[j], (j, k)
+        ref_tags, ref_meters = ref[k]
+        np.testing.assert_array_equal(got_tags[j], ref_tags)
+        np.testing.assert_allclose(got_meters[j], ref_meters, rtol=0, atol=0)
+
+
+def test_blocked_small():
+    _run_and_compare(n=64, t=4, m=6, n_keys=7, seed=0)
+
+
+def test_blocked_unaligned_n():
+    _run_and_compare(n=BLOCK + 37, t=4, m=6, n_keys=11, seed=3)
+
+
+def test_blocked_many_keys_multi_block():
+    _run_and_compare(n=4 * BLOCK, t=8, m=10, n_keys=200, seed=1)
+
+
+def test_blocked_long_straddles():
+    # 3 keys over 8 blocks: every segment spans multiple blocks
+    _run_and_compare(n=8 * BLOCK, t=4, m=6, n_keys=3, seed=4)
+
+
+def test_blocked_single_key_all_blocks():
+    n, t, m = 4 * BLOCK, 3, 4
+    tags = np.tile(np.array([[7, 8, 9]], dtype=np.uint32), (n, 1))
+    g = blocked_groupby_reduce(
+        jnp.full((n,), 5, jnp.uint32),
+        jnp.full((n,), 11, jnp.uint32),
+        jnp.full((n,), 13, jnp.uint32),
+        jnp.asarray(tags),
+        jnp.ones((n, m), jnp.float32),
+        jnp.ones(n, bool),
+        sum_cols=np.array([0, 1], dtype=np.int32),
+        max_cols=np.array([2, 3], dtype=np.int32),
+    )
+    assert int(g.num_segments) == 1
+    np.testing.assert_allclose(np.asarray(g.meters)[0], [n, n, 1, 1])
+    np.testing.assert_array_equal(np.asarray(g.tags)[0], [7, 8, 9])
+
+
+def test_blocked_invalid_rows():
+    _run_and_compare(n=3 * BLOCK, t=5, m=8, n_keys=31, seed=2, valid_frac=0.7)
+
+
+def test_blocked_all_invalid():
+    n, t, m = BLOCK, 3, 4
+    g = blocked_groupby_reduce(
+        jnp.zeros(n, jnp.uint32),
+        jnp.zeros(n, jnp.uint32),
+        jnp.zeros(n, jnp.uint32),
+        jnp.zeros((n, t), jnp.uint32),
+        jnp.ones((n, m), jnp.float32),
+        jnp.zeros(n, bool),
+        sum_cols=np.arange(m, dtype=np.int32),
+        max_cols=np.array([], dtype=np.int32),
+    )
+    assert int(g.num_segments) == 0
+    assert not np.asarray(g.seg_valid).any()
+    assert (np.asarray(g.slot) == SENTINEL_SLOT).all()
+
+
+def test_blocked_capacity_truncation():
+    # more live segments than capacity: lowest (slot,key) prefix kept,
+    # num_segments still reports the full live count
+    _run_and_compare(n=2 * BLOCK, t=4, m=6, n_keys=100, seed=5, cap=40)
+
+
+def test_blocked_matches_unblocked_on_random():
+    from deepflow_tpu.ops.segment import groupby_reduce
+
+    rng = np.random.default_rng(9)
+    n, t, m = 5 * BLOCK + 13, 6, 8
+    key_ids = rng.integers(0, 37, size=n)
+    uniq = rng.integers(0, 2**31, size=(37, t), dtype=np.uint32)
+    tags = uniq[key_ids]
+    slot = rng.integers(0, 4, size=n).astype(np.uint32)
+    hi, lo = uniq[key_ids, 0], uniq[key_ids, 1]
+    meters = rng.integers(0, 100, size=(n, m)).astype(np.float32)
+    valid = rng.random(n) < 0.9
+    sum_cols = np.arange(0, m - 3, dtype=np.int32)
+    max_cols = np.arange(m - 3, m, dtype=np.int32)
+
+    a = blocked_groupby_reduce(
+        jnp.asarray(slot), jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(tags),
+        jnp.asarray(meters), jnp.asarray(valid), sum_cols, max_cols,
+    )
+    b = groupby_reduce(
+        jnp.asarray(slot), jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(tags),
+        jnp.asarray(meters), jnp.asarray(valid), sum_cols, max_cols,
+    )
+    na, nb_ = int(a.num_segments), int(b.num_segments)
+    assert na == nb_
+    np.testing.assert_array_equal(np.asarray(a.slot)[:na], np.asarray(b.slot)[:na])
+    np.testing.assert_array_equal(np.asarray(a.key_hi)[:na], np.asarray(b.key_hi)[:na])
+    np.testing.assert_allclose(
+        np.asarray(a.meters)[:na], np.asarray(b.meters)[:na], rtol=0, atol=0
+    )
+    np.testing.assert_array_equal(np.asarray(a.tags)[:na], np.asarray(b.tags)[:na])
