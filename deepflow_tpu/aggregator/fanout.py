@@ -80,9 +80,11 @@ def _tap_side(direction: jnp.ndarray) -> jnp.ndarray:
     return direction
 
 
-def _make_lanes(tags: dict, meters: jnp.ndarray, valid: jnp.ndarray, config: FanoutConfig, app: bool):
-    """Build the four (cols, lane_valid, lane_meter) lanes."""
-    n = meters.shape[0]
+def _make_lanes(tags: dict, meters_t: jnp.ndarray, valid: jnp.ndarray, config: FanoutConfig, app: bool):
+    """Build the four (cols, lane_valid, lane_meter_t) lanes.
+
+    meters_t is column-major [M, N]; lane meters come back [M, N]."""
+    n = meters_t.shape[1]
     zero = jnp.zeros((n,), dtype=jnp.uint32)
 
     dir0 = tags["direction0"]
@@ -108,11 +110,11 @@ def _make_lanes(tags: dict, meters: jnp.ndarray, valid: jnp.ndarray, config: Fan
 
     # reversed meter for the L4 server-endpoint single doc (meter.rs:169-176)
     if app:
-        meters_rev = meters
+        meters_rev_t = meters_t
     else:
         perm = jnp.asarray(FLOW_METER.reverse_perm)
-        zmask = jnp.asarray(~FLOW_METER.reverse_zero_mask, dtype=meters.dtype)
-        meters_rev = meters[:, perm] * zmask[None, :]
+        zmask = jnp.asarray(~FLOW_METER.reverse_zero_mask, dtype=meters_t.dtype)
+        meters_rev_t = meters_t[perm, :] * zmask[:, None]
 
     # ignore_server_port (collector.rs:877)
     inactive_service = tags["is_active_service"] == 0
@@ -213,7 +215,7 @@ def _make_lanes(tags: dict, meters: jnp.ndarray, valid: jnp.ndarray, config: Fan
             "server_port": port,
             "gpid0": gpid,
         }
-        return cols, lane_valid, (meters if ep == 0 else meters_rev)
+        return cols, lane_valid, (meters_t if ep == 0 else meters_rev_t)
 
     # ---- edge docs (lanes 2, 3) ---------------------------------------
     both_none = (dir0 == 0) & (dir1 == 0)
@@ -283,47 +285,46 @@ def _make_lanes(tags: dict, meters: jnp.ndarray, valid: jnp.ndarray, config: Fan
             "gpid0": tags["gpid0"],
             "gpid1": tags["gpid1"],
         }
-        return cols, lane_valid, meters
+        return cols, lane_valid, meters_t
 
     return [single_lane(0), single_lane(1), edge_lane(0), edge_lane(1)]
 
 
 def _fanout_impl(tags: dict, meters: jnp.ndarray, valid: jnp.ndarray, config: FanoutConfig, app: bool):
-    n = meters.shape[0]
-    lanes = _make_lanes(tags, meters, valid, config, app)
+    meters_t = jnp.transpose(meters)  # [M, N] — column-major from here on
+    n = meters_t.shape[1]
+    lanes = _make_lanes(tags, meters_t, valid, config, app)
 
     t_count = _T.num_fields
-    doc_tags = jnp.zeros((4, n, t_count), dtype=jnp.uint32)
-    doc_valid = jnp.zeros((4, n), dtype=bool)
-    doc_meters = jnp.zeros((4, n, meters.shape[1]), dtype=meters.dtype)
-    for li, (cols, lv, mt) in enumerate(lanes):
-        lane_tags = jnp.zeros((n, t_count), dtype=jnp.uint32)
+    zero = jnp.zeros((n,), dtype=jnp.uint32)
+    lane_tag_blocks, lane_valids, lane_meters = [], [], []
+    for cols, lv, mt in lanes:
+        rows = [zero] * t_count
         for name, arr in cols.items():
-            lane_tags = lane_tags.at[:, _T.index(name)].set(_u32(arr))
-        doc_tags = doc_tags.at[li].set(lane_tags)
-        doc_valid = doc_valid.at[li].set(lv)
-        doc_meters = doc_meters.at[li].set(mt)
+            rows[_T.index(name)] = _u32(arr)
+        lane_tag_blocks.append(jnp.stack(rows))  # [T, n]
+        lane_valids.append(lv)
+        lane_meters.append(mt)
 
-    ts = jnp.broadcast_to(tags["timestamp"][None, :], (4, n))
-    return (
-        doc_tags.reshape(4 * n, t_count),
-        doc_meters.reshape(4 * n, -1),
-        ts.reshape(4 * n),
-        doc_valid.reshape(4 * n),
-    )
+    doc_tags = jnp.concatenate(lane_tag_blocks, axis=1)  # [T, 4n], lane-major
+    doc_meters = jnp.concatenate(lane_meters, axis=1)  # [M, 4n]
+    doc_valid = jnp.concatenate(lane_valids)
+    ts = jnp.concatenate([tags["timestamp"]] * 4)
+    return doc_tags, doc_meters, ts, doc_valid
 
 
 @partial(jax.jit, static_argnames=("config",))
 def fanout_l4(tags: dict, meters: jnp.ndarray, valid: jnp.ndarray, config: FanoutConfig):
-    """FlowBatch columns → DocBatch arrays of shape [4N, ...].
+    """FlowBatch columns → column-major doc arrays.
 
     Args:
       tags: dict of [N] u32 columns named per FLOW_RECORD_TAG_FIELDS.
-      meters: [N, M] f32 FlowMeter rows (client-view).
+      meters: [N, M] f32 FlowMeter rows (client-view; transposed to
+        column-major internally — host batches stay row-major).
       valid: [N] bool.
     Returns:
-      (doc_tags [4N, T] u32, doc_meters [4N, M] f32, doc_ts [4N] u32,
-       doc_valid [4N] bool)
+      (doc_tags [T, 4N] u32, doc_meters [M, 4N] f32, doc_ts [4N] u32,
+       doc_valid [4N] bool), lane-major along the row axis.
     """
     return _fanout_impl(tags, meters, valid, config, app=False)
 

@@ -19,7 +19,7 @@ import numpy as np
 from ..datamodel.batch import DocBatch, FlowBatch
 from ..datamodel.code import DocumentFlag
 from ..datamodel.schema import APP_METER, FLOW_METER, TAG_SCHEMA, MeterSchema
-from ..ops.hashing import fingerprint64
+from ..ops.hashing import fingerprint64_t
 from .fanout import FanoutConfig, fanout_l4, fanout_l7
 from .window import FlushedWindow, WindowConfig, WindowManager
 
@@ -43,8 +43,8 @@ def make_ingest_step(fanout_config: FanoutConfig, interval: int = 1, app: bool =
 
     def step(state, tags, meters, valid):
         doc_tags, doc_meters, ts, doc_valid = fanout_fn(tags, meters, valid, fanout_config)
-        key_mat = jnp.take(doc_tags, key_cols, axis=1)
-        hi, lo = fingerprint64(key_mat)
+        key_mat = jnp.take(doc_tags, key_cols, axis=0)  # [K, 4N] — static row select
+        hi, lo = fingerprint64_t(key_mat)
         window = (ts // jnp.uint32(interval)).astype(jnp.uint32)
         from .stash import _merge_impl
 
@@ -85,8 +85,8 @@ class RollupPipeline:
         doc_tags, doc_meters, ts, doc_valid = self.fanout_fn(
             tags, meters, valid, self.config.fanout
         )
-        key_mat = jnp.take(doc_tags, jnp.asarray(_KEY_COLS), axis=1)
-        hi, lo = fingerprint64(key_mat)
+        key_mat = jnp.take(doc_tags, jnp.asarray(_KEY_COLS), axis=0)
+        hi, lo = fingerprint64_t(key_mat)
 
         flushed = self.wm.ingest(ts, hi, lo, doc_tags, doc_meters, doc_valid)
         return [self._to_docbatch(f) for f in flushed]
@@ -96,8 +96,8 @@ class RollupPipeline:
 
     def _to_docbatch(self, f: FlushedWindow) -> DocBatch:
         mask = np.asarray(f.out["mask"])
-        tags = np.asarray(f.out["tags"])[mask]
-        meters = np.asarray(f.out["meters"])[mask]
+        tags = np.asarray(f.out["tags"]).T[mask]  # device [T, S] → host rows
+        meters = np.asarray(f.out["meters"]).T[mask]
         n = tags.shape[0]
         ts = np.full((n,), f.start_time, dtype=np.uint32)
         return DocBatch(

@@ -36,8 +36,8 @@ class StashState:
     slot: jnp.ndarray  # [S] u32 absolute window index (SENTINEL = empty)
     key_hi: jnp.ndarray  # [S] u32
     key_lo: jnp.ndarray  # [S] u32
-    tags: jnp.ndarray  # [S, T] u32
-    meters: jnp.ndarray  # [S, M] f32
+    tags: jnp.ndarray  # [T, S] u32 (column-major — see ops/segment.py)
+    meters: jnp.ndarray  # [M, S] f32
     valid: jnp.ndarray  # [S] bool
     dropped_overflow: jnp.ndarray  # scalar i32, running count of shed segments
 
@@ -51,14 +51,14 @@ def stash_init(capacity: int, tag_schema: TagSchema, meter_schema: MeterSchema) 
         slot=jnp.full((capacity,), SENTINEL_SLOT, dtype=jnp.uint32),
         key_hi=jnp.zeros((capacity,), dtype=jnp.uint32),
         key_lo=jnp.zeros((capacity,), dtype=jnp.uint32),
-        tags=jnp.zeros((capacity, tag_schema.num_fields), dtype=jnp.uint32),
-        meters=jnp.zeros((capacity, meter_schema.num_fields), dtype=jnp.float32),
+        tags=jnp.zeros((tag_schema.num_fields, capacity), dtype=jnp.uint32),
+        meters=jnp.zeros((meter_schema.num_fields, capacity), dtype=jnp.float32),
         valid=jnp.zeros((capacity,), dtype=bool),
         dropped_overflow=jnp.zeros((), dtype=jnp.int32),
     )
 
 
-def _merge_impl(state: StashState, slot, key_hi, key_lo, tags, meters, valid, sum_cols_t, max_cols_t):
+def _merge_impl(state: StashState, slot, key_hi, key_lo, tags_t, meters_t, valid, sum_cols_t, max_cols_t):
     s = state.capacity
     sum_cols = np.asarray(sum_cols_t, dtype=np.int32)
     max_cols = np.asarray(max_cols_t, dtype=np.int32)
@@ -66,20 +66,23 @@ def _merge_impl(state: StashState, slot, key_hi, key_lo, tags, meters, valid, su
     all_slot = jnp.concatenate([state.slot, slot])
     all_hi = jnp.concatenate([state.key_hi, key_hi])
     all_lo = jnp.concatenate([state.key_lo, key_lo])
-    all_tags = jnp.concatenate([state.tags, tags], axis=0)
-    all_meters = jnp.concatenate([state.meters, meters], axis=0)
+    all_tags = jnp.concatenate([state.tags, tags_t], axis=1)
+    all_meters = jnp.concatenate([state.meters, meters_t], axis=1)
     all_valid = jnp.concatenate([state.valid, valid])
 
-    g = groupby_reduce(all_slot, all_hi, all_lo, all_tags, all_meters, all_valid, sum_cols, max_cols)
+    g = groupby_reduce(
+        all_slot, all_hi, all_lo, all_tags, all_meters, all_valid,
+        sum_cols, max_cols, out_capacity=s,
+    )
 
     dropped = jnp.maximum(g.num_segments - s, 0)
     new_state = StashState(
-        slot=g.slot[:s],
-        key_hi=g.key_hi[:s],
-        key_lo=g.key_lo[:s],
-        tags=g.tags[:s],
-        meters=g.meters[:s],
-        valid=g.seg_valid[:s],
+        slot=g.slot,
+        key_hi=g.key_hi,
+        key_lo=g.key_lo,
+        tags=g.tags,
+        meters=g.meters,
+        valid=g.seg_valid,
         dropped_overflow=state.dropped_overflow + dropped,
     )
     return new_state
@@ -100,7 +103,9 @@ def stash_merge(
     valid,
     meter_schema: MeterSchema,
 ) -> StashState:
-    """Merge a doc batch into the stash (one sort of [S+N] rows)."""
+    """Merge a doc batch into the stash (one sort of [S+N] rows).
+
+    tags/meters are column-major ([T, N] / [M, N])."""
     sum_cols = tuple(int(i) for i in np.nonzero(meter_schema.sum_mask)[0])
     max_cols = tuple(int(i) for i in np.nonzero(meter_schema.max_mask)[0])
     return _merge(state, slot, key_hi, key_lo, tags, meters, valid, sum_cols, max_cols)

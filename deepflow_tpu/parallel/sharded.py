@@ -250,8 +250,9 @@ class ShardedWindowManager:
         mask = np.asarray(out["mask"])  # [D, S]
         if not mask.any():
             return None
-        tags_out = np.asarray(out["tags"])[mask]  # [n, T]
-        meters_out = np.asarray(out["meters"])[mask]
+        # device payloads are column-major [D, T, S]; host rows are [n, T]
+        tags_out = np.transpose(np.asarray(out["tags"]), (0, 2, 1))[mask]
+        meters_out = np.transpose(np.asarray(out["meters"]), (0, 2, 1))[mask]
         n = tags_out.shape[0]
         self.total_flushed += n
         return DocBatch(

@@ -278,8 +278,8 @@ class Downsampler:
                 jnp.asarray(slot),
                 jnp.asarray(hi),
                 jnp.asarray(lo),
-                jnp.asarray(key_mat),
-                jnp.asarray(meters_in),
+                jnp.asarray(key_mat.T),
+                jnp.asarray(meters_in.T),
                 jnp.ones(n, bool),
                 np.concatenate([sum_cols, max_cols, [meters.shape[1]]]).astype(np.int32),
                 np.array([], np.int32),
@@ -289,15 +289,15 @@ class Downsampler:
                 jnp.asarray(slot),
                 jnp.asarray(hi),
                 jnp.asarray(lo),
-                jnp.asarray(key_mat),
-                jnp.asarray(meters),
+                jnp.asarray(key_mat.T),
+                jnp.asarray(meters.T),
                 jnp.ones(n, bool),
                 sum_cols,
                 max_cols,
             )
         m = int(np.asarray(g.num_segments))
-        out_tags = np.asarray(g.tags[:m])
-        out_meters = np.array(g.meters[:m])  # writable host copy
+        out_tags = np.asarray(g.tags).T[:m]
+        out_meters = np.array(g.meters).T[:m]  # writable host copy
         out_slot = np.asarray(g.slot[:m]).astype(np.int64)
         if ds.aggr_unsummable == "avg" and max_cols.size:
             count = np.maximum(out_meters[:, -1], 1.0)

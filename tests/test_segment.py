@@ -46,8 +46,8 @@ def _run_and_compare(n, t, m, n_keys, seed, valid_frac=1.0):
         jnp.asarray(slot),
         jnp.asarray(hi),
         jnp.asarray(lo),
-        jnp.asarray(tags),
-        jnp.asarray(meters),
+        jnp.asarray(tags.T),
+        jnp.asarray(meters.T),
         jnp.asarray(valid),
     )
 
@@ -58,8 +58,8 @@ def _run_and_compare(n, t, m, n_keys, seed, valid_frac=1.0):
     got_slots = np.asarray(g.slot)
     got_hi = np.asarray(g.key_hi)
     got_lo = np.asarray(g.key_lo)
-    got_meters = np.asarray(g.meters)
-    got_tags = np.asarray(g.tags)
+    got_meters = np.asarray(g.meters).T
+    got_tags = np.asarray(g.tags).T
     got_valid = np.asarray(g.seg_valid)
     assert got_valid[:nseg].all() and not got_valid[nseg:].any()
 
@@ -95,8 +95,8 @@ def test_groupby_all_invalid():
         jnp.zeros(n, jnp.uint32),
         jnp.zeros(n, jnp.uint32),
         jnp.zeros(n, jnp.uint32),
-        jnp.zeros((n, t), jnp.uint32),
-        jnp.ones((n, m), jnp.float32),
+        jnp.zeros((t, n), jnp.uint32),
+        jnp.ones((m, n), jnp.float32),
         jnp.zeros(n, bool),
         sum_cols=np.arange(m, dtype=np.int32),
         max_cols=np.array([], dtype=np.int32),
@@ -113,12 +113,12 @@ def test_groupby_single_key_all_rows():
         jnp.full((n,), 5, jnp.uint32),
         jnp.full((n,), 11, jnp.uint32),
         jnp.full((n,), 13, jnp.uint32),
-        jnp.asarray(tags),
-        jnp.ones((n, m), jnp.float32),
+        jnp.asarray(tags.T),
+        jnp.ones((m, n), jnp.float32),
         jnp.ones(n, bool),
         sum_cols=np.array([0, 1], dtype=np.int32),
         max_cols=np.array([2, 3], dtype=np.int32),
     )
     assert int(g.num_segments) == 1
-    np.testing.assert_array_equal(np.asarray(g.meters)[0], [n, n, 1, 1])
-    np.testing.assert_array_equal(np.asarray(g.tags)[0], [7, 8, 9])
+    np.testing.assert_array_equal(np.asarray(g.meters)[:, 0], [n, n, 1, 1])
+    np.testing.assert_array_equal(np.asarray(g.tags)[:, 0], [7, 8, 9])

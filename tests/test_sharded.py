@@ -58,8 +58,9 @@ def test_sharded_total_meters_match_input():
     stash, sketches = pipe.step(stash, sketches, fb.tags, fb.meters, fb.valid)
 
     valid = np.asarray(stash.valid)
-    meters = np.asarray(stash.meters)
-    tags = np.asarray(stash.tags)
+    # stash payloads are column-major [D, M, S] / [D, T, S]
+    meters = np.transpose(np.asarray(stash.meters), (0, 2, 1))
+    tags = np.transpose(np.asarray(stash.tags), (0, 2, 1))
     code_col = TAG_SCHEMA.index("code_id")
     pkt_col = FLOW_METER.index("packet_tx")
     # edge docs with direction0 (lane 2) carry the unreversed meter exactly

@@ -22,8 +22,8 @@ def _mkbatch(rows):
     slot = jnp.asarray(np.array([r[0] for r in rows], dtype=np.uint32))
     hi = jnp.asarray(np.array([r[1] for r in rows], dtype=np.uint32))
     lo = jnp.asarray(np.array([r[2] for r in rows], dtype=np.uint32))
-    tags = jnp.asarray(np.array([r[3] for r in rows], dtype=np.uint32))
-    meters = jnp.asarray(np.array([r[4] for r in rows], dtype=np.float32))
+    tags = jnp.asarray(np.array([r[3] for r in rows], dtype=np.uint32).T)
+    meters = jnp.asarray(np.array([r[4] for r in rows], dtype=np.float32).T)
     valid = jnp.ones((n,), dtype=bool)
     return slot, hi, lo, tags, meters, valid
 
@@ -38,7 +38,7 @@ def test_stash_merge_accumulates_across_batches():
     st, out = stash_flush(st, 1)
     assert int(out["count"]) == 2
     mask = np.asarray(out["mask"])
-    meters = np.asarray(out["meters"])[mask]
+    meters = np.asarray(out["meters"]).T[mask]
     his = np.asarray(out["key_hi"])[mask]
     row = {int(h): m for h, m in zip(his, meters)}
     np.testing.assert_array_equal(row[10], [5, 6, 5])  # sums + max
@@ -68,8 +68,8 @@ def test_window_manager_flushes_after_delay():
         ts = np.array(ts_list, dtype=np.uint32)
         hi = np.array(key_list, dtype=np.uint32)
         lo = np.zeros(n, dtype=np.uint32)
-        tags = np.stack([hi, hi], axis=1).astype(np.uint32)
-        meters = np.ones((n, 3), dtype=np.float32)
+        tags = np.stack([hi, hi], axis=0).astype(np.uint32)
+        meters = np.ones((3, n), dtype=np.float32)
         return (
             jnp.asarray(ts),
             jnp.asarray(hi),
@@ -87,7 +87,7 @@ def test_window_manager_flushes_after_delay():
     f = flushed[0]
     assert f.count == 1  # key 1 merged twice in window 100
     mask = np.asarray(f.out["mask"])
-    np.testing.assert_array_equal(np.asarray(f.out["meters"])[mask][0], [2, 2, 1])
+    np.testing.assert_array_equal(np.asarray(f.out["meters"]).T[mask][0], [2, 2, 1])
 
     # late arrival for window 100 is dropped
     assert wm.ingest(*batch([100], [9])) == []
@@ -107,8 +107,8 @@ def test_window_manager_multi_window_batch():
         jnp.asarray(np.array(ts, dtype=np.uint32)),
         jnp.asarray(np.arange(n, dtype=np.uint32)),
         jnp.zeros(n, dtype=jnp.uint32),
-        jnp.zeros((n, 2), dtype=jnp.uint32),
-        jnp.ones((n, 3), dtype=jnp.float32),
+        jnp.zeros((2, n), dtype=jnp.uint32),
+        jnp.ones((3, n), dtype=jnp.float32),
         jnp.ones(n, dtype=bool),
     )
     flushed = wm.ingest(*b)
