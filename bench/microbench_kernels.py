@@ -129,17 +129,19 @@ def main():
         report("segscan-shift 36c", r, timeit(mk_segscan))
 
         def mk_fingerprint():
-            from deepflow_tpu.ops.hashing import fingerprint64
+            # column-major [C, r], the layout the pipeline actually
+            # fingerprints (fingerprint64_t over key rows of [T, 4N])
+            from deepflow_tpu.ops.hashing import fingerprint64_t
 
-            tmat = jnp.asarray(rng.integers(0, 2**32, (r, 30), dtype=np.uint32))
+            tmat = jnp.asarray(rng.integers(0, 2**32, (30, r), dtype=np.uint32))
 
             def f(carry, tm):
-                hi, lo = fingerprint64(tm + carry)
+                hi, lo = fingerprint64_t(tm + carry)
                 return hi[0] ^ lo[0]
 
             return f, (tmat,)
 
-        report("fingerprint 30c", r, timeit(mk_fingerprint))
+        report("fingerprint_t 30c", r, timeit(mk_fingerprint))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,216 @@
+#!/usr/bin/env python
+"""Round-4 kernel decision microbenchmark — run ON CHIP before any rewrite.
+
+Measures, at bench shape (16k flows -> 65,536 fanout doc rows merged into a
+65,536-row stash => 131,072 sort rows), every candidate for the group-by
+hot loop:
+
+  sort4          pure lax.sort of 4 u32 lanes (the floor of any sort design)
+  r2_rowmajor    round-2 kernel: sort + cumsum seg-ids + segment_sum/max,
+                 row-major [N, M] payloads
+  r3_scan        round-3 kernel: sort + segmented associative_scan,
+                 column-major [M, N] (the shipped regression)
+  hybrid_col     col-major layout kept, reduction via transpose +
+                 segment_sum/max (VERDICT option c)
+  scatter_add    unsorted segment_sum [N,M] -> [H,M] (hash-stash cost model:
+                 the per-batch meter accumulate)
+  probe8         8 unrolled gather+compare probes over a 131k-slot table
+                 (hash-stash lookup cost)
+  claim_min      scatter-min slot claim (hash-stash insert-round cost)
+
+Each prints compile time and steady-state ms. Writes PERF entries to stdout;
+copy results into PERF.md.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+N_DOC = 1 << 16      # fanout doc rows per batch (16k flows x 4 lanes)
+S = 1 << 16          # stash capacity
+N_SORT = N_DOC + S   # rows in the per-batch merge sort today
+H = 1 << 17          # hash table slots (load 0.5 at 64k keys)
+T = 40               # tag columns (approx TAG_SCHEMA)
+M = 17               # meter columns (FLOW_METER)
+SUM_COLS = np.arange(0, 13, dtype=np.int32)
+MAX_COLS = np.arange(13, 17, dtype=np.int32)
+
+
+def timeit(name, fn, *args, iters=20):
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(fn(*args))
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    ms = (time.perf_counter() - t0) / iters * 1e3
+    print(f"{name:16s} compile {compile_s:7.2f}s   steady {ms:9.3f} ms")
+    return ms
+
+
+def make_inputs(n, seed=0):
+    rng = np.random.default_rng(seed)
+    nkeys = 40_000
+    kid = rng.integers(0, nkeys, n)
+    uniq_hi = rng.integers(0, 2**32, nkeys, dtype=np.uint64).astype(np.uint32)
+    uniq_lo = rng.integers(0, 2**32, nkeys, dtype=np.uint64).astype(np.uint32)
+    slot = jnp.asarray(np.full(n, 7, np.uint32))
+    hi = jnp.asarray(uniq_hi[kid])
+    lo = jnp.asarray(uniq_lo[kid])
+    tags_r = jnp.asarray(rng.integers(0, 1 << 16, (n, T)).astype(np.uint32))
+    meters_r = jnp.asarray(rng.random((n, M)).astype(np.float32))
+    valid = jnp.asarray(np.ones(n, bool))
+    return slot, hi, lo, tags_r, meters_r, valid
+
+
+@jax.jit
+def sort4(slot, hi, lo):
+    iota = jnp.arange(slot.shape[0], dtype=jnp.int32)
+    return lax.sort((slot, hi, lo, iota), num_keys=3)
+
+
+@jax.jit
+def r2_rowmajor(slot, hi, lo, tags, meters, valid):
+    n = slot.shape[0]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    s_slot, s_hi, s_lo, perm = lax.sort((slot, hi, lo, iota), num_keys=3)
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool),
+         (s_slot[1:] != s_slot[:-1]) | (s_hi[1:] != s_hi[:-1]) | (s_lo[1:] != s_lo[:-1])]
+    )
+    seg_id = jnp.cumsum(first.astype(jnp.int32)) - 1
+    ms_sorted = jnp.take(meters, perm, axis=0)
+    a = jax.ops.segment_sum(ms_sorted[:, SUM_COLS], seg_id, num_segments=n,
+                            indices_are_sorted=True)
+    b = jax.ops.segment_max(ms_sorted[:, MAX_COLS], seg_id, num_segments=n,
+                            indices_are_sorted=True)
+    rep = jax.ops.segment_min(iota, seg_id, num_segments=n, indices_are_sorted=True)
+    rep = jnp.where(rep >= n, 0, rep)
+    tags_out = jnp.take(tags, jnp.take(perm, rep), axis=0)
+    return a, b, tags_out, jnp.take(s_slot, rep)
+
+
+@jax.jit
+def hybrid_col(slot, hi, lo, tags_t, meters_t, valid):
+    n = slot.shape[0]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    s_slot, s_hi, s_lo, perm = lax.sort((slot, hi, lo, iota), num_keys=3)
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool),
+         (s_slot[1:] != s_slot[:-1]) | (s_hi[1:] != s_hi[:-1]) | (s_lo[1:] != s_lo[:-1])]
+    )
+    seg_id = jnp.cumsum(first.astype(jnp.int32)) - 1
+    ms_sorted = jnp.take(meters_t, perm, axis=1)  # [M, N] lane gather
+    row = ms_sorted.T  # [N, M]
+    a = jax.ops.segment_sum(row[:, SUM_COLS], seg_id, num_segments=n,
+                            indices_are_sorted=True)
+    b = jax.ops.segment_max(row[:, MAX_COLS], seg_id, num_segments=n,
+                            indices_are_sorted=True)
+    rep = jax.ops.segment_min(iota, seg_id, num_segments=n, indices_are_sorted=True)
+    rep = jnp.where(rep >= n, 0, rep)
+    tags_out = jnp.take(tags_t, jnp.take(perm, rep), axis=1)
+    return a.T, b.T, tags_out, jnp.take(s_slot, rep)
+
+
+@jax.jit
+def scatter_add(meters, ids):
+    return jax.ops.segment_sum(meters, ids, num_segments=H)
+
+
+@jax.jit
+def scatter_max(meters, ids):
+    return jax.ops.segment_max(meters, ids, num_segments=H)
+
+
+@jax.jit
+def probe8(t_hi, t_lo, t_fill, hi, lo):
+    mask = jnp.uint32(H - 1)
+    idx = (hi * jnp.uint32(0x9E3779B9) ^ lo) & mask
+    value = jnp.full(hi.shape, jnp.uint32(0xFFFFFFFF))
+    found = jnp.zeros(hi.shape, bool)
+    for p in range(8):
+        s = (idx + jnp.uint32(p)) & mask
+        hit = t_fill[s] & (t_hi[s] == hi) & (t_lo[s] == lo) & ~found
+        value = jnp.where(hit, s.astype(jnp.uint32), value)
+        found |= hit
+    return value, found
+
+
+@jax.jit
+def claim_min(cand, rowid):
+    claims = jnp.full((H,), jnp.int32(2**31 - 1))
+    claims = claims.at[cand].min(rowid)
+    won = claims[cand] == rowid
+    return claims, won
+
+
+def main():
+    print(f"device: {jax.devices()[0]}")
+    for n in (1 << 15, N_SORT):
+        print(f"--- shape N={n} ---")
+        slot, hi, lo, tags_r, meters_r, valid = make_inputs(n)
+        tags_t = jnp.asarray(np.asarray(tags_r).T.copy())
+        meters_t = jnp.asarray(np.asarray(meters_r).T.copy())
+        timeit("sort4", sort4, slot, hi, lo)
+        timeit("r2_rowmajor", r2_rowmajor, slot, hi, lo, tags_r, meters_r, valid)
+        timeit("hybrid_col", hybrid_col, slot, hi, lo, tags_t, meters_t, valid)
+        if n <= 1 << 15:
+            from deepflow_tpu.ops.segment import groupby_reduce
+
+            def r3(slot, hi, lo, tags_t, meters_t, valid):
+                return groupby_reduce(slot, hi, lo, tags_t, meters_t, valid,
+                                      SUM_COLS, MAX_COLS)
+
+            timeit("r3_scan", jax.jit(r3), slot, hi, lo, tags_t, meters_t, valid)
+
+    print(f"--- hash-stash cost model (N={N_DOC}, H={H}) ---")
+    rng = np.random.default_rng(1)
+    ids = jnp.asarray(rng.integers(0, H, N_DOC).astype(np.int32))
+    meters = jnp.asarray(rng.random((N_DOC, M)).astype(np.float32))
+    timeit("scatter_add", scatter_add, meters, ids)
+    timeit("scatter_max", scatter_max, meters, ids)
+    t_hi = jnp.asarray(rng.integers(0, 2**32, H, dtype=np.uint64).astype(np.uint32))
+    t_lo = jnp.asarray(rng.integers(0, 2**32, H, dtype=np.uint64).astype(np.uint32))
+    t_fill = jnp.asarray(rng.random(H) < 0.5)
+    hi = jnp.asarray(rng.integers(0, 2**32, N_DOC, dtype=np.uint64).astype(np.uint32))
+    lo = jnp.asarray(rng.integers(0, 2**32, N_DOC, dtype=np.uint64).astype(np.uint32))
+    timeit("probe8", probe8, t_hi, t_lo, t_fill, hi, lo)
+    cand = jnp.asarray(rng.integers(0, H, N_DOC).astype(np.int32))
+    rowid = jnp.arange(N_DOC, dtype=jnp.int32)
+    timeit("claim_min", claim_min, cand, rowid)
+
+
+def main_big():
+    """Fold-cost scaling: the accumulate-then-fold design needs sort+reduce
+    cost at accumulator scale (512k-4M rows) and the append cost."""
+    print(f"device: {jax.devices()[0]}")
+
+    @jax.jit
+    def append(buf_t, buf_m, new_t, new_m, off):
+        return (lax.dynamic_update_slice(buf_t, new_t, (0, off)),
+                lax.dynamic_update_slice(buf_m, new_m, (0, off)))
+
+    rng = np.random.default_rng(2)
+    big_t = jnp.zeros((T, 1 << 20), jnp.uint32)
+    big_m = jnp.zeros((M, 1 << 20), jnp.float32)
+    new_t = jnp.asarray(rng.integers(0, 1 << 16, (T, N_DOC)).astype(np.uint32))
+    new_m = jnp.asarray(rng.random((M, N_DOC)).astype(np.float32))
+    timeit("append_65k", append, big_t, big_m, new_t, new_m, jnp.int32(0))
+
+    for n in (int(sys.argv[1]) if sys.argv[1].isdigit() else 1 << 19,):
+        print(f"--- fold shape N={n} ---")
+        slot, hi, lo, tags_r, meters_r, valid = make_inputs(n, seed=3)
+        timeit("sort4", sort4, slot, hi, lo, iters=5)
+        timeit("r2_rowmajor", r2_rowmajor, slot, hi, lo, tags_r, meters_r, valid, iters=5)
+
+if __name__ == "__main__":
+    main_big() if sys.argv[-1] == "big" else main()

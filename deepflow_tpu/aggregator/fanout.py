@@ -52,6 +52,10 @@ from ..datamodel.schema import FLOW_METER, TAG_SCHEMA
 
 _T = TAG_SCHEMA
 
+# Docs emitted per flow: ep0/ep1 single + ep0/ep1 edge (lane 3 doubles as
+# the rest doc). Fill accounting everywhere keys off this constant.
+FANOUT_LANES = 4
+
 TCP = 6
 UDP = 17
 EPC_INTERNET_U16 = 0xFFFE  # -2 as u16 (EPC_INTERNET, npb_pcap_policy)

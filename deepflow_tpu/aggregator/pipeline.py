@@ -27,13 +27,23 @@ _KEY_COLS = np.nonzero(TAG_SCHEMA.key_mask)[0].astype(np.int32)
 
 
 def make_ingest_step(fanout_config: FanoutConfig, interval: int = 1, app: bool = False):
-    """Build the pure device step: FlowBatch columns → merged stash.
+    """Build the pure device step pair: FlowBatch columns → stash.
 
-    state' = step(state, tags, meters, valid). This is the function the
-    benchmark times and the graft entry exposes; RollupPipeline uses the
-    same building blocks but drives window flushes from the host.
-    `app` selects the L7 path (fanout_l7 + APP_METER) — fanout and meter
-    schema are coupled by construction so they cannot drift apart.
+    Returns (append, fold):
+
+      (stash, acc) = append(stash, acc, offset, tags, meters, valid)
+      (stash, acc) = fold(stash, acc)
+
+    `append` runs per batch: fanout → fingerprint → one
+    dynamic_update_slice into the accumulator ring at `offset` (a traced
+    scalar the host advances). `fold` is the amortized sort+reduce over
+    [S + A] rows, fired by the host every accum_batches batches and
+    before every window flush — this is what replaced the per-batch
+    re-sort of the whole stash (see AccumState, stash.py). The benchmark
+    times the (append ×K, fold ×1) cycle; RollupPipeline drives the same
+    functions from WindowManager. `app` selects the L7 path (fanout_l7 +
+    APP_METER) — fanout and meter schema are coupled by construction so
+    they cannot drift apart.
     """
     fanout_fn = fanout_l7 if app else fanout_l4
     meter_schema = APP_METER if app else FLOW_METER
@@ -41,16 +51,20 @@ def make_ingest_step(fanout_config: FanoutConfig, interval: int = 1, app: bool =
     max_cols = tuple(int(i) for i in np.nonzero(meter_schema.max_mask)[0])
     key_cols = jnp.asarray(_KEY_COLS)
 
-    def step(state, tags, meters, valid):
+    from .stash import _append_impl, _fold_impl
+
+    def append(stash, acc, offset, tags, meters, valid):
         doc_tags, doc_meters, ts, doc_valid = fanout_fn(tags, meters, valid, fanout_config)
         key_mat = jnp.take(doc_tags, key_cols, axis=0)  # [K, 4N] — static row select
         hi, lo = fingerprint64_t(key_mat)
         window = (ts // jnp.uint32(interval)).astype(jnp.uint32)
-        from .stash import _merge_impl
+        acc = _append_impl(acc, window, hi, lo, doc_tags, doc_meters, doc_valid, offset)
+        return stash, acc
 
-        return _merge_impl(state, window, hi, lo, doc_tags, doc_meters, doc_valid, sum_cols, max_cols)
+    def fold(stash, acc):
+        return _fold_impl(stash, acc, sum_cols, max_cols)
 
-    return step
+    return append, fold
 
 
 @dataclasses.dataclass(frozen=True)

@@ -26,7 +26,16 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..datamodel.schema import FLOW_METER, TAG_SCHEMA, MeterSchema, TagSchema
-from .stash import StashState, stash_flush, stash_init, stash_merge
+from .stash import (
+    AccumState,
+    StashState,
+    accum_append,
+    accum_init,
+    plan_append,
+    stash_flush,
+    stash_fold,
+    stash_init,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -34,6 +43,12 @@ class WindowConfig:
     interval: int = 1  # seconds per window
     delay: int = 2  # seconds a window stays open past its end
     capacity: int = 1 << 14  # stash rows shared by all open windows
+    # Batches accumulated between sort+reduce folds. The accumulator ring
+    # is sized accum_batches × (rows of the first batch); a fold also
+    # fires before any window flush so flushed windows always see every
+    # row. 8 amortizes the O((S+A) log(S+A)) sort ~8x while keeping the
+    # fold shape small enough for fast (remote) XLA compiles.
+    accum_batches: int = 8
 
     @property
     def ring(self) -> int:
@@ -62,10 +77,35 @@ class WindowManager:
         self.tag_schema = tag_schema
         self.meter_schema = meter_schema
         self.state: StashState = stash_init(config.capacity, tag_schema, meter_schema)
+        self.acc: AccumState | None = None  # sized on first batch
+        self.fill = 0  # host-tracked accumulator rows
         self.start_window: int | None = None  # oldest open window idx
         self.drop_before_window = 0
         self.total_docs_in = 0
         self.total_flushed = 0
+
+    def _fold(self):
+        if self.fill == 0:
+            return
+        self.state, self.acc = stash_fold(self.state, self.acc, self.meter_schema)
+        self.fill = 0
+
+    def _append(self, window, key_hi, key_lo, tags, meters, valid, rows: int):
+        plan = plan_append(self.fill, self.acc.capacity if self.acc else None, rows)
+        if plan == "init":
+            self._fold()  # pending rows must reach the stash before the ring is replaced
+            self.acc = accum_init(
+                max(self.config.accum_batches * rows, rows),
+                self.tag_schema,
+                self.meter_schema,
+            )
+        elif plan == "fold":
+            self._fold()
+        self.acc = accum_append(
+            self.acc, window, key_hi, key_lo, tags, meters, valid,
+            jnp.int32(self.fill),
+        )
+        self.fill += rows
 
     def window_of(self, timestamp):
         return timestamp // self.config.interval
@@ -110,9 +150,7 @@ class WindowManager:
             valid = valid & (window >= jnp.uint32(self.start_window))
         self.total_docs_in += int(valid_np.sum()) - n_late
 
-        self.state = stash_merge(
-            self.state, window, key_hi, key_lo, tags, meters, valid, self.meter_schema
-        )
+        self._append(window, key_hi, key_lo, tags, meters, valid, int(ts_np.shape[0]))
 
         # Advance: every window whose end is more than `delay` behind the
         # newest arrival closes now (move_window, quadruple_generator.rs:339).
@@ -122,6 +160,7 @@ class WindowManager:
         flushed: list[FlushedWindow] = []
         new_start = self.window_of(max(t_max - self.config.delay, 0))
         if self.start_window < new_start:
+            self._fold()  # flushed windows must see every accumulated row
             slots = np.asarray(self.state.slot)
             valid_rows = np.asarray(self.state.valid)
             occupied = np.unique(slots[valid_rows]) if valid_rows.any() else np.array([], np.uint32)
@@ -145,6 +184,7 @@ class WindowManager:
         """Drain every open window (shutdown path)."""
         if self.start_window is None:
             return []
+        self._fold()
         flushed = []
         slots = np.asarray(self.state.slot)
         valid = np.asarray(self.state.valid)
@@ -167,4 +207,5 @@ class WindowManager:
             "drop_before_window": self.drop_before_window,
             "drop_overflow": int(self.state.dropped_overflow),
             "occupancy": int(np.asarray(self.state.valid).sum()),
+            "acc_fill": self.fill,  # rows awaiting the next fold
         }

@@ -5,21 +5,31 @@ collector.rs:810; `SubQuadGen::inject_flow`, quadruple_generator.rs:544)
 with a fully static-shape XLA pattern:
 
     lax.sort((slot, key_hi, key_lo, iota), num_keys=3)
-      → head flags from key-change deltas
-      → segmented inclusive scans (associative_scan) per merge class
-      → boundary gathers at run edges, compaction via one aux sort
+      → head flags from key-change deltas → segment ids (one cumsum)
+      → segment_sum / segment_max with sorted ids, num_segments = cap
+      → representative-row gathers only at the ≤cap segment heads
 
-Layout is column-major: tag and meter payloads are [T, N] / [M, N] with
-the row axis minor. On TPU the minor axis maps to the 128-wide vector
-lanes, so every per-column op is a contiguous [N] vector op; the
-row-major [N, T] layout this replaced wasted (128-T)/128 of each tile
-and made column extraction a strided gather (measured 7.2 ms vs 0.02 ms
-for one [40, 128k] gather on v5e — see PERF.md).
+Layout at the interface is column-major ([T, N] / [M, N] with the row
+axis minor — it maps rows onto the 128-wide vector lanes and keeps
+column selection free); the meter payload is transposed to row-major
+internally because one row-gather of [N, M] moves M contiguous elements
+per index, which measures ~17x better than M strided lane-gathers.
 
-Everything is O(N log N) compare-exchange on u32 lanes plus log-depth
-scans — no data-dependent shapes, and no scatter anywhere (XLA lowers
-scatter poorly on TPU; the one index-construction scatter the v2 kernel
-kept was still its bottleneck).
+Kernel selection is measurement-driven (PERF.md, round 4, v5e):
+  * round-3 segmented `associative_scan`: 5.4-35 ms at 32k rows and
+    superlinear compile times — replaced by this kernel.
+  * round-2 row-major segment ops: 4.9 ms at 32k; this kernel is the
+    same reduction with the gathers restricted to segment heads and
+    `num_segments` capped at the stash capacity instead of N.
+  * the sort itself costs 3.3 ms at 32k but only 4.0 ms at 131k — it is
+    overhead-dominated at batch sizes, which is why the stash
+    accumulates raw rows and amortizes ONE big sort over many batches
+    (see aggregator/stash.py).
+
+Everything is O(N log N) compare-exchange on u32 lanes plus linear
+segment passes — no data-dependent shapes, no scatter (XLA lowers
+scatter poorly on TPU: a 65k-row scatter-add measured 4 ms, as much as
+the whole sort).
 """
 
 from __future__ import annotations
@@ -52,25 +62,6 @@ class Grouped:
     meters: jnp.ndarray  # [M, cap] f32 — reduced
     seg_valid: jnp.ndarray  # [cap] bool
     num_segments: jnp.ndarray  # scalar i32 — live segment count (may exceed cap)
-
-
-def _seg_scan(vals: jnp.ndarray, head: jnp.ndarray, op) -> jnp.ndarray:
-    """Segmented inclusive scan along the minor axis.
-
-    vals: [C, N]; head: [N] bool, True where a new run starts. Returns
-    [C, N] where each position holds the reduction of its run's prefix —
-    so a run's *last* position holds the run total. log2(N) fused
-    elementwise passes; no scatter.
-    """
-    flags = jnp.broadcast_to(head[None, :], vals.shape)
-
-    def comb(a, b):
-        av, af = a
-        bv, bf = b
-        return jnp.where(bf, bv, op(av, bv)), af | bf
-
-    out, _ = lax.associative_scan(comb, (vals, flags), axis=1)
-    return out
 
 
 def groupby_reduce(
@@ -114,53 +105,55 @@ def groupby_reduce(
             (s_slot[1:] != s_slot[:-1]) | (s_hi[1:] != s_hi[:-1]) | (s_lo[1:] != s_lo[:-1]),
         ]
     )
-
-    meters_sorted = jnp.take(meters_t, perm, axis=1)  # [M, N]
-
-    # Per merge-class segmented scans; reassemble rows in schema order
-    # (static permutation — free at trace time).
-    scanned_rows: list = [None] * m
-    if sum_cols.size:
-        part = _seg_scan(meters_sorted[sum_cols, :], head, lambda a, b: a + b)
-        for j, c in enumerate(sum_cols):
-            scanned_rows[int(c)] = part[j]
-    if max_cols.size:
-        part = _seg_scan(meters_sorted[max_cols, :], head, jnp.maximum)
-        for j, c in enumerate(max_cols):
-            scanned_rows[int(c)] = part[j]
-    scanned = jnp.stack(scanned_rows) if m else meters_sorted
-
-    # Sentinel rows sort after every live row, so live rows are a prefix.
+    # Sentinel rows sort after every live row, so live rows are a prefix
+    # and live segments are exactly segment ids [0, num_seg).
     live_row = s_slot != jnp.uint32(SENTINEL_SLOT)
     live_head = head & live_row
     num_seg = jnp.sum(live_head.astype(jnp.int32))
-    n_live = jnp.sum(live_row.astype(jnp.int32))
+    seg_id = jnp.cumsum(head.astype(jnp.int32)) - 1  # [N] ascending
+    # Dead rows get an out-of-range id so every segment op drops them.
+    # It must be `n`, not `cap`: live overflow segments carry ids in
+    # [cap, num_seg) and the id sequence must stay ascending for the
+    # indices_are_sorted hint below to be honest.
+    seg_id = jnp.where(live_row, seg_id, n)
 
-    # Compaction without scatter: ascending positions of live run heads
-    # via one 1-lane sort (dead lanes key to U32_MAX and sink).
-    head_pos = jnp.sort(jnp.where(live_head, iota.astype(jnp.uint32), _U32_MAX))
-    # +1: the next head bounds the last kept run; pad so the slice is
-    # always in range even at cap == N.
-    head_pos = jnp.concatenate([head_pos, jnp.full((1,), _U32_MAX, jnp.uint32)])
-    first_pos = head_pos[: cap + 1]
+    # One row-gather moves all M meter lanes of a row at once.
+    meters_rows = jnp.take(meters_t.T, perm, axis=0)  # [N, M]
+
+    reduced_cols: list = [None] * m
+    if sum_cols.size:
+        part = jax.ops.segment_sum(
+            meters_rows[:, sum_cols], seg_id, num_segments=cap, indices_are_sorted=True
+        )
+        for j, c in enumerate(sum_cols):
+            reduced_cols[int(c)] = part[:, j]
+    if max_cols.size:
+        part = jax.ops.segment_max(
+            meters_rows[:, max_cols], seg_id, num_segments=cap, indices_are_sorted=True
+        )
+        # (segment_max yields -inf for empty segments; the seg_valid mask
+        # below zeroes those columns, so no isfinite rewrite — it would
+        # also mask NaNs from genuinely corrupt meters.)
+        for j, c in enumerate(max_cols):
+            reduced_cols[int(c)] = part[:, j]
+    out_meters = jnp.stack(reduced_cols) if m else jnp.zeros((0, cap), meters_t.dtype)
+
+    # First sorted position of each kept segment (head positions), via a
+    # segment_min instead of a second full sort.
+    first_pos = jax.ops.segment_min(
+        iota, seg_id, num_segments=cap, indices_are_sorted=True
+    )
 
     k = jnp.arange(cap, dtype=jnp.int32)
     seg_valid = k < jnp.minimum(num_seg, cap)
-    fp = jnp.where(seg_valid, first_pos[:cap], 0).astype(jnp.int32)
-    # A run ends where the next one starts; the globally-last live run
-    # ends at the last live row.
-    has_next = k + 1 < num_seg
-    lp = jnp.where(
-        has_next, first_pos[1 : cap + 1].astype(jnp.int32) - 1, n_live - 1
-    )
-    lp = jnp.clip(jnp.where(seg_valid, lp, 0), 0, n - 1)
+    fp = jnp.where(seg_valid, first_pos, 0).astype(jnp.int32)
 
     out_slot = jnp.where(seg_valid, jnp.take(s_slot, fp), jnp.uint32(SENTINEL_SLOT))
     out_hi = jnp.where(seg_valid, jnp.take(s_hi, fp), 0)
     out_lo = jnp.where(seg_valid, jnp.take(s_lo, fp), 0)
     rep_orig = jnp.take(perm, fp)
     out_tags = jnp.where(seg_valid[None, :], jnp.take(tags_t, rep_orig, axis=1), 0)
-    out_meters = jnp.where(seg_valid[None, :], jnp.take(scanned, lp, axis=1), 0)
+    out_meters = jnp.where(seg_valid[None, :], out_meters, 0)
 
     return Grouped(
         slot=out_slot,

@@ -28,9 +28,15 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
-from ..aggregator.fanout import FanoutConfig
+from ..aggregator.fanout import FANOUT_LANES, FanoutConfig
 from ..aggregator.pipeline import make_ingest_step
-from ..aggregator.stash import StashState, stash_init
+from ..aggregator.stash import (
+    AccumState,
+    StashState,
+    accum_init,
+    plan_append,
+    stash_init,
+)
 from ..datamodel.schema import FLOW_METER, TAG_SCHEMA
 from ..ops.hashing import fingerprint64
 from ..ops.histogram import LogHistSpec, loghist_update
@@ -58,6 +64,9 @@ class ShardedConfig:
     cms_depth: int = 4
     cms_width: int = 1 << 14
     hist: LogHistSpec = LogHistSpec(bins=512, vmin=1.0, gamma=1.04)
+    # batches accumulated per device between sort+reduce folds
+    # (same amortization as WindowConfig.accum_batches)
+    accum_batches: int = 8
 
 
 class ShardedPipeline:
@@ -69,6 +78,7 @@ class ShardedPipeline:
         self.n_devices = mesh.devices.size
         self.axes = tuple(mesh.axis_names)  # ("host", "chip")
         self._step = self._build_step()
+        self._fold = self._build_fold()
         self._close = self._build_window_close()
         self._flush = self._build_flush()
 
@@ -91,20 +101,31 @@ class ShardedPipeline:
         sketches = jax.tree.map(lambda x: jax.device_put(x, spec), sketches)
         return stash, sketches
 
+    def init_acc(self, doc_rows_per_device: int) -> AccumState:
+        """Per-device accumulator ring, sized accum_batches × one batch's
+        fanout rows (lazy — the batch shape is only known at first ingest)."""
+        d = self.n_devices
+        cap = self.config.accum_batches * doc_rows_per_device
+        acc = accum_init(cap, TAG_SCHEMA, FLOW_METER)
+        acc = jax.tree.map(lambda x: jnp.broadcast_to(x[None], (d,) + x.shape), acc)
+        spec = NamedSharding(self.mesh, P(self.axes))
+        return jax.tree.map(lambda x: jax.device_put(x, spec), acc)
+
     # -- step -----------------------------------------------------------
     def _build_step(self):
         c = self.config
-        base_step = make_ingest_step(c.fanout, c.interval)
+        base_append, self._base_fold = make_ingest_step(c.fanout, c.interval)
         t_idx = TAG_SCHEMA.index
         m_idx = FLOW_METER.index
 
-        def device_step(stash, sk, tags, meters, valid):
+        def device_step(stash, acc, offset, sk, tags, meters, valid):
             # block shapes: stash [1, S, ...], tags {f: [1, n]}, ...
             stash1 = jax.tree.map(lambda x: x[0], stash)
+            acc1 = jax.tree.map(lambda x: x[0], acc)
             tags1 = {k: v[0] for k, v in tags.items()}
             meters1, valid1 = meters[0], valid[0]
 
-            new_stash = base_step(stash1, tags1, meters1, valid1)
+            new_stash, new_acc = base_append(stash1, acc1, offset, tags1, meters1, valid1)
 
             # Sketch updates from the raw flow batch (service-level keys).
             # service id: enrichment hook — until the PlatformInfoTable
@@ -130,6 +151,7 @@ class ShardedPipeline:
             expand = lambda x: x[None]
             return (
                 jax.tree.map(expand, new_stash),
+                jax.tree.map(expand, new_acc),
                 SketchPlanes(hll=hll[None], cms=cms[None], hist=hist[None]),
             )
 
@@ -137,14 +159,33 @@ class ShardedPipeline:
         mapped = shard_map(
             device_step,
             mesh=self.mesh,
-            in_specs=(pspec, pspec, pspec, pspec, pspec),
+            in_specs=(pspec, pspec, P(), pspec, pspec, pspec, pspec),
+            out_specs=(pspec, pspec, pspec),
+        )
+        return jax.jit(mapped, donate_argnums=(0, 1, 3))
+
+    def _build_fold(self):
+        def device_fold(stash, acc):
+            stash1 = jax.tree.map(lambda x: x[0], stash)
+            acc1 = jax.tree.map(lambda x: x[0], acc)
+            new_stash, new_acc = self._base_fold(stash1, acc1)
+            expand = lambda x: x[None]
+            return jax.tree.map(expand, new_stash), jax.tree.map(expand, new_acc)
+
+        pspec = P(self.axes)
+        mapped = shard_map(
+            device_fold,
+            mesh=self.mesh,
+            in_specs=(pspec, pspec),
             out_specs=(pspec, pspec),
         )
         return jax.jit(mapped, donate_argnums=(0, 1))
 
-    def step(self, stash, sketches, tags, meters, valid):
+    def step(self, stash, acc, offset, sketches, tags, meters, valid):
         """tags: {f: [D*n]} u32 (device-shardable), meters [D*n, M],
-        valid [D*n]. Leading dim must be divisible by the device count."""
+        valid [D*n]. Leading dim must be divisible by the device count.
+        `offset` is the per-device accumulator write position (host-tracked,
+        identical on every device)."""
         d = self.n_devices
 
         def shard_batch(x):
@@ -153,7 +194,12 @@ class ShardedPipeline:
         tags = {k: shard_batch(jnp.asarray(v)) for k, v in tags.items()}
         meters = shard_batch(jnp.asarray(meters))
         valid = shard_batch(jnp.asarray(valid))
-        return self._step(stash, sketches, tags, meters, valid)
+        return self._step(stash, acc, jnp.int32(offset), sketches, tags, meters, valid)
+
+    def fold(self, stash, acc):
+        """Amortized per-device sort+reduce of accumulated rows into the
+        stash (host fires it at accum_batches cadence and before flushes)."""
+        return self._fold(stash, acc)
 
     # -- window close ---------------------------------------------------
     def _build_window_close(self):
@@ -234,12 +280,20 @@ class ShardedWindowManager:
         self.interval = pipe.config.interval
         self.delay = delay
         self.stash, self.sketches = pipe.init_state()
+        self.acc = None  # per-device accumulator, sized on first batch
+        self.fill = 0  # host-tracked per-device accumulator rows
         self.start_window: int | None = None
         self.drop_before_window = 0
         self.total_flushed = 0
         # merged sketch views of the last closed window (None until one closes)
         self.global_view = None
         self.pod_1m = None
+
+    def _fold(self):
+        if self.fill == 0 or self.acc is None:
+            return
+        self.stash, self.acc = self.pipe.fold(self.stash, self.acc)
+        self.fill = 0
 
     def _flush_one(self, w: int):
         """Flush window w from every device stash → DocBatch | None."""
@@ -301,12 +355,23 @@ class ShardedWindowManager:
                 self.sketches
             )
 
-        self.stash, self.sketches = self.pipe.step(
-            self.stash, self.sketches, tags, meters, valid
+        rows_per_device = FANOUT_LANES * (int(ts_np.shape[0]) // self.pipe.n_devices)
+        cap = int(self.acc.slot.shape[1]) if self.acc is not None else None
+        plan = plan_append(self.fill, cap, rows_per_device)
+        if plan == "init":
+            self._fold()  # pending rows must reach the stash before the ring is replaced
+            self.acc = self.pipe.init_acc(max(rows_per_device, 1))
+            self.fill = 0
+        elif plan == "fold":
+            self._fold()
+        self.stash, self.acc, self.sketches = self.pipe.step(
+            self.stash, self.acc, self.fill, self.sketches, tags, meters, valid
         )
+        self.fill += rows_per_device
 
         flushed = []
         if advancing:
+            self._fold()  # flushed windows must see every accumulated row
             for w in self._occupied_windows():
                 if w >= new_start:
                     continue
@@ -321,6 +386,7 @@ class ShardedWindowManager:
         span past each drained window so a straggler ingest cannot
         re-open and re-emit it (same invariant as WindowManager.flush_all,
         window.py:159)."""
+        self._fold()
         flushed = []
         for w in self._occupied_windows():
             db = self._flush_one(w)

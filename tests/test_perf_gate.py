@@ -1,0 +1,76 @@
+"""Perf regression gate — small-shape smoke bounds on the hot path.
+
+Round 3 shipped a 7x kernel regression behind 164 green correctness
+tests because nothing in the suite watched time. This gate bounds, on
+the CPU backend the suite runs on (tests/conftest.py):
+
+  * compile+first-execute time of the append+fold pair, and
+  * steady-state per-batch time of the production cadence
+    (append × accum_batches + fold, aggregator/pipeline.py).
+
+Bounds are ~6x the values measured when the gate was written (PERF.md
+§gate: compile+first 2.7 s, steady 4.8 ms/batch at this shape on the
+build container's CPU), so host jitter can't flake it but an
+order-of-magnitude regression — the round-3 failure mode: superlinear
+compile blowup or a log-depth-scan kernel — still trips it.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+from deepflow_tpu.aggregator.fanout import FANOUT_LANES, FanoutConfig
+from deepflow_tpu.aggregator.pipeline import make_ingest_step
+from deepflow_tpu.aggregator.stash import accum_init, stash_init
+from deepflow_tpu.datamodel.schema import FLOW_METER, TAG_SCHEMA
+from deepflow_tpu.ingest.replay import SyntheticFlowGen
+
+BATCH = 1024
+CAPACITY = 1 << 12
+ACCUM_BATCHES = 4
+
+COMPILE_BOUND_S = 16.0
+STEADY_BOUND_MS = 30.0
+
+
+def test_hot_path_compile_and_steady_state_bounds():
+    gen = SyntheticFlowGen(num_tuples=500, seed=0)
+    fb = gen.flow_batch(BATCH, 1_700_000_000)
+    tags = {k: jnp.asarray(v) for k, v in fb.tags.items()}
+    meters, valid = jnp.asarray(fb.meters), jnp.asarray(fb.valid)
+
+    append_fn, fold_fn = make_ingest_step(FanoutConfig(), interval=1)
+    append = jax.jit(append_fn, donate_argnums=(0, 1))
+    fold = jax.jit(fold_fn, donate_argnums=(0, 1))
+
+    doc_rows = FANOUT_LANES * BATCH
+    state = stash_init(CAPACITY, TAG_SCHEMA, FLOW_METER)
+    acc = accum_init(ACCUM_BATCHES * doc_rows, TAG_SCHEMA, FLOW_METER)
+
+    t0 = time.perf_counter()
+    state, acc = append(state, acc, jnp.int32(0), tags, meters, valid)
+    state, acc = fold(state, acc)
+    jax.block_until_ready(acc.slot)
+    compile_s = time.perf_counter() - t0
+    assert compile_s < COMPILE_BOUND_S, (
+        f"hot-path compile+first-run took {compile_s:.1f}s "
+        f"(bound {COMPILE_BOUND_S}s) — compile-time regression"
+    )
+
+    cycles = 3
+    t0 = time.perf_counter()
+    for _ in range(cycles):
+        for k in range(ACCUM_BATCHES):
+            state, acc = append(
+                state, acc, jnp.int32(k * doc_rows), tags, meters, valid
+            )
+        state, acc = fold(state, acc)
+    jax.block_until_ready(acc.slot)
+    per_batch_ms = (time.perf_counter() - t0) / (cycles * ACCUM_BATCHES) * 1e3
+    assert per_batch_ms < STEADY_BOUND_MS, (
+        f"hot-path steady state {per_batch_ms:.1f} ms/batch "
+        f"(bound {STEADY_BOUND_MS} ms) — kernel regression"
+    )

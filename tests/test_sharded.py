@@ -31,7 +31,9 @@ def test_sharded_step_runs_and_counts_docs():
     stash, sketches = pipe.init_state()
 
     fb = _batch_for(pipe, 128)
-    stash, sketches = pipe.step(stash, sketches, fb.tags, fb.meters, fb.valid)
+    acc = pipe.init_acc(4 * 128)
+    stash, acc, sketches = pipe.step(stash, acc, 0, sketches, fb.tags, fb.meters, fb.valid)
+    stash, acc = pipe.fold(stash, acc)
 
     # every shard should now hold some valid stash rows
     valid = np.asarray(stash.valid)
@@ -55,7 +57,9 @@ def test_sharded_total_meters_match_input():
     fb = _batch_for(pipe, 64)
     in_pkt_tx = fb.meters[:, FLOW_METER.index("packet_tx")].sum()
 
-    stash, sketches = pipe.step(stash, sketches, fb.tags, fb.meters, fb.valid)
+    acc = pipe.init_acc(4 * 64)
+    stash, acc, sketches = pipe.step(stash, acc, 0, sketches, fb.tags, fb.meters, fb.valid)
+    stash, acc = pipe.fold(stash, acc)
 
     valid = np.asarray(stash.valid)
     # stash payloads are column-major [D, M, S] / [D, T, S]
@@ -95,7 +99,8 @@ def test_window_close_merges_hll_across_devices():
     fb.tags["l3_epc_id1"][:] = 5
     fb.tags["server_port"][:] = 443
 
-    stash, sketches = pipe.step(stash, sketches, fb.tags, fb.meters, fb.valid)
+    acc = pipe.init_acc(4 * 512)
+    stash, acc, sketches = pipe.step(stash, acc, 0, sketches, fb.tags, fb.meters, fb.valid)
     reset, global_view, pod_1m = pipe.window_close(sketches)
 
     # local planes zeroed
@@ -170,6 +175,49 @@ def test_sharded_doc_flush_matches_single_device_oracle():
     b = _groupby_docs(single_docs, FLOW_METER)
     assert a.keys() == b.keys()
     assert len(a) > 0
+    for k in a:
+        np.testing.assert_allclose(a[k], b[k], rtol=1e-5, atol=1e-5)
+
+
+def test_sharded_growing_batch_keeps_accumulated_rows():
+    """Regression twin of test_window_manager_growing_batch_keeps_accumulated_rows
+    for the sharded manager: a batch bigger than the per-device ring must
+    fold pending rows before replacing it, on every device."""
+    from deepflow_tpu.parallel.sharded import ShardedWindowManager
+
+    mesh = make_mesh(8, n_hosts=2)
+    cfg = ShardedConfig(
+        capacity_per_device=1 << 10, num_services=16, hll_precision=8,
+        accum_batches=2,
+    )
+    pipe = ShardedPipeline(mesh, cfg)
+    swm = ShardedWindowManager(pipe)
+
+    gen = SyntheticFlowGen(num_tuples=5000, seed=13)
+    t0 = 7000
+    fb_small = gen.flow_batch(8 * 8, t0)  # sizes ring at 2×32 rows/device
+    fb_big = gen.flow_batch(8 * 64, t0)  # 256 rows/device > ring → re-init
+    docs = []
+    docs += swm.ingest(fb_small.tags, fb_small.meters, fb_small.valid)
+    docs += swm.ingest(fb_big.tags, fb_big.meters, fb_big.valid)
+    fb_tick = gen.flow_batch(8, t0 + 10)  # close window t0
+    docs += swm.ingest(fb_tick.tags, fb_tick.meters, fb_tick.valid)
+
+    # single-device oracle over the identical stream
+    from deepflow_tpu.aggregator.pipeline import PipelineConfig, RollupPipeline
+    from deepflow_tpu.aggregator.window import WindowConfig
+    from deepflow_tpu.datamodel.schema import FLOW_METER
+
+    single = RollupPipeline(
+        PipelineConfig(window=WindowConfig(capacity=1 << 14), batch_size=512)
+    )
+    sdocs = []
+    for fb in (fb_small, fb_big, fb_tick):
+        sdocs += single.ingest(FlowBatch(tags=fb.tags, meters=fb.meters, valid=fb.valid))
+
+    a = _groupby_docs(docs, FLOW_METER)
+    b = _groupby_docs(sdocs, FLOW_METER)
+    assert len(a) > 0 and a.keys() == b.keys()
     for k in a:
         np.testing.assert_allclose(a[k], b[k], rtol=1e-5, atol=1e-5)
 

@@ -99,6 +99,31 @@ def test_window_manager_flushes_after_delay():
     assert wm.counters["occupancy"] == 0
 
 
+def test_window_manager_growing_batch_keeps_accumulated_rows():
+    """Regression: a batch larger than the accumulator ring re-initializes
+    it; pending rows must be folded into the stash first, not dropped."""
+    wm = WindowManager(
+        WindowConfig(interval=1, delay=2, capacity=64, accum_batches=2),
+        TINY_TAGS,
+        TINY_METER,
+    )
+
+    def batch(n, ts, key0):
+        return (
+            jnp.full((n,), ts, dtype=jnp.uint32),
+            jnp.asarray(np.arange(key0, key0 + n, dtype=np.uint32)),
+            jnp.zeros(n, dtype=jnp.uint32),
+            jnp.zeros((2, n), dtype=jnp.uint32),
+            jnp.ones((3, n), dtype=jnp.float32),
+            jnp.ones(n, dtype=bool),
+        )
+
+    wm.ingest(*batch(2, 50, 0))  # ring sized 2×2=4, fill=2
+    wm.ingest(*batch(8, 50, 100))  # bigger than ring → re-init path
+    flushed = wm.ingest(*batch(1, 60, 999))  # close window 50
+    assert sum(f.count for f in flushed) == 10  # 2 + 8, nothing lost
+
+
 def test_window_manager_multi_window_batch():
     wm = WindowManager(WindowConfig(interval=1, delay=1, capacity=32), TINY_TAGS, TINY_METER)
     ts = [10, 11, 12, 13, 14]
