@@ -226,6 +226,8 @@ L7_FLOW_LOG = LogSchema(
             _s("response_exception"),
             _s("trace_id"),
             _s("span_id"),
+            _s("parent_span_id"),
+            _s("x_request_id"),
             _s("app_service"),
             _s("app_instance"),
         ]

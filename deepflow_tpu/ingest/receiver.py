@@ -17,8 +17,16 @@ from __future__ import annotations
 import socket
 import threading
 import time
+import zlib
 
-from .framing import HEADER_LEN, FlowHeader, FrameReassembler, MessageType
+from .framing import (
+    ENCODER_RAW,
+    HEADER_LEN,
+    FlowHeader,
+    FrameReassembler,
+    MessageType,
+    decompress_body,
+)
 
 
 class AgentStatus:
@@ -117,6 +125,17 @@ class Receiver:
             self.counters[key] += n
 
     def _dispatch(self, header: FlowHeader, raw_frame: bytes, addr) -> None:
+        if header.encoder != ENCODER_RAW:
+            # decompress at the front door and re-frame raw, so every
+            # downstream consumer keeps its encoder-oblivious parse
+            try:
+                body = decompress_body(raw_frame[HEADER_LEN:], header.encoder)
+            except (ValueError, zlib.error):
+                self._count("bad_frames")
+                return
+            header.encoder = ENCODER_RAW
+            header.frame_size = HEADER_LEN + len(body)
+            raw_frame = header.encode() + body
         key = (header.organization_id, header.agent_id)
         with self._stats_lock:
             self.counters["rx_frames"] += 1

@@ -14,7 +14,14 @@ import socket
 import threading
 import time
 
-from .framing import MAX_FRAME_SIZE, FlowHeader, MessageType, encode_frame
+from .framing import (
+    ENCODER_RAW,
+    MAX_FRAME_SIZE,
+    FlowHeader,
+    MessageType,
+    best_encoder,
+    encode_frame,
+)
 from .queues import new_queue
 
 
@@ -31,11 +38,14 @@ class UniformSender:
         flush_interval: float = 0.2,
         queue_capacity: int = 1 << 14,
         prefer_native_queue: bool = True,
+        compression: int | str = ENCODER_RAW,
     ):
         if not servers:
             raise ValueError("need at least one server")
         self.servers = list(servers)
         self.msg_type = MessageType(msg_type)
+        # "auto" = strongest codec available in-process (framing.best_encoder)
+        self.compression = best_encoder() if compression == "auto" else int(compression)
         self.agent_id = agent_id
         self.team_id = team_id
         self.organization_id = organization_id
@@ -95,7 +105,7 @@ class UniformSender:
         )
         # encode_frame enforces MAX_FRAME_SIZE — a frame that encodes is
         # always accepted by the receiver's reassembler
-        return encode_frame(header, msgs)
+        return encode_frame(header, msgs, encoder=self.compression)
 
     def _run(self) -> None:
         backoff = 0.05

