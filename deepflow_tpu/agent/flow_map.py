@@ -173,10 +173,20 @@ def packets_to_flow_rows(p: PacketBatch) -> tuple[np.ndarray, np.ndarray, np.nda
     is_syn = (f & TCP_SYN != 0) & (f & TCP_ACK == 0)
     is_synack = (f & TCP_SYN != 0) & (f & TCP_ACK != 0)
     pure_ack = (f == TCP_ACK) & (p.payload_len == 0)
-    ints[:, _II("syn_time")] = np.where(is_syn, p.timestamp_s, _ABSENT)
-    ints[:, _II("synack_time")] = np.where(is_synack, p.timestamp_s, _ABSENT)
-    ints[:, _II("ack_time_d0")] = np.where(pure_ack & ~d1, p.timestamp_s, _ABSENT)
-    ints[:, _II("ack_time_d1")] = np.where(pure_ack & d1, p.timestamp_s, _ABSENT)
+    # handshake clocks run in µs (mod 2^32) so RTTs keep microsecond
+    # resolution like the reference's TcpPerf (perf/tcp.rs works on
+    # 64-bit µs Timestamps); the 71-minute wrap only matters if a
+    # handshake straddles it — u32 subtraction still yields the right
+    # difference then, only the MIN merge order could pick the later
+    # timestamp (documented approximation)
+    ts_us32 = (
+        p.timestamp_s.astype(np.uint64) * np.uint64(1_000_000)
+        + p.timestamp_us.astype(np.uint64)
+    ).astype(np.uint32)
+    ints[:, _II("syn_time")] = np.where(is_syn, ts_us32, _ABSENT)
+    ints[:, _II("synack_time")] = np.where(is_synack, ts_us32, _ABSENT)
+    ints[:, _II("ack_time_d0")] = np.where(pure_ack & ~d1, ts_us32, _ABSENT)
+    ints[:, _II("ack_time_d1")] = np.where(pure_ack & d1, ts_us32, _ABSENT)
     ints[:, _II("syn_dir")] = np.where(is_syn, np.where(d1, 2, 1), 0)
 
     one = np.ones(n, np.float32)
@@ -292,15 +302,21 @@ def _flow_tick_impl(state: LogStashState, now, cfg: _TickCfg):
     active = valid & (ncol("packet_d0") + ncol("packet_d1") > 0)
     emit = active | closing_flow
 
-    # RTT (µs in the reference; seconds-resolution here — timestamps are
-    # 1s grained, so handshake RTTs quantize to 0 within a second)
+    # RTT in µs (handshake lanes carry the µs-mod-2^32 clock; matches
+    # the reference's µs TcpPerf, perf/tcp.rs)
     syn_t, synack_t = icol("syn_time"), icol("synack_time")
     ack_t = jnp.where(client_is_ep1, icol("ack_time_d1"), icol("ack_time_d0"))
     absent = jnp.uint32(_ABSENT)
-    have_cli = (syn_t != absent) & (synack_t != absent) & (synack_t >= syn_t)
-    have_srv = (synack_t != absent) & (ack_t != absent) & (ack_t >= synack_t)
-    rtt_client = jnp.where(have_cli, synack_t - syn_t, 0)
-    rtt_server = jnp.where(have_srv, ack_t - synack_t, 0)
+    # wrap-tolerant ordering: the u32 µs difference is the true RTT as
+    # long as it lands under 2^31 (handshakes are short), so a clock
+    # wrap between SYN and SYN-ACK still measures correctly
+    d_cli = synack_t - syn_t
+    d_srv = ack_t - synack_t
+    half = jnp.uint32(0x80000000)
+    have_cli = (syn_t != absent) & (synack_t != absent) & (d_cli < half)
+    have_srv = (synack_t != absent) & (ack_t != absent) & (d_srv < half)
+    rtt_client = jnp.where(have_cli, d_cli, 0)
+    rtt_server = jnp.where(have_srv, d_srv, 0)
 
     out = {
         "close": closing_flow,

@@ -291,7 +291,26 @@ _PARSERS: list[tuple[int, object, object]] = [
     (L7Protocol.MYSQL, check_mysql, parse_mysql),
 ]
 
-_PORT_HINTS = {53: L7Protocol.DNS, 3306: L7Protocol.MYSQL, 6379: L7Protocol.REDIS}
+_PORT_HINTS = {
+    53: L7Protocol.DNS,
+    3306: L7Protocol.MYSQL,
+    6379: L7Protocol.REDIS,
+    443: L7Protocol.TLS,
+    5432: L7Protocol.POSTGRESQL,
+    9092: L7Protocol.KAFKA,
+    27017: L7Protocol.MONGODB,
+    20880: L7Protocol.DUBBO,
+}
+
+
+def register_parser(protocol: int, check, parse) -> None:
+    """Extension seat (the reference's L7ProtocolParserInterface registry,
+    protocol_logs/mod.rs impl_protocol_parser!)."""
+    for i, (p, _c, _p) in enumerate(_PARSERS):
+        if p == protocol:
+            _PARSERS[i] = (protocol, check, parse)
+            return
+    _PARSERS.append((protocol, check, parse))
 
 
 def infer_protocol(payload: bytes, server_port: int = 0) -> int:
@@ -315,3 +334,28 @@ def parse_payload(protocol: int, payload: bytes) -> L7Message | None:
         if proto == protocol:
             return parse(payload)
     return None
+
+
+def _register_wave2() -> None:
+    """Wave-2 parsers live in sibling modules; importing here keeps the
+    single registry while avoiding a cyclic import at module top."""
+    from . import parsers_ext as ext
+    from .http2 import check_http2, parse_http2
+
+    register_parser(L7Protocol.HTTP2, check_http2, parse_http2)
+    register_parser(L7Protocol.TLS, ext.check_tls, ext.parse_tls)
+    register_parser(L7Protocol.POSTGRESQL, ext.check_postgresql, ext.parse_postgresql)
+    register_parser(L7Protocol.MONGODB, ext.check_mongodb, ext.parse_mongodb)
+    register_parser(L7Protocol.DUBBO, ext.check_dubbo, ext.parse_dubbo)
+    # kafka last: its request heuristic is the loosest (mq/kafka.rs also
+    # orders bespoke-magic protocols before it)
+    register_parser(L7Protocol.KAFKA, ext.check_kafka, ext.parse_kafka)
+
+
+_register_wave2()
+
+# GRPC rides the HTTP2 parser (content-type dispatch); parse_payload on
+# GRPC must resolve too
+from .http2 import parse_http2 as _p2  # noqa: E402
+
+register_parser(L7Protocol.GRPC, lambda p, port=0: False, _p2)

@@ -1,0 +1,422 @@
+"""L7 parsers, wave 2: TLS, Kafka, PostgreSQL, MongoDB, Dubbo.
+
+Behavioral peers of the reference parsers (protocol_logs/{tls.rs,
+mq/kafka.rs, sql/postgresql.rs, sql/mongo.rs, rpc/dubbo.rs}); all wire
+layouts implemented from the public protocol specs. Each exposes the
+same (check, parse) pair as parsers.py and registers into its registry.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from ...datamodel.code import L7Protocol
+from .parsers import (
+    MSG_REQUEST,
+    MSG_RESPONSE,
+    STATUS_CLIENT_ERROR,
+    STATUS_OK,
+    STATUS_SERVER_ERROR,
+    L7Message,
+    obfuscate_sql,
+)
+
+# ---------------------------------------------------------------------------
+# TLS (tls.rs) — record layer + ClientHello SNI / ServerHello version
+
+_TLS_HANDSHAKE = 22
+_CLIENT_HELLO = 1
+_SERVER_HELLO = 2
+_TLS_VERSIONS = {0x0301: "1.0", 0x0302: "1.1", 0x0303: "1.2", 0x0304: "1.3"}
+
+
+def check_tls(payload: bytes, port: int = 0) -> bool:
+    if len(payload) < 6:
+        return False
+    typ, maj, mi = payload[0], payload[1], payload[2]
+    return typ in (20, 21, 22, 23) and maj == 3 and mi <= 4 and (
+        typ != _TLS_HANDSHAKE or payload[5] in (_CLIENT_HELLO, _SERVER_HELLO)
+    )
+
+
+def _hello_fields(body: bytes) -> tuple[int, str]:
+    """(legacy_version, sni) from a ClientHello body; best effort."""
+    try:
+        ver = int.from_bytes(body[0:2], "big")
+        off = 2 + 32  # version + random
+        sid_len = body[off]
+        off += 1 + sid_len
+        cs_len = int.from_bytes(body[off : off + 2], "big")
+        off += 2 + cs_len
+        comp_len = body[off]
+        off += 1 + comp_len
+        if off + 2 > len(body):
+            return ver, ""
+        ext_len = int.from_bytes(body[off : off + 2], "big")
+        off += 2
+        end = min(off + ext_len, len(body))
+        while off + 4 <= end:
+            etype = int.from_bytes(body[off : off + 2], "big")
+            elen = int.from_bytes(body[off + 2 : off + 4], "big")
+            off += 4
+            if etype == 0 and off + 5 <= len(body):  # server_name
+                # list_len u16, type u8, name_len u16
+                name_len = int.from_bytes(body[off + 3 : off + 5], "big")
+                return ver, body[off + 5 : off + 5 + name_len].decode(errors="replace")
+            off += elen
+        return ver, ""
+    except (IndexError, struct.error):
+        return 0, ""
+
+
+def parse_tls(payload: bytes) -> L7Message | None:
+    try:
+        if payload[0] != _TLS_HANDSHAKE:
+            return None
+        hs_type = payload[5]
+        body = payload[9 : 9 + int.from_bytes(payload[6:9], "big")]
+        if hs_type == _CLIENT_HELLO:
+            ver, sni = _hello_fields(body)
+            return L7Message(
+                protocol=L7Protocol.TLS,
+                msg_type=MSG_REQUEST,
+                version=_TLS_VERSIONS.get(ver, ""),
+                request_type="ClientHello",
+                request_domain=sni,
+                request_resource=sni,
+                endpoint=sni,
+            )
+        if hs_type == _SERVER_HELLO:
+            ver = int.from_bytes(body[0:2], "big") if len(body) >= 2 else 0
+            return L7Message(
+                protocol=L7Protocol.TLS,
+                msg_type=MSG_RESPONSE,
+                version=_TLS_VERSIONS.get(ver, ""),
+                request_type="ServerHello",
+            )
+        return None
+    except (IndexError, struct.error):
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Kafka (mq/kafka.rs) — [size u32][api_key u16][api_ver u16][corr u32]
+#                       [client_id u16-prefixed]
+
+# api_key -> (name, max request version): the version cap is the request/
+# response discriminator — a "request" whose version exceeds its API's
+# ceiling is a response whose correlation id happened to alias the field
+# (kafka.rs keeps per-flow session state for the same purpose).
+_KAFKA_APIS = {
+    0: ("Produce", 11), 1: ("Fetch", 17), 2: ("ListOffsets", 9),
+    3: ("Metadata", 13), 8: ("OffsetCommit", 9), 9: ("OffsetFetch", 9),
+    10: ("FindCoordinator", 6), 11: ("JoinGroup", 9), 12: ("Heartbeat", 4),
+    13: ("LeaveGroup", 5), 14: ("SyncGroup", 5), 15: ("DescribeGroups", 5),
+    16: ("ListGroups", 5), 17: ("SaslHandshake", 1), 18: ("ApiVersions", 4),
+    19: ("CreateTopics", 7), 20: ("DeleteTopics", 6),
+    36: ("SaslAuthenticate", 2),
+}
+_KAFKA_MAX_API = 74
+
+
+def check_kafka(payload: bytes, port: int = 0) -> bool:
+    if len(payload) < 12:
+        return False
+    size = int.from_bytes(payload[0:4], "big")
+    api_key = int.from_bytes(payload[4:6], "big")
+    api_ver = int.from_bytes(payload[6:8], "big")
+    entry = _KAFKA_APIS.get(api_key)
+    req_ok = size + 4 >= len(payload) and entry is not None and api_ver <= entry[1]
+    return (port == 9092 and size > 0) or req_ok
+
+
+def parse_kafka(payload: bytes) -> L7Message | None:
+    try:
+        if len(payload) < 8:
+            return None
+        api_key = int.from_bytes(payload[4:6], "big")
+        api_ver = int.from_bytes(payload[6:8], "big")
+        entry = _KAFKA_APIS.get(api_key)
+        if entry is not None and api_ver <= entry[1]:
+            corr = int.from_bytes(payload[8:12], "big")
+            topic = ""
+            name = entry[0]
+            return L7Message(
+                protocol=L7Protocol.KAFKA,
+                msg_type=MSG_REQUEST,
+                version=str(api_ver),
+                request_type=name,
+                request_resource=topic,
+                endpoint=name,
+                request_id=corr,
+            )
+        # response: [size][correlation_id] and nothing request-like
+        corr = int.from_bytes(payload[4:8], "big")
+        return L7Message(
+            protocol=L7Protocol.KAFKA,
+            msg_type=MSG_RESPONSE,
+            request_id=corr,
+        )
+    except (IndexError, struct.error):
+        return None
+
+
+# ---------------------------------------------------------------------------
+# PostgreSQL (sql/postgresql.rs) — typed messages ['Q' len sql...], etc.
+
+_PG_REQ = {b"Q": "QUERY", b"P": "PARSE", b"B": "BIND", b"E": "EXECUTE", b"F": "FASTPATH"}
+_PG_RESP_OK = (b"C", b"T", b"D", b"Z", b"1", b"2", b"n", b"s")
+# CommandComplete tags (command word leads); used to disambiguate the
+# 'C' byte from the frontend Close message (both use the tag)
+_PG_COMPLETE_TAGS = (
+    b"SELECT", b"INSERT", b"UPDATE", b"DELETE", b"BEGIN", b"COMMIT",
+    b"ROLLBACK", b"FETCH", b"COPY", b"CREATE", b"DROP", b"ALTER", b"SET",
+    b"MOVE", b"TRUNCATE",
+)
+# ErrorResponse field-type bytes (severity/code lead in practice)
+_PG_ERR_FIELDS = b"SVC"
+
+
+def _pg_is_error_response(payload: bytes) -> bool:
+    """'E' is both frontend Execute and backend ErrorResponse; the error
+    body is field-structured ([type u8][cstr]...) while Execute is
+    [portal cstr][maxrows i32]."""
+    body = payload[5:]
+    return bool(body) and body[0:1] in (b"S", b"V") and b"\x00" in body
+
+
+def check_postgresql(payload: bytes, port: int = 0) -> bool:
+    if len(payload) < 5:
+        return False
+    if payload[0:1] in _PG_REQ or payload[0:1] in (b"R", b"S", b"K", b"C", b"T", b"E"):
+        ln = int.from_bytes(payload[1:5], "big")
+        return 4 <= ln <= 1 << 24 and (port == 5432 or ln <= len(payload) + 16)
+    # startup message: len u32, protocol 3.0 = 196608
+    ln = int.from_bytes(payload[0:4], "big")
+    proto = int.from_bytes(payload[4:8], "big") if len(payload) >= 8 else 0
+    return proto in (196608, 80877103) and ln <= 1 << 16
+
+
+def parse_postgresql(payload: bytes) -> L7Message | None:
+    try:
+        t = payload[0:1]
+        if t == b"E" and not _pg_is_error_response(payload):
+            return L7Message(
+                protocol=L7Protocol.POSTGRESQL,
+                msg_type=MSG_REQUEST,
+                request_type="EXECUTE",
+                endpoint="EXECUTE",
+            )
+        if t == b"C" and not payload[5:].split(b"\x00", 1)[0].startswith(
+            _PG_COMPLETE_TAGS
+        ):
+            # frontend Close ('S'/'P' + name), not CommandComplete
+            return L7Message(
+                protocol=L7Protocol.POSTGRESQL,
+                msg_type=MSG_REQUEST,
+                request_type="CLOSE",
+                endpoint="CLOSE",
+            )
+        if t == b"Q" or t == b"P":
+            body = payload[5:]
+            if t == b"P":  # Parse: statement name \0 query \0
+                _, _, body = body.partition(b"\x00")
+            sql = body.split(b"\x00", 1)[0].decode(errors="replace")
+            stmt = obfuscate_sql(sql)
+            verb = stmt.split(" ", 1)[0].upper() if stmt else _PG_REQ[t]
+            return L7Message(
+                protocol=L7Protocol.POSTGRESQL,
+                msg_type=MSG_REQUEST,
+                request_type=verb,
+                request_resource=stmt,
+                endpoint=verb,
+            )
+        if t == b"C":  # CommandComplete ("SELECT 1\0")
+            tag = payload[5:].split(b"\x00", 1)[0].decode(errors="replace")
+            return L7Message(
+                protocol=L7Protocol.POSTGRESQL,
+                msg_type=MSG_RESPONSE,
+                request_resource=tag,
+            )
+        if t == b"E":  # ErrorResponse: fields [code u8][str \0]...
+            severity, code = "", ""
+            off = 5
+            while off < len(payload) and payload[off] != 0:
+                f = payload[off : off + 1]
+                end = payload.index(b"\x00", off + 1)
+                val = payload[off + 1 : end].decode(errors="replace")
+                if f == b"S":
+                    severity = val
+                elif f == b"C":
+                    code = val
+                off = end + 1
+            status = (
+                STATUS_CLIENT_ERROR
+                if code.startswith(("42", "22", "23"))  # syntax/data/integrity
+                else STATUS_SERVER_ERROR
+            )
+            return L7Message(
+                protocol=L7Protocol.POSTGRESQL,
+                msg_type=MSG_RESPONSE,
+                status=status,
+                request_resource=f"{severity} {code}".strip(),
+            )
+        if t in _PG_RESP_OK:
+            return L7Message(protocol=L7Protocol.POSTGRESQL, msg_type=MSG_RESPONSE)
+        return None
+    except (IndexError, ValueError):
+        return None
+
+
+# ---------------------------------------------------------------------------
+# MongoDB (sql/mongo.rs) — wire header [len i32 LE][req id][responseTo][op]
+
+_OP_MSG = 2013
+_OP_QUERY = 2004
+_OP_REPLY = 1
+_MONGO_OPS = {_OP_MSG, _OP_QUERY, _OP_REPLY, 2001, 2002, 2005, 2006, 2007, 2010, 2011, 2012}
+_MONGO_CMDS = (
+    "find", "insert", "update", "delete", "aggregate", "count", "distinct",
+    "findAndModify", "getMore", "hello", "isMaster", "ping", "saslStart",
+    "saslContinue", "listCollections", "listDatabases", "create", "drop",
+)
+
+
+def check_mongodb(payload: bytes, port: int = 0) -> bool:
+    if len(payload) < 16:
+        return False
+    ln = int.from_bytes(payload[0:4], "little")
+    op = int.from_bytes(payload[12:16], "little")
+    return op in _MONGO_OPS and 16 <= ln <= 48 << 20 and (port == 27017 or ln <= len(payload) + 64)
+
+
+def _bson_first_key(doc: bytes) -> str:
+    """First element name of a BSON document (the command verb)."""
+    if len(doc) < 6:
+        return ""
+    # [len i32][etype u8][name \0]...
+    end = doc.find(b"\x00", 5)
+    if end < 0:
+        return ""
+    return doc[5:end].decode(errors="replace")
+
+
+def parse_mongodb(payload: bytes) -> L7Message | None:
+    try:
+        if len(payload) < 16:
+            return None
+        req_id = int.from_bytes(payload[4:8], "little")
+        response_to = int.from_bytes(payload[8:12], "little")
+        op = int.from_bytes(payload[12:16], "little")
+        is_resp = response_to != 0 or op == _OP_REPLY
+        cmd = ""
+        if op == _OP_MSG and len(payload) > 21:
+            # [flags u32][section kind u8][BSON doc]
+            cmd = _bson_first_key(payload[21:])
+        elif op == _OP_QUERY:
+            # [flags u32][fullCollectionName \0][skip][ret][BSON]
+            end = payload.find(b"\x00", 20)
+            if end > 0:
+                cmd = payload[20:end].decode(errors="replace")
+        if is_resp:
+            return L7Message(
+                protocol=L7Protocol.MONGODB,
+                msg_type=MSG_RESPONSE,
+                request_id=response_to or req_id,
+            )
+        known = cmd in _MONGO_CMDS or "." in cmd
+        return L7Message(
+            protocol=L7Protocol.MONGODB,
+            msg_type=MSG_REQUEST,
+            request_type=cmd if known or cmd else f"op_{op}",
+            request_resource=cmd,
+            endpoint=cmd,
+            request_id=req_id,
+        )
+    except (IndexError, struct.error):
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Dubbo (rpc/dubbo.rs) — magic 0xdabb header + hessian2 body strings
+
+_DUBBO_MAGIC = b"\xda\xbb"
+_FLAG_REQUEST = 0x80
+_FLAG_EVENT = 0x20
+
+
+def check_dubbo(payload: bytes, port: int = 0) -> bool:
+    return len(payload) >= 16 and payload[:2] == _DUBBO_MAGIC
+
+
+def _hessian_strings(body: bytes, limit: int = 4) -> list[str]:
+    """Leading hessian2-encoded short strings ("2.0.2", service, version,
+    method). Short strings are length-prefixed with 0x00-0x1f."""
+    out = []
+    off = 0
+    while off < len(body) and len(out) < limit:
+        ln = body[off]
+        if 0x30 <= ln <= 0x33 and off + 1 < len(body):  # medium string
+            ln = ((ln - 0x30) << 8) + body[off + 1]
+            off += 2
+        elif ln < 0x20:
+            off += 1
+        else:
+            break
+        if off + ln > len(body):
+            break
+        out.append(body[off : off + ln].decode(errors="replace"))
+        off += ln
+    return out
+
+
+def parse_dubbo(payload: bytes) -> L7Message | None:
+    try:
+        if payload[:2] != _DUBBO_MAGIC or len(payload) < 16:
+            return None
+        flags = payload[2]
+        status = payload[3]
+        req_id = int.from_bytes(payload[4:12], "big")
+        body = payload[16:]
+        if flags & _FLAG_REQUEST:
+            if flags & _FLAG_EVENT:
+                return L7Message(
+                    protocol=L7Protocol.DUBBO,
+                    msg_type=MSG_REQUEST,
+                    request_type="heartbeat",
+                    request_id=req_id,
+                )
+            strs = _hessian_strings(body)
+            # [dubbo version, service, service version, method]
+            service = strs[1] if len(strs) > 1 else ""
+            method = strs[3] if len(strs) > 3 else ""
+            return L7Message(
+                protocol=L7Protocol.DUBBO,
+                msg_type=MSG_REQUEST,
+                version=strs[0] if strs else "",
+                request_type=method,
+                request_domain=service,
+                request_resource=f"{service}.{method}" if service else method,
+                endpoint=service,
+                request_id=req_id,
+            )
+        # Dubbo status registry: 20 OK; client-side faults: 30
+        # CLIENT_TIMEOUT, 40 BAD_REQUEST, 90 CLIENT_ERROR; server-side:
+        # 31 SERVER_TIMEOUT, 50 BAD_RESPONSE, 60 SERVICE_NOT_FOUND,
+        # 70 SERVICE_ERROR, 80 SERVER_ERROR
+        st = (
+            STATUS_OK
+            if status == 20
+            else STATUS_CLIENT_ERROR
+            if status in (30, 40, 90)
+            else STATUS_SERVER_ERROR
+        )
+        return L7Message(
+            protocol=L7Protocol.DUBBO,
+            msg_type=MSG_RESPONSE,
+            status=st,
+            status_code=status,
+            request_id=req_id,
+        )
+    except (IndexError, struct.error):
+        return None

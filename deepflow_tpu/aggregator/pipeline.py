@@ -134,6 +134,47 @@ class RollupPipeline:
         return DocumentFlag.NONE
 
 
+class DualGranularityPipeline:
+    """SECOND + MINUTE rollups from one flow stream — the reference runs
+    one SubQuadGen per granularity over the same TaggedFlow queue
+    (MetricsType::SECOND|MINUTE, quadruple_generator.rs:275-298) and the
+    1m docs land in the *.1m tables that feed the downsampler chain
+    (datasource/handle.go 1m→1h→1d).
+
+    ingest() returns (flags, DocBatch) pairs: PER_SECOND_METRICS for 1s
+    windows, NONE for 1m — exactly what encode_docbatch/table routing
+    (metrics_tables.route_table_ids) key off.
+    """
+
+    def __init__(
+        self,
+        config: PipelineConfig = PipelineConfig(),
+        *,
+        minute_delay: int = 10,
+        app: bool = False,
+    ):
+        cls = L7Pipeline if app else L4Pipeline
+        self.second = cls(config)
+        minute_window = dataclasses.replace(
+            config.window, interval=60, delay=minute_delay
+        )
+        self.minute = cls(dataclasses.replace(config, window=minute_window))
+
+    def ingest(self, batch) -> list[tuple[DocumentFlag, DocBatch]]:
+        out = [(self.second.flags, db) for db in self.second.ingest(batch)]
+        out += [(self.minute.flags, db) for db in self.minute.ingest(batch)]
+        return out
+
+    def drain(self) -> list[tuple[DocumentFlag, DocBatch]]:
+        out = [(self.second.flags, db) for db in self.second.drain()]
+        out += [(self.minute.flags, db) for db in self.minute.drain()]
+        return out
+
+    @property
+    def counters(self) -> dict:
+        return {"second": self.second.counters, "minute": self.minute.counters}
+
+
 class L4Pipeline(RollupPipeline):
     """network / network_map rollup (FlowMeter docs) — the RollupPipeline
     defaults, named for symmetry with L7Pipeline."""

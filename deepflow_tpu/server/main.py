@@ -25,6 +25,7 @@ from ..querier import QueryEngine
 from ..querier.translation import Translator
 from ..server.datasource import DataSource, Downsampler
 from ..server.debug import DebugServer
+from ..server.events import EventIngester
 from ..server.exporters import ExporterHub
 from ..server.flow_metrics import FlowMetricsIngester
 from ..server.integration import IntegrationIngester
@@ -101,6 +102,7 @@ class Server:
             self.receiver, self.store, writer_args=writer_args,
             trace_builder=self.trace_builder,
         )
+        self.events = EventIngester(self.receiver, self.store, writer_args=writer_args)
         self.downsampler = Downsampler(self.store)
         self.debug = DebugServer(
             context={
@@ -162,6 +164,7 @@ class Server:
         self.flow_metrics.stop()
         self.flow_log.stop()
         self.integration.stop()
+        self.events.stop()
         self.trace_builder.stop()
         self.mcp.stop()
         self.doc_writer.flush()

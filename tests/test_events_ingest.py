@@ -1,0 +1,150 @@
+"""event / app_log / pcap ingesters: frame-in → queryable-table tests
+(VERDICT r3 missing #3; reference: server/ingester/{event,app_log,pcap})."""
+
+from __future__ import annotations
+
+import json
+import struct
+import time
+
+import numpy as np
+
+from deepflow_tpu.ingest.framing import FlowHeader, MessageType, encode_frame
+from deepflow_tpu.ingest.receiver import Receiver
+from deepflow_tpu.ingest.sender import UniformSender
+from deepflow_tpu.server.events import EventIngester
+from deepflow_tpu.storage.store import ColumnarStore
+
+T0 = 1_700_000_000
+
+
+def _wait(cond, timeout=15.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def _stack():
+    recv = Receiver()
+    recv.start()
+    store = ColumnarStore()
+    ing = EventIngester(recv, store, writer_args={"flush_interval_s": 0.05})
+    return recv, store, ing
+
+
+def _send(recv, mt, msgs, agent_id=5, org=1):
+    snd = UniformSender(
+        [("127.0.0.1", recv.tcp_port)], mt,
+        agent_id=agent_id, organization_id=org,
+        prefer_native_queue=False, flush_interval=0.05,
+    )
+    snd.send(msgs)
+    snd.close()
+
+
+def test_proc_and_k8s_events_to_table():
+    recv, store, ing = _stack()
+    try:
+        proc = {
+            "time": T0, "start_time_us": T0 * 10**6, "end_time_us": T0 * 10**6 + 500,
+            "event_type": "io_write", "process_kname": "nginx",
+            "gprocess_id": 42, "description": "slow write",
+        }
+        k8s = {
+            "time": T0 + 1, "event_type": "create",
+            "resource_type": "pod", "resource_id": 9,
+            "resource_name": "web-0",
+        }
+        _send(recv, MessageType.PROC_EVENT, [json.dumps(proc).encode()])
+        _send(recv, MessageType.K8S_EVENT, [json.dumps(k8s).encode()])
+        assert _wait(lambda: ing.get_counters()["rows_written"] >= 2)
+        ing.flush()
+        rows = store.scan("event", "event")
+        assert len(rows["time"]) == 2
+        by_type = {t: i for i, t in enumerate(rows["event_type"])}
+        assert rows["process_kname"][by_type["io_write"]] == "nginx"
+        assert rows["signal_source"][by_type["io_write"]] == 1
+        assert rows["resource_name"][by_type["create"]] == "web-0"
+        assert rows["agent_id"][0] == 5
+    finally:
+        ing.stop()
+        recv.stop()
+
+
+def test_alert_events_to_table():
+    recv, store, ing = _stack()
+    try:
+        alert = {
+            "time": T0, "policy_id": 3, "policy_name": "high-rtt",
+            "level": 3, "target_tags": {"pod": "web-0"},
+            "metric_value": 812.5, "description": "rtt over threshold",
+        }
+        _send(recv, MessageType.ALERT_EVENT, [json.dumps(alert).encode()])
+        assert _wait(lambda: ing.get_counters()["rows_written"] >= 1)
+        ing.flush()
+        rows = store.scan("event", "alert_event")
+        assert rows["policy_name"][0] == "high-rtt"
+        assert rows["metric_value"][0] == 812.5
+        assert json.loads(rows["target_tags"][0]) == {"pod": "web-0"}
+    finally:
+        ing.stop()
+        recv.stop()
+
+
+def test_app_log_to_table_and_severity_mapping():
+    recv, store, ing = _stack()
+    try:
+        logs = [
+            {"timestamp_us": T0 * 10**6, "app_service": "checkout",
+             "severity_text": "ERROR", "body": "payment failed",
+             "trace_id": "t1", "span_id": "s1", "attributes": {"k": "v"}},
+            {"timestamp_us": T0 * 10**6 + 1, "app_service": "checkout",
+             "severity_number": 9, "body": "ok"},
+        ]
+        _send(recv, MessageType.APPLICATION_LOG,
+              [json.dumps(l).encode() for l in logs])
+        assert _wait(lambda: ing.get_counters()["rows_written"] >= 2)
+        ing.flush()
+        rows = store.scan("application_log", "log")
+        assert len(rows["time"]) == 2
+        i = int(np.nonzero(rows["body"] == "payment failed")[0][0])
+        assert rows["severity_number"][i] == 17  # "error" mapped
+        assert rows["trace_id"][i] == "t1"
+        assert rows["app_service"][i] == "checkout"
+    finally:
+        ing.stop()
+        recv.stop()
+
+
+def test_raw_pcap_to_table():
+    recv, store, ing = _stack()
+    try:
+        pkt = bytes(range(64))
+        msg = struct.pack(">QQI", 0xAABBCCDD00112233, T0 * 10**6 + 7, len(pkt)) + pkt
+        _send(recv, MessageType.RAW_PCAP, [msg])
+        assert _wait(lambda: ing.get_counters()["rows_written"] >= 1)
+        ing.flush()
+        rows = store.scan("pcap", "pcap")
+        assert rows["flow_id_hi"][0] == 0xAABBCCDD
+        assert rows["flow_id_lo"][0] == 0x00112233
+        assert rows["ts_us"][0] == T0 * 10**6 + 7
+        assert bytes.fromhex(rows["packet"][0]) == pkt
+    finally:
+        ing.stop()
+        recv.stop()
+
+
+def test_malformed_event_counted_not_fatal():
+    recv, store, ing = _stack()
+    try:
+        _send(recv, MessageType.PROC_EVENT, [b"not json"])
+        good = {"time": T0, "event_type": "x"}
+        _send(recv, MessageType.PROC_EVENT, [json.dumps(good).encode()])
+        assert _wait(lambda: ing.get_counters()["rows_written"] >= 1)
+        assert ing.get_counters()["decode_errors"] >= 1
+    finally:
+        ing.stop()
+        recv.stop()
