@@ -1,0 +1,304 @@
+#!/usr/bin/env python
+"""All five BASELINE.json configs + a measured CPU baseline, one JSON
+line each (BASELINE.md:22-39; r3 verdict item #4). Run from repo root:
+
+    python bench_all.py [--cpu] [--quick]
+
+Configs:
+  1 flow_metrics 1s rollup   — synthetic accumulated-flow replay, 10k
+    5-tuples, amortized append/fold cadence (the bench.py number), plus
+    the MEASURED CPU-oracle baseline on the identical stream; this
+    config's vs line is device_rate / cpu_oracle_rate.
+  2 L7 RED + t-digest        — request replay through the L7 path, RED
+    meters + p50/p99 from the latency log-histogram t-digest.
+  3 HLL cardinality          — 1M true client cardinality through the
+    HLL plane; reports measured relative error (<1% required).
+  4 CMS heavy hitters        — top-K endpoints by bytes via count-min,
+    reports top-10 recall vs exact.
+  5 pod-wide 1m rollup       — 64-agent firehose over the mesh pipeline
+    with collective sketch merges (8-device CPU mesh when multichip
+    hardware is absent; on the single TPU it degrades to a 1-device
+    mesh, still through shard_map).
+
+Output: one {"metric", "value", "unit", "vs_baseline"} JSON line per
+config; also writes PERF_ALL.json with the full detail.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+if "--cpu" in sys.argv:
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+import numpy as np
+
+NORTH_STAR = 50e6
+
+results = []
+
+
+def emit(metric, value, unit, vs_baseline, **detail):
+    line = {"metric": metric, "value": round(float(value), 4), "unit": unit,
+            "vs_baseline": round(float(vs_baseline), 4)}
+    print(json.dumps(line), flush=True)
+    results.append({**line, **detail})
+
+
+def config1(quick: bool):
+    import jax
+    import jax.numpy as jnp
+
+    from deepflow_tpu.aggregator.fanout import FANOUT_LANES, FanoutConfig
+    from deepflow_tpu.aggregator.pipeline import make_ingest_step
+    from deepflow_tpu.aggregator.stash import accum_init, stash_init
+    from deepflow_tpu.datamodel.schema import FLOW_METER, TAG_SCHEMA
+    from deepflow_tpu.ingest.replay import SyntheticFlowGen
+
+    BATCH = 1 << 12 if quick else 1 << 14
+    CAP = 1 << 16
+    K = 8
+    CYCLES = 2 if quick else 8
+
+    gen = SyntheticFlowGen(num_tuples=10_000, seed=0)
+    fb = gen.flow_batch(BATCH, 1_700_000_000)
+    tags = {k: jnp.asarray(v) for k, v in fb.tags.items()}
+    meters = jnp.asarray(fb.meters)
+    valid = jnp.asarray(fb.valid)
+
+    append_fn, fold_fn = make_ingest_step(FanoutConfig(), interval=1)
+    append = jax.jit(append_fn, donate_argnums=(0, 1))
+    fold = jax.jit(fold_fn, donate_argnums=(0, 1))
+    doc_rows = FANOUT_LANES * BATCH
+    state = stash_init(CAP, TAG_SCHEMA, FLOW_METER)
+    acc = accum_init(K * doc_rows, TAG_SCHEMA, FLOW_METER)
+
+    def cycle(state, acc):
+        for k in range(K):
+            state, acc = append(state, acc, jnp.int32(k * doc_rows), tags, meters, valid)
+        return fold(state, acc)
+
+    state, acc = cycle(state, acc)
+    jax.block_until_ready(acc.slot)
+    t0 = time.perf_counter()
+    for _ in range(CYCLES):
+        state, acc = cycle(state, acc)
+    jax.block_until_ready(acc.slot)
+    dev_rate = BATCH * K * CYCLES / (time.perf_counter() - t0)
+
+    # CPU oracle baseline on the identical stream shape (the reference
+    # publishes no numbers — BASELINE.md mandates measuring our own)
+    from deepflow_tpu.oracle.numpy_oracle import oracle_l4_rollup
+
+    n_oracle = min(BATCH, 4096)
+    records = gen.records(n_oracle, 1_700_000_000)
+    t0 = time.perf_counter()
+    oracle_l4_rollup(records, config=FanoutConfig())
+    cpu_rate = n_oracle / (time.perf_counter() - t0)
+
+    emit("c1_flow_metrics_1s_rollup", dev_rate, "records/s", dev_rate / cpu_rate,
+         cpu_oracle_rate=cpu_rate, north_star_frac=dev_rate / NORTH_STAR)
+
+
+def config2(quick: bool):
+    import jax
+    import jax.numpy as jnp
+
+    from deepflow_tpu.aggregator.fanout import FANOUT_LANES, FanoutConfig
+    from deepflow_tpu.aggregator.pipeline import make_ingest_step
+    from deepflow_tpu.aggregator.stash import accum_init, stash_init
+    from deepflow_tpu.datamodel.schema import APP_METER, TAG_SCHEMA
+    from deepflow_tpu.ops.histogram import LogHistSpec, loghist_update
+    from deepflow_tpu.ops.tdigest import tdigest_from_loghist, tdigest_quantile
+
+    BATCH = 1 << 12 if quick else 1 << 14
+    total = 1 << 17 if quick else 1 << 20  # ~1M requests
+    spec = LogHistSpec(bins=512, vmin=1.0, gamma=1.04)
+
+    from deepflow_tpu.ingest.replay import SyntheticAppGen
+
+    gen = SyntheticAppGen(num_services=64, endpoints_per_service=16, seed=1)
+    fb = gen.app_batch(BATCH, 1_700_000_000)
+    tags = {k: jnp.asarray(v) for k, v in fb.tags.items()}
+    meters = jnp.asarray(fb.meters)
+    valid = jnp.asarray(fb.valid)
+
+    append_fn, fold_fn = make_ingest_step(FanoutConfig(), interval=1, app=True)
+    append = jax.jit(append_fn, donate_argnums=(0, 1))
+    fold = jax.jit(fold_fn, donate_argnums=(0, 1))
+    doc_rows = FANOUT_LANES * BATCH
+    K = 8
+    state = stash_init(1 << 16, TAG_SCHEMA, APP_METER)
+    acc = accum_init(K * doc_rows, TAG_SCHEMA, APP_METER)
+
+    m_idx = APP_METER.index
+    hist = jnp.zeros((64, spec.bins), jnp.int32)
+
+    @jax.jit
+    def upd_hist(hist, tags, meters, valid):
+        svc = (tags["server_port"] % jnp.uint32(64)).astype(jnp.int32)
+        rrt = meters[:, m_idx("rrt_sum")] / jnp.maximum(meters[:, m_idx("rrt_count")], 1.0)
+        return loghist_update(hist, svc, rrt, valid & (meters[:, m_idx("rrt_count")] > 0), spec)
+
+    # warm
+    state, acc = append(state, acc, jnp.int32(0), tags, meters, valid)
+    state, acc = fold(state, acc)
+    hist = upd_hist(hist, tags, meters, valid)
+    jax.block_until_ready(hist)
+
+    iters = max(1, total // BATCH)
+    t0 = time.perf_counter()
+    k = 0
+    for i in range(iters):
+        state, acc = append(state, acc, jnp.int32(k * doc_rows), tags, meters, valid)
+        hist = upd_hist(hist, tags, meters, valid)
+        k += 1
+        if k == K:
+            state, acc = fold(state, acc)
+            k = 0
+    jax.block_until_ready(acc.slot)
+    rate = BATCH * iters / (time.perf_counter() - t0)
+
+    means, weights = tdigest_from_loghist(hist[:1], spec)
+    p50, p99 = np.asarray(
+        tdigest_quantile(means[0], weights[0], jnp.asarray([0.5, 0.99]))
+    )
+    emit("c2_l7_red_tdigest", rate, "requests/s", rate / NORTH_STAR,
+         p50_us=float(p50), p99_us=float(p99))
+
+
+def config3(quick: bool):
+    import jax
+    import jax.numpy as jnp
+
+    from deepflow_tpu.ops.hashing import fingerprint64
+    from deepflow_tpu.ops.hll import hll_estimate, hll_init, hll_update
+
+    true_card = 1 << 17 if quick else 1_000_000
+    BATCH = 1 << 16
+    precision = 14
+    rng = np.random.default_rng(2)
+    state = hll_init(1, precision)
+    upd = jax.jit(hll_update, donate_argnums=(0,))
+    gid = jnp.zeros(BATCH, jnp.int32)
+    v = jnp.ones(BATCH, bool)
+
+    # stream 4x the cardinality in repeats (clients recur across windows)
+    total = true_card * 4
+    ids = rng.integers(0, true_card, total).astype(np.uint32)
+    ids[:true_card] = np.arange(true_card, dtype=np.uint32)  # all present
+    t0 = time.perf_counter()
+    seen = 0
+    for off in range(0, total, BATCH):
+        chunk = ids[off : off + BATCH]
+        if len(chunk) < BATCH:
+            chunk = np.pad(chunk, (0, BATCH - len(chunk)))
+        hi, lo = fingerprint64(jnp.asarray(chunk[:, None]))
+        state = upd(state, gid, hi, lo, v)
+        seen += len(chunk)
+    est = float(np.asarray(hll_estimate(state))[0])
+    dt = time.perf_counter() - t0
+    rel_err = abs(est - true_card) / true_card
+    emit("c3_hll_rel_err_at_1M", rel_err, "fraction", 1.0 if rel_err < 0.01 else 0.0,
+         estimate=est, true_cardinality=true_card, update_rate=seen / dt)
+
+
+def config4(quick: bool):
+    import jax
+    import jax.numpy as jnp
+
+    from deepflow_tpu.ops.cms import cms_init, cms_query, cms_update
+    from deepflow_tpu.ops.hashing import fingerprint64
+
+    n_endpoints = 1 << 14  # 16-way tag group-by space
+    BATCH = 1 << 16
+    iters = 4 if quick else 16
+    rng = np.random.default_rng(3)
+    # zipf-ish endpoint popularity
+    weights = 1.0 / np.arange(1, n_endpoints + 1) ** 1.2
+    weights /= weights.sum()
+    state = cms_init(depth=4, width=1 << 14)
+    upd = jax.jit(cms_update, donate_argnums=(0,))
+    truth = np.zeros(n_endpoints, np.int64)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        eps = rng.choice(n_endpoints, BATCH, p=weights).astype(np.uint32)
+        byte_w = rng.integers(100, 1500, BATCH).astype(np.int32)
+        np.add.at(truth, eps, byte_w)
+        hi, lo = fingerprint64(jnp.asarray(eps[:, None]))
+        state = upd(state, hi, lo, jnp.asarray(byte_w), jnp.ones(BATCH, bool))
+    jax.block_until_ready(state)
+    rate = BATCH * iters / (time.perf_counter() - t0)
+
+    all_ids = np.arange(n_endpoints, dtype=np.uint32)
+    hi, lo = fingerprint64(jnp.asarray(all_ids[:, None]))
+    est = np.asarray(cms_query(state, hi, lo))
+    top_true = set(np.argsort(truth)[-10:].tolist())
+    top_est = set(np.argsort(est)[-10:].tolist())
+    recall = len(top_true & top_est) / 10.0
+    emit("c4_cms_topk_endpoints", rate, "spans/s", recall, top10_recall=recall)
+
+
+def config5(quick: bool):
+    import jax
+
+    from deepflow_tpu.ingest.replay import SyntheticFlowGen
+    from deepflow_tpu.ops.histogram import LogHistSpec
+    from deepflow_tpu.parallel.mesh import make_mesh
+    from deepflow_tpu.parallel.sharded import (
+        ShardedConfig,
+        ShardedPipeline,
+        ShardedWindowManager,
+    )
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh(n_dev, n_hosts=2 if n_dev % 2 == 0 and n_dev > 1 else 1)
+    cfg = ShardedConfig(
+        capacity_per_device=1 << 12,
+        num_services=256,
+        hll_precision=10,
+        hist=LogHistSpec(bins=256, vmin=1.0, gamma=1.08),
+    )
+    pipe = ShardedPipeline(mesh, cfg)
+    wm = ShardedWindowManager(pipe)
+
+    per_dev = 1 << 10 if quick else 1 << 12
+    batch = per_dev * n_dev  # "64-agent firehose" sharded over the mesh
+    gen = SyntheticFlowGen(num_tuples=10_000, seed=4)
+    t0s = 1_700_000_000
+    fb = gen.flow_batch(batch, t0s)
+    wm.ingest(fb.tags, fb.meters, fb.valid)  # warm compiles
+    iters = 4 if quick else 12
+    t0 = time.perf_counter()
+    docs = 0
+    for i in range(iters):
+        fb = gen.flow_batch(batch, t0s + 60 + i)
+        docs += sum(d.size for d in wm.ingest(fb.tags, fb.meters, fb.valid))
+    jax.block_until_ready(wm.sketches.hll)
+    rate = batch * iters / (time.perf_counter() - t0)
+    emit("c5_pod_1m_rollup_mesh", rate, "records/s", rate / NORTH_STAR,
+         n_devices=n_dev, flushed_docs=docs)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--cpu", action="store_true")
+    p.add_argument("--quick", action="store_true")
+    args = p.parse_args()
+    for fn in (config1, config2, config3, config4, config5):
+        try:
+            fn(args.quick)
+        except Exception as e:  # one config must not kill the others
+            emit(fn.__name__ + "_error", 0, "error", 0, error=repr(e))
+    with open("PERF_ALL.json", "w") as f:
+        json.dump(results, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

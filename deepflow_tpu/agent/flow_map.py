@@ -312,9 +312,12 @@ def _flow_tick_impl(state: LogStashState, now, cfg: _TickCfg):
     # wrap between SYN and SYN-ACK still measures correctly
     d_cli = synack_t - syn_t
     d_srv = ack_t - synack_t
-    half = jnp.uint32(0x80000000)
-    have_cli = (syn_t != absent) & (synack_t != absent) & (d_cli < half)
-    have_srv = (synack_t != absent) & (ack_t != absent) & (d_srv < half)
+    # handshake legs are bounded (5 min in µs): rejects both nonsense
+    # orderings and the post-wrap pure-ACK displacing the handshake ACK
+    # in the MIN lane on flows that live across a 71-min clock wrap
+    bound = jnp.uint32(300_000_000)
+    have_cli = (syn_t != absent) & (synack_t != absent) & (d_cli < bound)
+    have_srv = (synack_t != absent) & (ack_t != absent) & (d_srv < bound)
     rtt_client = jnp.where(have_cli, d_cli, 0)
     rtt_server = jnp.where(have_srv, d_srv, 0)
 

@@ -68,6 +68,9 @@ class _FlowL7:
     pending: deque = dataclasses.field(default_factory=deque)
     by_id: dict = dataclasses.field(default_factory=dict)
     last_seen_us: int = 0
+    # per-direction parser state (HTTP/2 HPACK dynamic tables — each
+    # side of the connection keeps its own, RFC 7541 §2.2)
+    parser_ctx: dict = dataclasses.field(default_factory=dict)
 
 
 _MAX_INFER_TRIES = 8  # reference: bounded per-flow inference attempts
@@ -131,10 +134,20 @@ class L7Engine:
             fl.protocol = proto
             self.counters["inferred"] += 1
 
-        msg = parse_payload(fl.protocol, payload)
+        ctx = None
+        if fl.protocol in (L7Protocol.HTTP2, L7Protocol.GRPC):
+            from .http2 import Hpack
+
+            d = 0 if (key[0] == ((tuple(int(w) for w in p.ip_src[i]), sport))) else 1
+            ctx = fl.parser_ctx.setdefault(d, Hpack())
+        msg = parse_payload(fl.protocol, payload, ctx)
         if msg is None:
             self.counters["parse_miss"] += 1
             return
+        # parser-level refinement: HTTP/2 flows carrying
+        # content-type application/grpc become GRPC for the whole flow
+        if msg.protocol not in (fl.protocol, L7Protocol.UNKNOWN):
+            fl.protocol = msg.protocol
         ts_us = int(p.timestamp_s[i]) * 1_000_000 + int(p.timestamp_us[i])
         ident = {
             "is_ipv6": int(p.is_ipv6[i]),

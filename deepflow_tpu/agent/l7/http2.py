@@ -236,10 +236,13 @@ def _parse_http2_inner(payload: bytes, hp: Hpack) -> L7Message | None:
         is_grpc = headers.get("content-type", "").startswith("application/grpc")
         proto = L7Protocol.GRPC if is_grpc else L7Protocol.HTTP2
         if ":method" in headers:  # request
+            from .parsers import endpoint_from_path
+
             path = headers.get(":path", "")
             bare = path.split("?", 1)[0]
-            segs = [s for s in bare.split("/") if s]
-            endpoint = "/" + "/".join(segs[: 2 if is_grpc else _N_PATH_SEGMENTS])
+            # gRPC paths are exactly /package.Service/Method — the
+            # 2-segment trim keeps them whole
+            endpoint = endpoint_from_path(bare, _N_PATH_SEGMENTS)
             return L7Message(
                 protocol=proto,
                 msg_type=MSG_REQUEST,

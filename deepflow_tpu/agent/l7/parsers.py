@@ -93,8 +93,7 @@ def parse_http(payload: bytes) -> L7Message | None:
                         host = ln[5:].strip().decode(errors="replace")
                         break
                 path = uri.split("?", 1)[0]
-                segs = [s for s in path.split("/") if s]
-                endpoint = "/" + "/".join(segs[:_N_PATH_SEGMENTS])
+                endpoint = endpoint_from_path(path, _N_PATH_SEGMENTS)
                 return L7Message(
                     protocol=L7Protocol.HTTP1,
                     msg_type=MSG_REQUEST,
@@ -329,9 +328,22 @@ def infer_protocol(payload: bytes, server_port: int = 0) -> int:
     return L7Protocol.UNKNOWN
 
 
-def parse_payload(protocol: int, payload: bytes) -> L7Message | None:
+def endpoint_from_path(path: str, n_segments: int = 2) -> str:
+    """Endpoint = first n path segments, query stripped (the http.rs
+    endpoint trim; shared by HTTP/1 and HTTP/2)."""
+    bare = path.split("?", 1)[0]
+    segs = [s for s in bare.split("/") if s]
+    return "/" + "/".join(segs[:n_segments])
+
+
+def parse_payload(protocol: int, payload: bytes, ctx=None) -> L7Message | None:
+    """Dispatch to the protocol's parser. `ctx` is per-flow parser state
+    (today: the HTTP/2 connection's Hpack dynamic table) handed to
+    parsers that declare a second positional argument."""
     for proto, _, parse in _PARSERS:
         if proto == protocol:
+            if ctx is not None and parse.__code__.co_argcount > 1:
+                return parse(payload, ctx)
             return parse(payload)
     return None
 

@@ -185,6 +185,28 @@ def _pg_is_error_response(payload: bytes) -> bool:
     return bool(body) and body[0:1] in (b"S", b"V") and b"\x00" in body
 
 
+def _pg_wellformed(payload: bytes) -> bool:
+    """Byte stream starts with a plausible [type][len u32 BE] message
+    chain. Continuation segments of a large result set are raw row bytes
+    whose accidental first byte may alias a type code, but their "length"
+    is random — requiring the chain to land exactly on a boundary (or
+    run past the segment only on its FINAL message) rejects them."""
+    off = 0
+    n = len(payload)
+    msgs = 0
+    while off < n:
+        if off + 5 > n:
+            return msgs > 0  # trailing partial header after valid msgs
+        ln = int.from_bytes(payload[off + 1 : off + 5], "big")
+        if ln < 4 or ln > 1 << 24:
+            return False
+        off += 1 + ln
+        msgs += 1
+        if msgs >= 4:  # enough evidence
+            return True
+    return off == n
+
+
 def check_postgresql(payload: bytes, port: int = 0) -> bool:
     if len(payload) < 5:
         return False
@@ -261,7 +283,7 @@ def parse_postgresql(payload: bytes) -> L7Message | None:
                 status=status,
                 request_resource=f"{severity} {code}".strip(),
             )
-        if t in _PG_RESP_OK:
+        if t in _PG_RESP_OK and _pg_wellformed(payload):
             return L7Message(protocol=L7Protocol.POSTGRESQL, msg_type=MSG_RESPONSE)
         return None
     except (IndexError, ValueError):

@@ -293,6 +293,65 @@ def test_infer_tls_by_content():
     assert infer_protocol(_client_hello(), server_port=443) == L7Protocol.TLS
 
 
+# -- engine integration: HPACK continuity + gRPC refinement -------------
+
+
+def _h2_frame(block, stream=1):
+    return (
+        len(block).to_bytes(3, "big") + b"\x01\x04"
+        + stream.to_bytes(4, "big") + block
+    )
+
+
+def test_engine_threads_hpack_across_packets_and_refines_grpc():
+    """Request 2 references request 1's dynamic-table entries; without
+    per-flow HPACK state its :path/content-type are lost and the flow
+    stays HTTP2 (r4 review finding). The engine must keep one Hpack per
+    direction and adopt the parser's GRPC refinement."""
+    from deepflow_tpu.agent.l7.engine import L7Engine
+    from deepflow_tpu.agent.packet import craft_tcp, parse_packets, to_batch
+
+    def lit(name_idx, value):
+        return bytes([0x40 | name_idx]) + bytes([len(value)]) + value
+
+    # req1: :method POST (0x83), :path literal idx 4, content-type literal idx 31
+    req1 = b"\x83" + lit(4, b"/pkg.Svc/M") + lit(31, b"application/grpc")
+    # req2: :method POST + dynamic refs (62 = newest = content-type, 63 = :path)
+    req2 = b"\x83\xbe\xbf"
+
+    CLI, SRV = 0x0A000001, 0x0A000002
+    pkts = [
+        craft_tcp(CLI, SRV, 40000, 50051, flags=0x18, seq=1,
+                  payload=_h2_frame(req1, 1)),
+        craft_tcp(CLI, SRV, 40000, 50051, flags=0x18, seq=100,
+                  payload=_h2_frame(req2, 3)),
+    ]
+    eng = L7Engine()
+    eng.process(*_pb(pkts))
+    fl = next(iter(eng._flows.values()))
+    assert fl.protocol == L7Protocol.GRPC  # refined from HTTP2
+    msgs = [e.msg for e in fl.pending]
+    assert [m.endpoint for m in msgs] == ["/pkg.Svc/M", "/pkg.Svc/M"]
+    assert all(m.protocol == L7Protocol.GRPC for m in msgs)
+
+
+def _pb(pkts):
+    from deepflow_tpu.agent.packet import parse_packets, to_batch
+
+    buf, lengths, ts_s, ts_us = to_batch(pkts, [1_700_000_000] * len(pkts))
+    return buf, parse_packets(buf, lengths, ts_s, ts_us)
+
+
+def test_pg_continuation_segment_not_a_response():
+    # raw DataRow continuation bytes whose first byte aliases 'D' but
+    # whose "length" is implausible
+    cont = b"D" + b"\xf0\xff\xff\xff" + b"rowdata" * 10
+    assert parse_postgresql(cont) is None
+    # a real CommandComplete still parses
+    real = b"C" + (4 + 9).to_bytes(4, "big") + b"SELECT 1\x00"
+    assert parse_postgresql(real).msg_type == MSG_RESPONSE
+
+
 # -- registry sanity ----------------------------------------------------
 
 
