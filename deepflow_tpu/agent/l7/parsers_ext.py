@@ -200,6 +200,12 @@ def _pg_wellformed(payload: bytes) -> bool:
         ln = int.from_bytes(payload[off + 1 : off + 5], "big")
         if ln < 4 or ln > 1 << 24:
             return False
+        if off + 1 + ln > n:
+            # a message larger than the captured segment is legitimate
+            # (big DataRow spanning TCP segments) — but only as the
+            # stream's FINAL message; random continuation "lengths"
+            # rarely land in [4, 16M]
+            return True
         off += 1 + ln
         msgs += 1
         if msgs >= 4:  # enough evidence

@@ -68,6 +68,12 @@ class Receiver:
             "tcp_conns": 0,
         }
 
+    def agent_list(self) -> list[AgentStatus]:
+        """Snapshot for observers (REST/debug) — .agents mutates under
+        _stats_lock on every dispatched frame."""
+        with self._stats_lock:
+            return list(self.agents.values())
+
     # -- registry (receiver.go:444 RegistHandler) -----------------------
     def register_handler(self, msg_type: MessageType, queues: list) -> None:
         if not queues:

@@ -6,6 +6,9 @@ Supported:  [agg by (l1, l2)] (metric{label="v", label!="v"})
             and rate(metric{...}[Ns])  inside the aggregation
 Instant queries: evaluate at time `t` with a lookback window (last
 sample per series wins, Prometheus staleness semantics simplified).
+Range queries: query_range evaluates the instant expression at each
+step over [start, end] and returns per-series value arrays — the
+/api/v1/query_range shape.
 """
 
 from __future__ import annotations
@@ -137,3 +140,31 @@ def query_instant(
             v = float(len(vals))
         out.append({"labels": dict(key), "value": v})
     return out
+
+
+def query_range(
+    store: ColumnarStore,
+    query: str,
+    start: int,
+    end: int,
+    step: int,
+    *,
+    lookback_s: int = 300,
+    db: str = "prometheus",
+) -> list[dict]:
+    """Matrix result: [{"labels": {...}, "values": [[t, v], ...]}] — the
+    /api/v1/query_range evaluation (each step is an instant evaluation,
+    which is exactly Prometheus's range-query semantics)."""
+    if step <= 0:
+        raise PromQLError("step must be positive")
+    if end < start:
+        raise PromQLError("end < start")
+    series: dict[tuple, dict] = {}
+    for t in range(start, end + 1, step):
+        for row in query_instant(store, query, t, lookback_s=lookback_s, db=db):
+            key = tuple(sorted(row["labels"].items()))
+            s = series.get(key)
+            if s is None:
+                s = series[key] = {"labels": row["labels"], "values": []}
+            s["values"].append([t, row["value"]])
+    return [series[k] for k in sorted(series)]

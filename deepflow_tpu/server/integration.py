@@ -98,9 +98,15 @@ class IntegrationIngester:
         queue_capacity: int = 1 << 13,
         writer_args: dict | None = None,
         trace_builder=None,  # tracing.TraceTreeBuilder | None
+        prom_labels=None,  # default-org PrometheusLabelRegistry | None (enables SmartEncoding)
     ):
         self.store = store
         self.trace_builder = trace_builder
+        self.prom_labels = prom_labels
+        # id spaces are per-tenant: each org gets its own registry, loaded
+        # from (and flushed into) its own prometheus db — sharing one
+        # would leak label values across orgs and desync dictionaries
+        self._prom_regs: dict[int, object] = {}
         self.writer_args = writer_args or {"flush_interval_s": 0.5}
         self._writers: dict[tuple[str, str], TableWriter] = {}
         self._flow_tags: dict[str, FlowTagWriter] = {}
@@ -226,6 +232,20 @@ class IntegrationIngester:
         with self._lock:
             self.counters["rows_written"] += n
 
+    def _prom_reg(self, org: int):
+        from ..storage.store import DEFAULT_ORG_ID
+
+        if org in (0, DEFAULT_ORG_ID):
+            return self.prom_labels
+        reg = self._prom_regs.get(org)
+        if reg is None:
+            from ..controller.prom_labels import PrometheusLabelRegistry
+
+            reg = self._prom_regs[org] = PrometheusLabelRegistry.load(
+                self.store, db=org_db("prometheus", org)
+            )
+        return reg
+
     def _prometheus(self, org: int, msg: bytes) -> None:
         series = parse_remote_write(msg)
         if not series:
@@ -247,6 +267,32 @@ class IntegrationIngester:
                 "value": np.asarray(rows["value"], np.float64),
             }
         )
+        if self.prom_labels is not None:
+            # SmartEncoding lane (grpc_label_ids.go seat): id-encoded
+            # samples + dictionary sidecars, alongside the string table
+            from ..controller.prom_labels import SAMPLES_ENC
+
+            reg = self._prom_reg(org)
+            enc_rows = {"time": [], "metric_id": [], "label_ids": [], "value": []}
+            for s in series:
+                mid, packed_ids = reg.encode(s.labels)
+                for ts_ms, val in s.samples:
+                    enc_rows["time"].append(ts_ms // 1000)
+                    enc_rows["metric_id"].append(mid)
+                    enc_rows["label_ids"].append(packed_ids)
+                    enc_rows["value"].append(val)
+            self._writer(org_db("prometheus", org), SAMPLES_ENC).put(
+                {
+                    "time": np.asarray(enc_rows["time"], np.uint32),
+                    "metric_id": np.asarray(enc_rows["metric_id"], np.uint32),
+                    "label_ids": np.asarray(enc_rows["label_ids"]),
+                    "value": np.asarray(enc_rows["value"], np.float64),
+                }
+            )
+            reg.flush_dicts(
+                self.store, db=org_db("prometheus", org),
+                now=int(rows["time"][0]) if rows["time"] else 0,
+            )
         with self._lock:
             self.counters["rows_written"] += len(rows["time"])
 

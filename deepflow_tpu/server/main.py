@@ -17,6 +17,8 @@ import time
 
 from ..controller.election import LeaderElection
 from ..controller.resources import ResourceDB
+from ..controller.prom_labels import PrometheusLabelRegistry
+from ..controller.rest import RestServer
 from ..controller.tagrecorder import TagRecorder
 from ..controller.trisolaris import TrisolarisService
 from ..flowlog.server import FlowLogIngester
@@ -31,6 +33,8 @@ from ..server.flow_metrics import FlowMetricsIngester
 from ..server.integration import IntegrationIngester
 from ..server.mcp import MCPServer
 from ..server.metrics_tables import DocStoreWriter
+from ..storage.issu import upgrade as issu_upgrade
+from ..storage.monitor import StoreMonitor
 from ..storage.store import ColumnarStore
 from ..tracing.builder import TraceTreeBuilder
 from ..utils.config import ServerConfig, load_config
@@ -47,6 +51,9 @@ class Server:
     def start(self) -> "Server":
         cfg = self.config
         self.store = ColumnarStore(cfg.storage.root)
+        # in-service schema upgrade before anything touches tables
+        # (ckissu.go:51 boot ordering)
+        self.issu_report = issu_upgrade(self.store)
         self.resources = ResourceDB()
         self.translator = Translator(self.store)
         self.tagrecorder = TagRecorder(self.resources, self.store, translator=self.translator)
@@ -98,9 +105,13 @@ class Server:
             writer_args=writer_args,
         )
         self.trace_builder = TraceTreeBuilder(self.store, writer_args=writer_args)
+        # restart-safe: ids re-load from the persisted dictionaries so
+        # encoded rows never alias onto re-allocated ids
+        self.prom_labels = PrometheusLabelRegistry.load(self.store)
         self.integration = IntegrationIngester(
             self.receiver, self.store, writer_args=writer_args,
             trace_builder=self.trace_builder,
+            prom_labels=self.prom_labels,
         )
         self.events = EventIngester(self.receiver, self.store, writer_args=writer_args)
         self.downsampler = Downsampler(self.store)
@@ -111,8 +122,12 @@ class Server:
                 "downsampler": self.downsampler,
             }
         )
+        self.monitor = StoreMonitor(
+            self.store, max_bytes=cfg.storage.max_disk_bytes or None
+        )
         self.query = QueryEngine(self.store, translator=self.translator)
         self.mcp = MCPServer(self)  # LLM tool surface (mcp.go seat)
+        self.rest = RestServer(self)  # controller/querier REST + pprof seat
         if self.election:
             self.election.start()
         self.started = True
@@ -129,6 +144,7 @@ class Server:
             self.refresh_platform()
             did["platform"] = True
         did["traces_closed"] = self.trace_builder.tick()
+        did["monitor"] = self.monitor.check(now)
         if leader:
             did["tagrecorder"] = self.tagrecorder.sync()
             did["downsampled"] = self.downsampler.process(now)
@@ -167,6 +183,7 @@ class Server:
         self.events.stop()
         self.trace_builder.stop()
         self.mcp.stop()
+        self.rest.stop()
         self.doc_writer.flush()
         self.doc_writer.stop()
         if self.exporter_hub is not None:

@@ -47,6 +47,8 @@ class StorageConfig:
     ttl_hours: int = 168
     writer_batch_size: int = 1 << 15
     writer_flush_s: float = 1.0
+    # disk watermark for ckmonitor-style priority drops (0 = unlimited)
+    max_disk_bytes: int = 0
 
 
 @dataclasses.dataclass(frozen=True)

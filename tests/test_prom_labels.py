@@ -1,0 +1,96 @@
+"""Prometheus label→ID SmartEncoding (grpc_label_ids.go seat)."""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from deepflow_tpu.controller.prom_labels import (
+    LABEL_VALUE_DICT,
+    METRIC_DICT,
+    SAMPLES_ENC,
+    PrometheusLabelRegistry,
+)
+from deepflow_tpu.storage.store import ColumnarStore
+
+T0 = 1_700_000_000
+
+
+def test_ids_stable_and_versioned():
+    reg = PrometheusLabelRegistry()
+    m1 = reg.metric_id("http_requests_total")
+    m2 = reg.metric_id("up")
+    assert m1 != m2
+    assert reg.metric_id("http_requests_total") == m1  # stable
+    v0 = reg.version
+    reg.metric_id("up")  # no new allocation
+    assert reg.version == v0
+
+
+def test_encode_decode_roundtrip():
+    reg = PrometheusLabelRegistry()
+    labels = {"__name__": "up", "job": "api", "instance": "n1:9100"}
+    mid, packed = reg.encode(labels)
+    assert reg.decode(mid, packed) == labels
+    # same labels → identical encoding (dictionary reuse)
+    assert reg.encode(dict(labels)) == (mid, packed)
+    # value ids are per label-name: "api" under job vs under other
+    _, p2 = reg.encode({"__name__": "up", "zone": "api"})
+    assert p2 != packed.split(",")[0]
+
+
+def test_dict_flush_to_store():
+    reg = PrometheusLabelRegistry()
+    store = ColumnarStore()
+    reg.encode({"__name__": "up", "job": "api"})
+    n = reg.flush_dicts(store, now=T0)
+    assert n == 3  # metric + label name + label value
+    md = store.scan("prometheus", METRIC_DICT.name)
+    assert list(md["name"]) == ["up"]
+    lv = store.scan("prometheus", LABEL_VALUE_DICT.name)
+    assert list(lv["value"]) == ["api"]
+    # idempotent: nothing dirty remains
+    assert reg.flush_dicts(store, now=T0) == 0
+
+
+def test_ingester_writes_encoded_samples(tmp_path):
+    """remote-write → both samples (strings) and samples_enc (ids) +
+    dictionaries; ids decode back to the original labels."""
+    from deepflow_tpu.ingest.receiver import Receiver
+    from deepflow_tpu.ingest.sender import UniformSender
+    from deepflow_tpu.ingest.framing import MessageType
+    from deepflow_tpu.integration.formats import PromSeries, encode_remote_write
+    from deepflow_tpu.server.integration import IntegrationIngester
+
+    recv = Receiver()
+    recv.start()
+    store = ColumnarStore()
+    reg = PrometheusLabelRegistry()
+    ing = IntegrationIngester(
+        recv, store, writer_args={"flush_interval_s": 0.05}, prom_labels=reg
+    )
+    snd = UniformSender(
+        [("127.0.0.1", recv.tcp_port)], MessageType.PROMETHEUS,
+        organization_id=1, prefer_native_queue=False, flush_interval=0.05,
+    )
+    try:
+        rw = encode_remote_write(
+            [PromSeries({"__name__": "up", "job": "api"}, [(T0 * 1000, 1.0)])]
+        )
+        snd.send([rw])
+        deadline = time.time() + 15
+        while time.time() < deadline and ing.get_counters()["rows_written"] < 1:
+            time.sleep(0.05)
+        ing.flush()
+        enc = store.scan("prometheus", SAMPLES_ENC.name)
+        assert len(enc["time"]) == 1
+        labels = reg.decode(int(enc["metric_id"][0]), str(enc["label_ids"][0]))
+        assert labels == {"__name__": "up", "job": "api"}
+        assert enc["value"][0] == 1.0
+        # dictionaries landed too
+        assert store.scan("prometheus", METRIC_DICT.name)["name"][0] == "up"
+    finally:
+        snd.close()
+        ing.stop()
+        recv.stop()
