@@ -1,0 +1,57 @@
+"""Custom L7 protocol plugins — the Wasm / shared-object plugin seat.
+
+The reference loads operator-supplied protocol parsers as Wasm modules
+or shared objects (agent/src/plugin/, ~4.9k LoC) exposing the same
+check/parse interface as built-ins. The Python-native equivalent: a
+plugin directory of modules each declaring
+
+    PROTOCOL  = <int id>        # a datamodel.code.L7Protocol value or
+                                # a custom id ≥ 200
+    def check_payload(payload: bytes, port: int = 0) -> bool
+    def parse_payload(payload: bytes) -> parsers.L7Message | None
+
+`load_plugins(dir)` imports every module and registers it into the
+shared parser registry (parsers.register_parser — the same seat the
+wave-2 parsers use), so plugin protocols flow through inference, the
+L7 engine, flow logs, and RED metrics with zero further wiring.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import sys
+from pathlib import Path
+
+from .parsers import register_parser
+
+# operator protocol ids live above every built-in (l7_protocol.rs
+# reserves the custom range the same way)
+CUSTOM_PROTOCOL_BASE = 200
+
+
+def load_plugins(plugin_dir: str | Path) -> list[tuple[int, str]]:
+    """Import and register every plugin; returns [(protocol_id, name)].
+
+    A broken plugin is skipped (one bad operator module must not take
+    down the agent), mirroring the reference's plugin-load error stance.
+    """
+    loaded = []
+    d = Path(plugin_dir)
+    if not d.is_dir():
+        return loaded
+    for path in sorted(d.glob("*.py")):
+        name = f"deepflow_l7_plugin_{path.stem}"
+        try:
+            spec = importlib.util.spec_from_file_location(name, path)
+            mod = importlib.util.module_from_spec(spec)
+            sys.modules[name] = mod
+            spec.loader.exec_module(mod)
+            proto = int(mod.PROTOCOL)
+            check = mod.check_payload
+            parse = mod.parse_payload
+        except Exception:
+            sys.modules.pop(name, None)
+            continue
+        register_parser(proto, check, parse)
+        loaded.append((proto, path.stem))
+    return loaded

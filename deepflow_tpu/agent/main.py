@@ -1,0 +1,176 @@
+"""Agent daemon composition — the trident.rs wiring seat.
+
+The reference's trident.rs builds, per capture engine: dispatcher →
+FlowMap → {QuadrupleGenerator/Collector, FlowAggr, L7 log} chains, one
+UniformSender per output type, config sync, and self-monitoring
+(trident.rs:1748-1781 lists every sender). This composes the same
+pipeline graph from this package's pieces:
+
+  packet source (pcap replay / crafted batches; live capture has no
+  seat in this container) → parse_packets → FlowMap (L4 state) +
+  L7Engine (protocol logs) → per-second tick:
+    * L4 emissions → DualGranularityPipeline (1s+1m metric docs)
+      → METRICS sender
+    * L4 emissions → minute FlowAggr → TAGGEDFLOW sender
+    * L7 sessions → PROTOCOLLOG sender + L7 AppMeter pipeline → METRICS
+  plus AgentSyncClient (config/platform/NTP/upgrade) and the stats loop
+  shipping DFSTATS.
+
+`Agent.run_pcap()` is the replay driver; `step()` is the injectable
+unit tests drive.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ..aggregator.fanout import FanoutConfig
+from ..aggregator.pipeline import DualGranularityPipeline, L7Pipeline, PipelineConfig
+from ..aggregator.window import WindowConfig
+from ..datamodel.batch import FlowBatch
+from ..flowlog.aggr import MinuteAggr, ThrottlingQueue
+from ..flowlog.codec import encode_rows
+from ..ingest.codec import encode_docbatch
+from ..ingest.framing import MessageType
+from ..ingest.sender import UniformSender
+from ..utils.stats import StatsCollector
+from .bridge import emissions_to_flow_batch
+from .flow_map import FlowMap, FlowTimeouts
+from .l7.engine import L7Engine
+from .packet import parse_packets
+
+
+@dataclasses.dataclass(frozen=True)
+class AgentConfig:
+    agent_id: int = 1
+    organization_id: int = 1
+    servers: tuple = (("127.0.0.1", 20033),)
+    flow_capacity: int = 1 << 14
+    batch_size: int = 1 << 12
+    l4_log_throttle: int = 10_000
+    compression: str | int = "auto"
+    metrics_window: WindowConfig = WindowConfig(capacity=1 << 14)
+
+
+class Agent:
+    def __init__(self, config: AgentConfig = AgentConfig(), *, senders=None):
+        c = config
+        self.config = c
+        self.flow_map = FlowMap(
+            capacity=c.flow_capacity, batch_size=c.batch_size, agent_id=c.agent_id
+        )
+        self.l7 = L7Engine(agent_id=c.agent_id)
+        fanout = FanoutConfig(agent_id=c.agent_id)
+        pipe_cfg = PipelineConfig(
+            fanout=fanout, window=c.metrics_window, batch_size=c.batch_size
+        )
+        self.metrics = DualGranularityPipeline(pipe_cfg)
+        self.l7_metrics = L7Pipeline(pipe_cfg)
+        self.flow_aggr = MinuteAggr(batch_size=4 * c.batch_size)
+        self.l4_throttle = ThrottlingQueue(c.l4_log_throttle)
+
+        if senders is not None:
+            self.senders = senders  # test seam: {msg_type: sender-like}
+        else:
+            self.senders = {
+                mt: UniformSender(
+                    list(c.servers),
+                    mt,
+                    agent_id=c.agent_id,
+                    organization_id=c.organization_id,
+                    compression=c.compression,
+                )
+                for mt in (
+                    MessageType.METRICS,
+                    MessageType.TAGGEDFLOW,
+                    MessageType.PROTOCOLLOG,
+                )
+            }
+        self.counters = {"batches": 0, "packets": 0, "docs_sent": 0, "logs_sent": 0}
+
+    # -- pipeline step ---------------------------------------------------
+    def step(self, buf: np.ndarray, lengths, ts_s, ts_us) -> None:
+        """One capture batch through the whole graph."""
+        p = parse_packets(buf, lengths, ts_s, ts_us)
+        self.counters["batches"] += 1
+        self.counters["packets"] += int(p.valid.sum())
+        self.flow_map.inject(p)
+
+        # L7: protocol logs + RED metrics from the same packets
+        log_batch, app_batch = self.l7.process(buf, p)
+        if log_batch.size:
+            self._send(MessageType.PROTOCOLLOG, encode_rows(log_batch))
+            self.counters["logs_sent"] += log_batch.size
+        if app_batch.valid.any():
+            for db in self.l7_metrics.ingest(app_batch):
+                self._send_docs(db, self.l7_metrics.flags)
+
+        # L4 tick at the batch's max second: emissions feed metrics + logs
+        now = int(np.max(np.asarray(ts_s))) if len(np.asarray(ts_s)) else 0
+        emissions = self.flow_map.tick(now)
+        if emissions.size:
+            self._ingest_l4(emissions)
+            for sampled in self.l4_throttle.drain():
+                self._send(MessageType.TAGGEDFLOW, encode_rows(sampled))
+
+    def _ingest_l4(self, emissions) -> None:
+        """Emission rows → dual-granularity metric docs + minute flow
+        logs. Chunked: a drain tick can emit more rows than one pipeline
+        batch (the stash flushes whole windows at once)."""
+        fb = emissions_to_flow_batch(emissions)
+        bs = self.config.batch_size
+        for off in range(0, fb.size, bs):
+            chunk = FlowBatch(
+                tags={k: v[off : off + bs] for k, v in fb.tags.items()},
+                meters=fb.meters[off : off + bs],
+                valid=fb.valid[off : off + bs],
+            )
+            for flags, db in self.metrics.ingest(chunk):
+                self._send_docs(db, flags)
+        for minute_batch in self.flow_aggr.ingest(emissions):
+            self.l4_throttle.put(minute_batch)
+
+    def _send_docs(self, db, flags) -> None:
+        msgs = encode_docbatch(db, flags=int(flags))
+        self._send(MessageType.METRICS, msgs)
+        self.counters["docs_sent"] += db.size
+
+    def _send(self, mt: MessageType, msgs: list[bytes]) -> None:
+        s = self.senders.get(mt)
+        if s is not None and msgs:
+            s.send(msgs)
+
+    # -- drivers ---------------------------------------------------------
+    def run_pcap(self, path, *, batch_size: int | None = None) -> dict:
+        """Replay a capture file through the graph (the dispatcher seat —
+        this container has no live AF_PACKET/XDP; replay is the source)."""
+        from .pcap import pcap_batches
+
+        for buf, lengths, ts_s, ts_us in pcap_batches(
+            path, batch_size=batch_size or self.config.batch_size
+        ):
+            self.step(buf, lengths, ts_s, ts_us)
+        return self.drain()
+
+    def drain(self) -> dict:
+        """Flush everything (shutdown): final tick far in the future,
+        pipeline drains, sender close left to the caller."""
+        emissions = self.flow_map.tick(1 << 31)
+        if emissions.size:
+            self._ingest_l4(emissions)
+        for flags, db in self.metrics.drain():
+            self._send_docs(db, flags)
+        for db in self.l7_metrics.drain():
+            self._send_docs(db, self.l7_metrics.flags)
+        for batch in self.flow_aggr.drain():
+            self.l4_throttle.put(batch)
+        for sampled in self.l4_throttle.drain():
+            self._send(MessageType.TAGGEDFLOW, encode_rows(sampled))
+        return dict(self.counters)
+
+    def close(self) -> None:
+        for s in self.senders.values():
+            if hasattr(s, "close"):
+                s.close()

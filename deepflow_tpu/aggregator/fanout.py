@@ -111,6 +111,12 @@ def _make_lanes(tags: dict, meters_t: jnp.ndarray, valid: jnp.ndarray, config: F
     if app:
         l7_known = (tags["l7_protocol"] != 0) | is_otel
         valid = valid & l7_known
+    else:
+        # eBPF-sourced flows carry no L4 packet meters — the reference
+        # never feeds them to the L4 QuadrupleGenerator
+        # (quadruple_generator.rs:420-423 skips SignalSource::EBPF);
+        # they exist only on the L7/App plane.
+        valid = valid & (sig != jnp.uint32(SignalSource.EBPF))
 
     # reversed meter for the L4 server-endpoint single doc (meter.rs:169-176)
     if app:

@@ -28,6 +28,15 @@ class AgentGroupConfig:
     # the dynamic UserConfig payload (flat dict; agents overlay it on
     # their static YAML)
     config: dict = dataclasses.field(default_factory=dict)
+    # agent self-upgrade target for the group (trisolaris upgrade push:
+    # the reference serves versioned agent packages; agents reporting a
+    # different version get the offer and pull the package)
+    upgrade_version: str = ""
+    upgrade_package: bytes = b""
+    # computed once in set_upgrade — hashing a large package per sync
+    # (under the service lock) would serialize every agent
+    upgrade_sha256: str = ""
+    upgrade_b64: str = ""
 
 
 class TrisolarisService:
@@ -37,7 +46,9 @@ class TrisolarisService:
         self._agent_group: dict[int, str] = {}
         self.agents: dict[int, dict] = {}  # liveness registry
         self._lock = threading.Lock()
-        self.counters = {"syncs": 0, "config_pushes": 0, "platform_pushes": 0}
+        self.counters = {
+            "syncs": 0, "config_pushes": 0, "platform_pushes": 0, "upgrade_pulls": 0,
+        }
 
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -60,8 +71,25 @@ class TrisolarisService:
         with self._lock:
             self._agent_group[agent_id] = group
 
+    def set_upgrade(self, group: str, version: str, package: bytes) -> None:
+        """Stage an agent package for the group (upgrade push seat)."""
+        import base64
+        import hashlib
+
+        pkg = bytes(package)
+        sha = hashlib.sha256(pkg).hexdigest()
+        b64 = base64.b64encode(pkg).decode()
+        with self._lock:
+            g = self._groups.setdefault(group, AgentGroupConfig())
+            g.upgrade_version = version
+            g.upgrade_package = pkg
+            g.upgrade_sha256 = sha
+            g.upgrade_b64 = b64
+
     # -- sync protocol --------------------------------------------------
     def handle_sync(self, req: dict) -> dict:
+        if req.get("type") == "upgrade":
+            return self._handle_upgrade(req)
         agent_id = int(req.get("agent_id", 0))
         with self._lock:
             group = self._agent_group.get(agent_id, "default")
@@ -75,14 +103,40 @@ class TrisolarisService:
             resp: dict = {
                 "config_rev": g.revision,
                 "platform_version": self.db.version,
+                # NTP seat: agents diff this against their local clock
+                # (reference: trident NTP request/response over the same
+                # session)
+                "server_time_us": int(time.time() * 1_000_000),
             }
             if req.get("config_rev", 0) != g.revision:
                 resp["config"] = g.config
                 self.counters["config_pushes"] += 1
+            if g.upgrade_version and req.get("agent_version", "") != g.upgrade_version:
+                resp["upgrade"] = {
+                    "version": g.upgrade_version,
+                    "size": len(g.upgrade_package),
+                    "sha256": g.upgrade_sha256,
+                }
         if req.get("platform_version", 0) != self.db.version:
             resp["platform"] = self._platform_snapshot()
             self.counters["platform_pushes"] += 1
         return resp
+
+    def _handle_upgrade(self, req: dict) -> dict:
+        """Package pull: {type: 'upgrade', agent_id, version} →
+        {version, sha256, package_b64}."""
+        agent_id = int(req.get("agent_id", 0))
+        with self._lock:
+            group = self._agent_group.get(agent_id, "default")
+            g = self._groups.setdefault(group, AgentGroupConfig())
+            if not g.upgrade_version:
+                return {"error": "no upgrade staged"}
+            self.counters["upgrade_pulls"] += 1
+            return {
+                "version": g.upgrade_version,
+                "sha256": g.upgrade_sha256,
+                "package_b64": g.upgrade_b64,
+            }
 
     def _platform_snapshot(self) -> dict:
         """Compact platform payload: what agents need for local tagging
@@ -147,7 +201,31 @@ class AgentSyncClient:
         self.platform: dict = {}
         self.last_success: float | None = None
         self.escaped = False
-        self.counters = {"syncs_ok": 0, "syncs_failed": 0, "escapes": 0}
+        self.agent_version = ""
+        # NTP diff vs controller clock (µs; trident's NTP-over-session)
+        self.ntp_offset_us = 0
+        self.pending_upgrade: dict | None = None
+        self.counters = {"syncs_ok": 0, "syncs_failed": 0, "escapes": 0,
+                         "upgrades": 0}
+
+    def _rpc(self, req: dict) -> tuple[dict, float, float] | None:
+        """Returns (resp, t_send, t_recv) bracketing only the SUCCESSFUL
+        attempt — failover time on dead servers must not leak into the
+        NTP midpoint."""
+        for host, port in self.servers:
+            try:
+                with socket.create_connection((host, port), timeout=2.0) as s:
+                    f = s.makefile("rwb")
+                    t_send = time.time()
+                    f.write(json.dumps(req).encode() + b"\n")
+                    f.flush()
+                    resp = json.loads(f.readline())
+                    t_recv = time.time()
+            except (OSError, ValueError):
+                continue
+            if "error" not in resp:
+                return resp, t_send, t_recv
+        return None
 
     def sync_once(self, now: float | None = None) -> bool:
         now = time.time() if now is None else now
@@ -155,31 +233,60 @@ class AgentSyncClient:
             "agent_id": self.agent_id,
             "config_rev": self.config_rev,
             "platform_version": self.platform_version,
+            "agent_version": self.agent_version,
         }
-        for host, port in self.servers:
-            try:
-                with socket.create_connection((host, port), timeout=2.0) as s:
-                    f = s.makefile("rwb")
-                    f.write(json.dumps(req).encode() + b"\n")
-                    f.flush()
-                    resp = json.loads(f.readline())
-            except (OSError, ValueError):
-                continue
-            if "error" in resp:
-                continue
-            if "config" in resp:
-                self.config = {**self.defaults, **resp["config"]}
-            if "platform" in resp:
-                self.platform = resp["platform"]
-            self.config_rev = resp["config_rev"]
-            self.platform_version = resp["platform_version"]
-            self.last_success = now
-            self.escaped = False
-            self.counters["syncs_ok"] += 1
-            return True
-        self.counters["syncs_failed"] += 1
-        self._check_escape(now)
-        return False
+        got = self._rpc(req)
+        if got is None:
+            self.counters["syncs_failed"] += 1
+            self._check_escape(now)
+            return False
+        resp, t_send, t_recv = got
+        if "config" in resp:
+            self.config = {**self.defaults, **resp["config"]}
+        if "platform" in resp:
+            self.platform = resp["platform"]
+        if "server_time_us" in resp:
+            # midpoint correction: offset = server - (send+recv)/2
+            mid_us = (t_send + t_recv) / 2 * 1_000_000
+            self.ntp_offset_us = int(resp["server_time_us"] - mid_us)
+        self.pending_upgrade = resp.get("upgrade")
+        self.config_rev = resp["config_rev"]
+        self.platform_version = resp["platform_version"]
+        self.last_success = now
+        self.escaped = False
+        self.counters["syncs_ok"] += 1
+        return True
+
+    def corrected_time_us(self, now: float | None = None) -> int:
+        """Local clock adjusted onto the controller's (NTP seat)."""
+        now = time.time() if now is None else now
+        return int(now * 1_000_000) + self.ntp_offset_us
+
+    def pull_upgrade(self) -> tuple[str, bytes] | None:
+        """Fetch + verify the staged package; returns (version, bytes)
+        for the caller to install, or None. The caller MUST call
+        confirm_upgrade(version) only after a successful install — a
+        failed install must keep the offer pending so it retries."""
+        import base64
+        import hashlib
+
+        if not self.pending_upgrade:
+            return None
+        got = self._rpc({"type": "upgrade", "agent_id": self.agent_id})
+        if got is None:
+            return None
+        resp, _t0, _t1 = got
+        pkg = base64.b64decode(resp.get("package_b64", ""))
+        if hashlib.sha256(pkg).hexdigest() != resp.get("sha256"):
+            return None  # corrupt transfer: keep the offer pending
+        return resp["version"], pkg
+
+    def confirm_upgrade(self, version: str) -> None:
+        """Install succeeded: report the new version so the controller
+        stops offering, and count it."""
+        self.agent_version = version
+        self.pending_upgrade = None
+        self.counters["upgrades"] += 1
 
     def _check_escape(self, now: float) -> None:
         if self.last_success is None:

@@ -33,6 +33,10 @@ _ROUTES = {
     "/api/v1/prom/write": MessageType.PROMETHEUS,
     "/influxdb/api/v2/write": MessageType.TELEGRAF,
     "/api/v1/profile": MessageType.PROFILE,
+    # SkyWalking SegmentObject pb (agent OAP route) and Datadog JSON
+    # traces (integration_collector.rs SkyWalking/Datadog routes)
+    "/v3/segment": MessageType.SKYWALKING,
+    "/v0.4/traces": MessageType.DATADOG,
 }
 
 # request-size guards (the reference bounds bodies via hyper defaults;

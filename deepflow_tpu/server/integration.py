@@ -88,6 +88,8 @@ class IntegrationIngester:
         MessageType.PROFILE,
         MessageType.OPENTELEMETRY,
         MessageType.OPENTELEMETRY_COMPRESSED,
+        MessageType.SKYWALKING,
+        MessageType.DATADOG,
     )
 
     def __init__(
@@ -177,6 +179,10 @@ class IntegrationIngester:
                 self._profile(org, msg)
             elif mt == MessageType.OPENTELEMETRY:
                 self._otel(org, header, msg)
+            elif mt == MessageType.SKYWALKING:
+                self._skywalking(org, header, msg)
+            elif mt == MessageType.DATADOG:
+                self._datadog(org, header, msg)
             elif mt == MessageType.OPENTELEMETRY_COMPRESSED:
                 # agent-side zlib over the OTLP body (decoder.go:244
                 # decodeOTelCompressed); bounded via the shared zip-bomb
@@ -320,7 +326,22 @@ class IntegrationIngester:
             self.counters["rows_written"] += len(samples)
 
     def _otel(self, org: int, header: FlowHeader, msg: bytes) -> None:
-        spans = parse_otlp_traces(msg)
+        self._spans(org, header, parse_otlp_traces(msg))
+
+    def _skywalking(self, org: int, header: FlowHeader, msg: bytes) -> None:
+        from ..integration.trace_imports import parse_skywalking_segment
+
+        self._spans(org, header, parse_skywalking_segment(msg))
+
+    def _datadog(self, org: int, header: FlowHeader, msg: bytes) -> None:
+        from ..integration.trace_imports import parse_datadog_traces
+
+        self._spans(org, header, parse_datadog_traces(msg))
+
+    def _spans(self, org: int, header: FlowHeader, spans) -> None:
+        """OtelSpan list → l7_flow_log rows + trace-tree observation —
+        one lane shared by the OTLP / SkyWalking / Datadog imports
+        (decoder.go:244/:289/:338 all converge on L7FlowLog the same way)."""
         if not spans:
             return
         s = L7_FLOW_LOG

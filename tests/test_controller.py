@@ -123,3 +123,36 @@ def test_platform_refresh_into_enrichment():
     assert int(np.asarray(s0["pod_id"])[0]) == 55
     assert int(np.asarray(s0["az_id"])[0]) == 4
     assert keep.all()
+
+
+def test_trisolaris_ntp_and_upgrade(tmp_path):
+    """NTP offset from the sync response midpoint + staged-package pull
+    with sha verification (the reference's NTP/upgrade session RPCs)."""
+    from deepflow_tpu.controller.resources import ResourceDB
+    from deepflow_tpu.controller.trisolaris import AgentSyncClient, TrisolarisService
+
+    svc = TrisolarisService(ResourceDB())
+    try:
+        cli = AgentSyncClient([("127.0.0.1", svc.port)], agent_id=9)
+        assert cli.sync_once()
+        # clocks are the same host here: offset must be tiny
+        assert abs(cli.ntp_offset_us) < 2_000_000
+        assert abs(cli.corrected_time_us() - int(__import__("time").time() * 1e6)) < 5_000_000
+        assert cli.pending_upgrade is None
+
+        pkg = b"agent-binary-bytes" * 100
+        svc.set_upgrade("default", "v7.0.1", pkg)
+        assert cli.sync_once()
+        assert cli.pending_upgrade["version"] == "v7.0.1"
+        version, got = cli.pull_upgrade()
+        assert got == pkg and version == "v7.0.1"
+        # install not yet confirmed: offer stays pending (retry path)
+        assert cli.pending_upgrade is not None
+        cli.confirm_upgrade(version)
+        assert cli.agent_version == "v7.0.1"
+        # next sync: no more offer
+        assert cli.sync_once()
+        assert cli.pending_upgrade is None
+        assert svc.counters["upgrade_pulls"] == 1
+    finally:
+        svc.stop()

@@ -1,0 +1,68 @@
+"""geo lib, L7 plugin loader, eBPF L4 gate (SURVEY §2 parity items)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from deepflow_tpu.utils.geo import BUILTIN_LABELS, GeoTable
+
+
+def test_geo_builtin_ranges():
+    g = GeoTable.builtin()
+    ips = np.array(
+        [0x0A000001, 0xAC100101, 0xC0A80001, 0x7F000001, 0x08080808, 0xE0000001],
+        np.uint32,
+    )
+    got = [g.label(i) for i in g.lookup(ips)]
+    assert got == ["private-10", "private-172", "private-192", "loopback",
+                   "public", "multicast"]
+
+
+def test_geo_custom_table():
+    g = GeoTable.from_cidrs([("203.0.113.0/24", 42)], {42: "ap-southeast"})
+    ids = g.lookup(np.array([0xCB007101, 0xCB007201], np.uint32))
+    assert g.label(ids[0]) == "ap-southeast"
+    assert ids[1] == 0  # outside the /24
+
+
+def test_plugin_loader_registers_custom_protocol(tmp_path):
+    from deepflow_tpu.agent.l7.parsers import infer_protocol, parse_payload
+    from deepflow_tpu.agent.l7.plugins import load_plugins
+
+    (tmp_path / "myproto.py").write_text(
+        '''
+from deepflow_tpu.agent.l7.parsers import L7Message, MSG_REQUEST
+
+PROTOCOL = 201
+
+def check_payload(payload, port=0):
+    return payload.startswith(b"MYP/")
+
+def parse_payload(payload):
+    return L7Message(protocol=PROTOCOL, msg_type=MSG_REQUEST,
+                     request_type=payload[4:8].decode(errors="replace"))
+'''
+    )
+    (tmp_path / "broken.py").write_text("raise RuntimeError('bad plugin')")
+    loaded = load_plugins(tmp_path)
+    assert loaded == [(201, "myproto")]
+    assert infer_protocol(b"MYP/PING hello") == 201
+    assert parse_payload(201, b"MYP/PING").request_type == "PING"
+
+
+def test_ebpf_flows_skip_l4_fanout():
+    from deepflow_tpu.aggregator.fanout import FanoutConfig, fanout_l4, fanout_l7
+    from deepflow_tpu.datamodel.code import SignalSource
+    from deepflow_tpu.ingest.replay import SyntheticFlowGen
+
+    gen = SyntheticFlowGen(num_tuples=16, seed=1)
+    fb = gen.flow_batch(64, 1000)
+    fb.tags["signal_source"][:] = int(SignalSource.EBPF)
+    fb.tags["l7_protocol"][:] = 20
+    import jax.numpy as jnp
+
+    tags = {k: jnp.asarray(v) for k, v in fb.tags.items()}
+    _t, _m, _ts, valid_l4 = fanout_l4(tags, jnp.asarray(fb.meters), jnp.asarray(fb.valid), FanoutConfig())
+    assert not bool(np.asarray(valid_l4).any())  # no L4 docs from eBPF
+    _t, _m, _ts, valid_l7 = fanout_l7(tags, jnp.asarray(fb.meters), jnp.asarray(fb.valid), FanoutConfig())
+    assert bool(np.asarray(valid_l7).any())  # L7 plane still emits

@@ -1,0 +1,145 @@
+"""SkyWalking + Datadog trace imports → the shared span lane
+(decoder.go:289/:338 seats)."""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+
+from deepflow_tpu.ingest.codec import _put_varint
+from deepflow_tpu.integration.trace_imports import (
+    parse_datadog_traces,
+    parse_skywalking_segment,
+)
+
+T0 = 1_700_000_000
+
+
+def _ld(field, payload):
+    b = bytearray()
+    _put_varint(b, field << 3 | 2)
+    _put_varint(b, len(payload))
+    b += payload
+    return bytes(b)
+
+
+def _vi(field, v):
+    b = bytearray()
+    _put_varint(b, field << 3 | 0)
+    _put_varint(b, v & 0xFFFFFFFFFFFFFFFF)
+    return bytes(b)
+
+
+def _sw_segment():
+    """service 'cart' segment: entry span 0 (root via ref), exit span 1."""
+    ref = _ld(2, b"seg-upstream") + _vi(3, 4)  # parent segment/span
+    span0 = (
+        _vi(1, 0) + _vi(2, (-1) & 0xFFFFFFFFFFFFFFFF)
+        + _vi(3, T0 * 1000) + _vi(4, T0 * 1000 + 25)
+        + _ld(5, ref)
+        + _ld(8, b"GET:/cart") + _vi(13, 0)
+        + _ld(20, _ld(1, b"http.method") + _ld(2, b"GET"))
+    )
+    span1 = (
+        _vi(1, 1) + _vi(2, 0)
+        + _vi(3, T0 * 1000 + 5) + _vi(4, T0 * 1000 + 20)
+        + _ld(8, b"SELECT db") + _vi(13, 1) + _vi(19, 1)
+    )
+    return (
+        _ld(1, b"trace-abc") + _ld(2, b"seg-1")
+        + _ld(3, span0) + _ld(3, span1)
+        + _ld(4, b"cart") + _ld(5, b"cart-pod-1")
+    )
+
+
+def test_skywalking_segment_parse():
+    spans = parse_skywalking_segment(_sw_segment())
+    assert len(spans) == 2
+    entry, exit_ = spans
+    assert entry.trace_id == "trace-abc"
+    assert entry.span_id == "seg-1-0"
+    assert entry.parent_span_id == "seg-upstream-4"  # cross-segment ref
+    assert entry.name == "GET:/cart"
+    assert entry.kind == 2 and entry.status_code == 0
+    assert entry.end_us - entry.start_us == 25_000
+    assert entry.attributes["http.method"] == "GET"
+    assert exit_.parent_span_id == "seg-1-0"  # segment-local parent
+    assert exit_.kind == 3 and exit_.status_code == 2  # Exit + error
+
+
+def test_datadog_traces_parse():
+    payload = [[
+        {"trace_id": 42, "span_id": 7, "parent_id": 0, "service": "web",
+         "name": "web.request", "resource": "GET /", "start": T0 * 10**9,
+         "duration": 30_000_000, "error": 0, "meta": {"span.kind": "server"}},
+        {"trace_id": 42, "span_id": 8, "parent_id": 7, "service": "db",
+         "name": "pg.query", "resource": "SELECT", "start": T0 * 10**9,
+         "duration": 5_000_000, "error": 1, "meta": {"span.kind": "client"}},
+    ]]
+    spans = parse_datadog_traces(json.dumps(payload).encode())
+    assert len(spans) == 2
+    a, b = spans
+    assert a.trace_id == b.trace_id == format(42, "032x")
+    assert b.parent_span_id == format(7, "016x")
+    assert a.kind == 2 and b.kind == 3
+    assert b.status_code == 2
+    assert a.end_us - a.start_us == 30_000
+
+
+def test_malformed_imports_return_empty():
+    assert parse_skywalking_segment(b"\xff\xff\xff") == []
+    assert parse_datadog_traces(b"not json") == []
+    assert parse_datadog_traces(b'{"a": 1}') == []
+
+
+def test_sw_and_dd_to_trace_tree_e2e():
+    """Collector HTTP routes → ingester → l7_flow_log + assembled tree."""
+    from deepflow_tpu.ingest.receiver import Receiver
+    from deepflow_tpu.integration.collector import IntegrationCollector
+    from deepflow_tpu.server.integration import IntegrationIngester
+    from deepflow_tpu.storage.store import ColumnarStore
+    from deepflow_tpu.tracing import TraceTreeBuilder, query_trace
+
+    recv = Receiver()
+    recv.start()
+    store = ColumnarStore()
+    builder = TraceTreeBuilder(store, close_after_s=0.0,
+                               writer_args={"flush_interval_s": 0.01})
+    ing = IntegrationIngester(recv, store, writer_args={"flush_interval_s": 0.05},
+                              trace_builder=builder)
+    col = IntegrationCollector([("127.0.0.1", recv.tcp_port)])
+    try:
+        for path, body in (
+            ("/v3/segment", _sw_segment()),
+            ("/v0.4/traces", json.dumps([[
+                {"trace_id": 99, "span_id": 1, "service": "front",
+                 "name": "req", "resource": "GET /x", "start": T0 * 10**9,
+                 "duration": 10**6, "error": 0, "meta": {}}]]).encode()),
+        ):
+            req = urllib.request.Request(f"http://127.0.0.1:{col.port}{path}", data=body)
+            assert urllib.request.urlopen(req).status == 200
+
+        deadline = time.time() + 15
+        while time.time() < deadline and builder.get_counters()["spans_in"] < 3:
+            time.sleep(0.05)
+        assert builder.get_counters()["spans_in"] >= 3
+        builder.tick()
+        builder.flush()
+        ing.flush()
+
+        got = query_trace(store, "trace-abc")
+        assert got is not None
+        assert {n["app_service"] for n in got["nodes"]} == {"cart"}
+        assert got["nodes"][0]["response_total"] == 2
+
+        dd = query_trace(store, format(99, "032x"))
+        assert dd["nodes"][0]["app_service"] == "front"
+
+        l7 = store.scan("flow_log", "l7_flow_log", columns=["app_service"])
+        assert set(l7["app_service"]) == {"cart", "front"}
+    finally:
+        col.stop()
+        ing.stop()
+        builder.stop()
+        recv.stop()
