@@ -1,0 +1,103 @@
+"""Window-state checkpoint/resume.
+
+The reference has no checkpointing (streaming system; durable state
+lives in its databases — SURVEY §5), but the TPU build's device-resident
+window state (stash + accumulator rings + host window span) is exactly
+the state a preempted chip loses. These helpers serialize a
+WindowManager to one .npz so an evicted worker resumes mid-window
+instead of dropping every open window's partial aggregates.
+
+Format: the StashState/AccumState arrays (device → host), the host
+counters, and a version tag. Resume rebuilds device arrays lazily on
+first use (jnp.asarray on merge).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+from pathlib import Path
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..datamodel.schema import MeterSchema, TagSchema
+from .stash import AccumState, StashState
+from .window import WindowConfig, WindowManager
+
+_VERSION = 1
+
+
+def save_window_state(wm: WindowManager, path: str | Path) -> None:
+    arrays = {
+        "stash_slot": np.asarray(wm.state.slot),
+        "stash_key_hi": np.asarray(wm.state.key_hi),
+        "stash_key_lo": np.asarray(wm.state.key_lo),
+        "stash_tags": np.asarray(wm.state.tags),
+        "stash_meters": np.asarray(wm.state.meters),
+        "stash_valid": np.asarray(wm.state.valid),
+        "stash_dropped": np.asarray(wm.state.dropped_overflow),
+    }
+    if wm.acc is not None:
+        arrays.update(
+            acc_slot=np.asarray(wm.acc.slot),
+            acc_key_hi=np.asarray(wm.acc.key_hi),
+            acc_key_lo=np.asarray(wm.acc.key_lo),
+            acc_tags=np.asarray(wm.acc.tags),
+            acc_meters=np.asarray(wm.acc.meters),
+        )
+    meta = {
+        "version": _VERSION,
+        "fill": wm.fill,
+        "start_window": wm.start_window,
+        "drop_before_window": wm.drop_before_window,
+        "total_docs_in": wm.total_docs_in,
+        "total_flushed": wm.total_flushed,
+        "interval": wm.config.interval,
+        "delay": wm.config.delay,
+        "capacity": wm.config.capacity,
+        "accum_batches": wm.config.accum_batches,
+    }
+    buf = io.BytesIO()
+    np.savez_compressed(buf, meta=np.frombuffer(json.dumps(meta).encode(), np.uint8),
+                        **arrays)
+    Path(path).write_bytes(buf.getvalue())
+
+
+def load_window_state(
+    path: str | Path, tag_schema: TagSchema, meter_schema: MeterSchema
+) -> WindowManager:
+    with np.load(io.BytesIO(Path(path).read_bytes())) as z:
+        meta = json.loads(bytes(z["meta"]).decode())
+        if meta["version"] != _VERSION:
+            raise ValueError(f"checkpoint version {meta['version']} != {_VERSION}")
+        cfg = WindowConfig(
+            interval=meta["interval"],
+            delay=meta["delay"],
+            capacity=meta["capacity"],
+            accum_batches=meta["accum_batches"],
+        )
+        wm = WindowManager(cfg, tag_schema, meter_schema)
+        wm.state = StashState(
+            slot=jnp.asarray(z["stash_slot"]),
+            key_hi=jnp.asarray(z["stash_key_hi"]),
+            key_lo=jnp.asarray(z["stash_key_lo"]),
+            tags=jnp.asarray(z["stash_tags"]),
+            meters=jnp.asarray(z["stash_meters"]),
+            valid=jnp.asarray(z["stash_valid"]),
+            dropped_overflow=jnp.asarray(z["stash_dropped"]),
+        )
+        if "acc_slot" in z:
+            wm.acc = AccumState(
+                slot=jnp.asarray(z["acc_slot"]),
+                key_hi=jnp.asarray(z["acc_key_hi"]),
+                key_lo=jnp.asarray(z["acc_key_lo"]),
+                tags=jnp.asarray(z["acc_tags"]),
+                meters=jnp.asarray(z["acc_meters"]),
+            )
+        wm.fill = meta["fill"]
+        wm.start_window = meta["start_window"]
+        wm.drop_before_window = meta["drop_before_window"]
+        wm.total_docs_in = meta["total_docs_in"]
+        wm.total_flushed = meta["total_flushed"]
+    return wm

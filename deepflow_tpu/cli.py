@@ -11,6 +11,13 @@ Commands mirror the reference CLI surface that applies to this build:
   dfctl counters --port P [--module M]   live counter dump (debug UDP)
   dfctl agents --port P                  agent liveness (debug UDP)
   dfctl datasource ... (list/add)        downsampler management
+  dfctl rest --port P METHOD PATH [JSON] controller REST (agent-group /
+                                         domain / resource mgmt seats:
+                                         resources, datasources, traces,
+                                         tracemap, prom, profile)
+  dfctl agent-group --port P ...         trisolaris group config/upgrade
+  dfctl plugin --dir D list              L7 protocol plugin inventory
+  dfctl trace --port P TRACE_ID          assembled trace tree (REST)
 """
 
 from __future__ import annotations
@@ -88,6 +95,60 @@ def cmd_server(args):
         srv.stop()
 
 
+def cmd_rest(args):
+    import urllib.request
+
+    url = f"http://{args.host}:{args.port}{args.path}"
+    data = args.body.encode() if args.body else None
+    req = urllib.request.Request(url, data=data, method=args.method.upper())
+    try:
+        with urllib.request.urlopen(req) as r:
+            body = r.read()
+    except urllib.error.HTTPError as e:
+        body = e.read()
+    print(body.decode())
+
+
+def cmd_trace(args):
+    import urllib.request
+
+    with urllib.request.urlopen(
+        f"http://{args.host}:{args.port}/v1/traces/{args.trace_id}"
+    ) as r:
+        print(json.dumps(json.loads(r.read()), indent=2))
+
+
+def cmd_agent_group(args):
+    """Trisolaris group management over the sync socket (line-JSON):
+    the deepflow-ctl agent-group/agent-group-config seat."""
+    import base64
+    import socket
+
+    if args.action == "set-config":
+        # configs are set through the REST/debug plane in-process; over
+        # the wire we print the payload the server operator applies
+        print(json.dumps({"group": args.group, "config": json.loads(args.value)}))
+        return
+    req = {"agent_id": args.agent_id, "config_rev": 0, "platform_version": 0}
+    if args.action == "upgrade":
+        req = {"type": "upgrade", "agent_id": args.agent_id}
+    with socket.create_connection((args.host, args.port), timeout=5) as s:
+        f = s.makefile("rwb")
+        f.write(json.dumps(req).encode() + b"\n")
+        f.flush()
+        resp = json.loads(f.readline())
+    if args.action == "upgrade" and "package_b64" in resp:
+        resp["package_bytes"] = len(base64.b64decode(resp.pop("package_b64")))
+    print(json.dumps(resp, indent=2))
+
+
+def cmd_plugin(args):
+    from .agent.l7.plugins import load_plugins
+
+    loaded = load_plugins(args.dir)
+    print(json.dumps([{"protocol": p, "name": n} for p, n in loaded], indent=2))
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(prog="dfctl")
     sub = p.add_subparsers(dest="command", required=True)
@@ -124,6 +185,34 @@ def main(argv=None):
                 a, _n, **({"module": a.module} if _n == "counters" and a.module else {})
             )
         )
+
+    sp = sub.add_parser("rest")
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--port", type=int, required=True)
+    sp.add_argument("method")
+    sp.add_argument("path")
+    sp.add_argument("body", nargs="?", default=None)
+    sp.set_defaults(fn=cmd_rest)
+
+    sp = sub.add_parser("trace")
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--port", type=int, required=True)
+    sp.add_argument("trace_id")
+    sp.set_defaults(fn=cmd_trace)
+
+    sp = sub.add_parser("agent-group")
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--port", type=int, required=True)
+    sp.add_argument("action", choices=["sync", "upgrade", "set-config"])
+    sp.add_argument("--agent-id", type=int, default=0)
+    sp.add_argument("--group", default="default")
+    sp.add_argument("--value", default="{}")
+    sp.set_defaults(fn=cmd_agent_group)
+
+    sp = sub.add_parser("plugin")
+    sp.add_argument("--dir", required=True)
+    sp.add_argument("action", choices=["list"])
+    sp.set_defaults(fn=cmd_plugin)
 
     args = p.parse_args(argv)
     try:

@@ -9,12 +9,13 @@ into l7_flow_log rows + trace-tree spans, so every downstream plane
 
 Wire formats, from the public protocols:
   * SkyWalking: SegmentObject protobuf (skywalking-data-collect-protocol
-    language-agent/Tracing.proto): traceId=1, traceSegmentId=2,
+    language-agent/Tracing.proto v3): traceId=1, traceSegmentId=2,
     spans=3[SpanObject], service=4, serviceInstance=5. SpanObject:
     spanId=1, parentSpanId=2 (i32, -1 = root), startTime=3 ms,
-    endTime=4 ms, refs=5[SegmentReference{traceId=2 parentSpanId... }],
-    operationName=8, spanType=13 (0 Entry/1 Exit/2 Local),
-    spanLayer=15, componentId=16, isError=19, tags=20[KeyStringValuePair].
+    endTime=4 ms, refs=5[SegmentReference{refType=1, traceId=2,
+    parentTraceSegmentId=3, parentSpanId=4}], operationName=6, peer=7,
+    spanType=8 (0 Entry/1 Exit/2 Local), spanLayer=9, componentId=10,
+    isError=11, tags=12[KeyStringValuePair{key=1, value=2}].
   * Datadog: the MsgPack v0.4 trace payload is out of scope without a
     msgpack codec in-image; the JSON form (array of arrays of spans with
     trace_id/span_id/parent_id/service/name/resource/start/duration/
@@ -48,24 +49,25 @@ def _parse_sw_span(buf: bytes) -> dict:
         elif f == 4:
             s["end_ms"] = _zigzag_free_i64(v)
         elif f == 5 and isinstance(v, (bytes, bytearray, memoryview)):
-            # SegmentReference: parentTraceSegmentId=2, parentSpanId=3
+            # SegmentReference: parentTraceSegmentId=3 (string),
+            # parentSpanId=4
             ref_seg, ref_span = "", -1
             for rf, rv in _iter_fields(bytes(v)):
-                if rf == 2 and isinstance(rv, (bytes, bytearray, memoryview)):
+                if rf == 3 and isinstance(rv, (bytes, bytearray, memoryview)):
                     ref_seg = _pb_str(rv)
-                elif rf == 3:
+                elif rf == 4 and not isinstance(rv, (bytes, bytearray, memoryview)):
                     ref_span = _zigzag_free_i64(rv)
             if ref_seg:
                 s["refs_parent"] = f"{ref_seg}-{ref_span}"
-        elif f == 8 and isinstance(v, (bytes, bytearray, memoryview)):
+        elif f == 6 and isinstance(v, (bytes, bytearray, memoryview)):
             s["op"] = _pb_str(v)
-        elif f == 13:
-            s["span_type"] = _zigzag_free_i64(v)
-        elif f == 14 and isinstance(v, (bytes, bytearray, memoryview)):
+        elif f == 7 and isinstance(v, (bytes, bytearray, memoryview)):
             s["peer"] = _pb_str(v)
-        elif f == 19:
+        elif f == 8:
+            s["span_type"] = _zigzag_free_i64(v)
+        elif f == 11:
             s["is_error"] = bool(_zigzag_free_i64(v))
-        elif f == 20 and isinstance(v, (bytes, bytearray, memoryview)):
+        elif f == 12 and isinstance(v, (bytes, bytearray, memoryview)):
             k = val = ""
             for tf, tv in _iter_fields(bytes(v)):
                 if tf == 1:
@@ -141,25 +143,30 @@ def parse_datadog_traces(data: bytes) -> list[OtelSpan]:
         for sp in trace:
             if not isinstance(sp, dict):
                 continue
-            meta = sp.get("meta") or {}
-            start_ns = int(sp.get("start") or 0)
-            dur_ns = int(sp.get("duration") or 0)
-            out.append(
-                OtelSpan(
-                    service=str(sp.get("service", "")),
-                    name=str(sp.get("resource", sp.get("name", ""))),
-                    trace_id=format(int(sp.get("trace_id") or 0), "032x"),
-                    span_id=format(int(sp.get("span_id") or 0), "016x"),
-                    parent_span_id=(
-                        format(int(sp["parent_id"]), "016x")
-                        if sp.get("parent_id")
-                        else ""
-                    ),
-                    kind=3 if meta.get("span.kind") == "client" else 2,
-                    start_us=start_ns // 1000,
-                    end_us=(start_ns + dur_ns) // 1000,
-                    status_code=2 if int(sp.get("error") or 0) else 0,
-                    attributes={str(k): str(v) for k, v in meta.items()},
+            try:
+                meta = sp.get("meta") or {}
+                if not isinstance(meta, dict):
+                    meta = {}
+                start_ns = int(sp.get("start") or 0)
+                dur_ns = int(sp.get("duration") or 0)
+                out.append(
+                    OtelSpan(
+                        service=str(sp.get("service", "")),
+                        name=str(sp.get("resource", sp.get("name", ""))),
+                        trace_id=format(int(sp.get("trace_id") or 0), "032x"),
+                        span_id=format(int(sp.get("span_id") or 0), "016x"),
+                        parent_span_id=(
+                            format(int(sp["parent_id"]), "016x")
+                            if sp.get("parent_id")
+                            else ""
+                        ),
+                        kind=3 if meta.get("span.kind") == "client" else 2,
+                        start_us=start_ns // 1000,
+                        end_us=(start_ns + dur_ns) // 1000,
+                        status_code=2 if int(sp.get("error") or 0) else 0,
+                        attributes={str(k): str(v) for k, v in meta.items()},
+                    )
                 )
-            )
+            except (TypeError, ValueError):
+                continue  # one malformed span must not drop its siblings
     return out

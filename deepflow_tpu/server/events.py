@@ -80,6 +80,26 @@ APP_LOG_SCHEMA = TableSchema(
     ),
 )
 
+# per-packet TCP sequence records (flow_log decoder's l4_packet lane,
+# decoder.go:387; log_data/l4_packet.go row model condensed). Wire:
+# back-to-back 28-byte records [flow_id u64][ts_us u64][seq u32][ack u32]
+# [payload_len u16][tcp_flags u8][direction u8].
+L4_PACKET_SCHEMA = TableSchema(
+    "l4_packet",
+    (
+        ColumnSpec("time", "u4"),
+        ColumnSpec("agent_id", "u4"),
+        ColumnSpec("flow_id_hi", "u4"),
+        ColumnSpec("flow_id_lo", "u4"),
+        ColumnSpec("ts_us", "u8"),
+        ColumnSpec("seq", "u4"),
+        ColumnSpec("ack", "u4"),
+        ColumnSpec("payload_len", "u4"),
+        ColumnSpec("tcp_flags", "u4"),
+        ColumnSpec("direction", "u4"),
+    ),
+)
+
 PCAP_SCHEMA = TableSchema(
     "pcap",
     (
@@ -106,6 +126,7 @@ class EventIngester:
         MessageType.ALERT_EVENT,
         MessageType.APPLICATION_LOG,
         MessageType.RAW_PCAP,
+        MessageType.PACKETSEQUENCE,
     )
 
     def __init__(
@@ -178,6 +199,8 @@ class EventIngester:
             self._app_log(org, header, msg)
         elif mt == MessageType.RAW_PCAP:
             self._pcap(org, header, msg)
+        elif mt == MessageType.PACKETSEQUENCE:
+            self._l4_packet(org, header, msg)
 
     def _event(self, org: int, header: FlowHeader, msg: bytes, mt) -> None:
         ev = json.loads(msg)
@@ -261,6 +284,36 @@ class EventIngester:
         )
         with self._lock:
             self.counters["rows_written"] += 1
+
+    # 28-byte packet-sequence record; parsed as one structured-dtype
+    # frombuffer — this is the highest-volume lane (one record per TCP
+    # packet), a per-record unpack loop would dominate the worker
+    _L4P_DT = np.dtype([
+        ("fid", ">u8"), ("ts", ">u8"), ("seq", ">u4"), ("ack", ">u4"),
+        ("plen", ">u2"), ("flags", "u1"), ("dir", "u1"),
+    ])
+
+    def _l4_packet(self, org: int, header: FlowHeader, msg: bytes) -> None:
+        n = len(msg) // self._L4P_DT.itemsize
+        if n == 0:
+            raise ValueError("short l4_packet record")
+        a = np.frombuffer(msg, dtype=self._L4P_DT, count=n)
+        ts = a["ts"].astype(np.uint64)
+        out = {
+            "time": (ts // 1_000_000).astype(np.uint32),
+            "agent_id": np.full(n, header.agent_id, np.uint32),
+            "flow_id_hi": (a["fid"] >> np.uint64(32)).astype(np.uint32),
+            "flow_id_lo": (a["fid"] & np.uint64(0xFFFFFFFF)).astype(np.uint32),
+            "ts_us": ts,
+            "seq": a["seq"].astype(np.uint32),
+            "ack": a["ack"].astype(np.uint32),
+            "payload_len": a["plen"].astype(np.uint32),
+            "tcp_flags": a["flags"].astype(np.uint32),
+            "direction": a["dir"].astype(np.uint32),
+        }
+        self._writer(org_db("flow_log", org), L4_PACKET_SCHEMA).put(out)
+        with self._lock:
+            self.counters["rows_written"] += n
 
     # -- lifecycle ------------------------------------------------------
     def flush(self):

@@ -42,7 +42,11 @@ _BUILTIN_CIDRS = [
 
 
 class GeoTable:
-    """Sorted-interval IPv4 lookup: starts[i] ≤ ip ≤ ends[i] → ids[i]."""
+    """DISJOINT sorted-interval IPv4 lookup: starts[i] ≤ ip ≤ ends[i] →
+    ids[i]. Build via from_cidrs, which flattens arbitrary (nested /
+    overlapping) CIDRs into disjoint ranges with most-specific-wins —
+    the shape real geo tables have (a province /24 carved from an ISP
+    /16 must not shadow the rest of the /16)."""
 
     def __init__(self, starts: np.ndarray, ends: np.ndarray, ids: np.ndarray,
                  labels: dict[int, str]):
@@ -55,12 +59,31 @@ class GeoTable:
     @classmethod
     def from_cidrs(cls, cidrs: list[tuple[str, int]],
                    labels: dict[int, str] | None = None) -> "GeoTable":
-        starts, ends, ids = [], [], []
+        nets = []
         for cidr, gid in cidrs:
             net = ipaddress.ip_network(cidr)
-            starts.append(int(net.network_address))
-            ends.append(int(net.broadcast_address))
-            ids.append(gid)
+            nets.append(
+                (int(net.network_address), int(net.broadcast_address),
+                 net.prefixlen, gid)
+            )
+        # flatten: sweep over boundary points; within each elementary
+        # segment the longest-prefix (most specific) covering net wins
+        points = sorted({p for s, e, _l, _g in nets for p in (s, e + 1)})
+        starts, ends, ids = [], [], []
+        for lo, hi_excl in zip(points, points[1:]):
+            best = None
+            for s, e, plen, gid in nets:
+                if s <= lo and hi_excl - 1 <= e:
+                    if best is None or plen > best[0]:
+                        best = (plen, gid)
+            if best is not None:
+                # merge with the previous segment when contiguous + same id
+                if starts and ids[-1] == best[1] and ends[-1] == lo - 1:
+                    ends[-1] = hi_excl - 1
+                else:
+                    starts.append(lo)
+                    ends.append(hi_excl - 1)
+                    ids.append(best[1])
         return cls(
             np.asarray(starts, np.uint32),
             np.asarray(ends, np.uint32),
@@ -75,6 +98,8 @@ class GeoTable:
     def lookup(self, ips: np.ndarray) -> np.ndarray:
         """[N] u32 IPv4 → [N] u32 geo ids (UNKNOWN when no range hits)."""
         ips = np.asarray(ips, np.uint32)
+        if len(self.starts) == 0:
+            return np.zeros(ips.shape, np.uint32)
         idx = np.searchsorted(self.starts, ips, side="right") - 1
         idx_c = np.clip(idx, 0, len(self.starts) - 1)
         hit = (idx >= 0) & (ips <= self.ends[idx_c])

@@ -32,19 +32,27 @@ def _vi(field, v):
 
 
 def _sw_segment():
-    """service 'cart' segment: entry span 0 (root via ref), exit span 1."""
-    ref = _ld(2, b"seg-upstream") + _vi(3, 4)  # parent segment/span
+    """service 'cart' segment per the OFFICIAL v3 Tracing.proto field
+    numbers (SegmentReference: refType=1 traceId=2 parentTraceSegmentId=3
+    parentSpanId=4; SpanObject: operationName=6 peer=7 spanType=8
+    isError=11 tags=12): entry span 0 (root via ref), exit span 1."""
+    ref = (
+        _vi(1, 0)  # refType CrossProcess
+        + _ld(2, b"trace-abc")
+        + _ld(3, b"seg-upstream")  # parentTraceSegmentId (string)
+        + _vi(4, 4)  # parentSpanId
+    )
     span0 = (
         _vi(1, 0) + _vi(2, (-1) & 0xFFFFFFFFFFFFFFFF)
         + _vi(3, T0 * 1000) + _vi(4, T0 * 1000 + 25)
         + _ld(5, ref)
-        + _ld(8, b"GET:/cart") + _vi(13, 0)
-        + _ld(20, _ld(1, b"http.method") + _ld(2, b"GET"))
+        + _ld(6, b"GET:/cart") + _vi(8, 0)
+        + _ld(12, _ld(1, b"http.method") + _ld(2, b"GET"))
     )
     span1 = (
         _vi(1, 1) + _vi(2, 0)
         + _vi(3, T0 * 1000 + 5) + _vi(4, T0 * 1000 + 20)
-        + _ld(8, b"SELECT db") + _vi(13, 1) + _vi(19, 1)
+        + _ld(6, b"SELECT db") + _ld(7, b"db:5432") + _vi(8, 1) + _vi(11, 1)
     )
     return (
         _ld(1, b"trace-abc") + _ld(2, b"seg-1")
@@ -66,6 +74,32 @@ def test_skywalking_segment_parse():
     assert entry.attributes["http.method"] == "GET"
     assert exit_.parent_span_id == "seg-1-0"  # segment-local parent
     assert exit_.kind == 3 and exit_.status_code == 2  # Exit + error
+    assert exit_.attributes["net.peer.name"] == "db:5432"
+
+
+def test_datadog_bad_span_does_not_drop_siblings():
+    payload = [[
+        {"trace_id": 1, "span_id": 1, "service": "ok", "name": "a",
+         "resource": "a", "start": T0 * 10**9, "duration": 1000, "meta": {}},
+        {"trace_id": "not-an-int", "span_id": 2, "service": "bad",
+         "meta": "oops"},
+    ]]
+    spans = parse_datadog_traces(json.dumps(payload).encode())
+    assert len(spans) == 1 and spans[0].service == "ok"
+
+
+def test_geo_nested_cidrs_most_specific_wins():
+    import numpy as np
+
+    from deepflow_tpu.utils.geo import GeoTable
+
+    g = GeoTable.from_cidrs([("10.0.0.0/8", 1), ("10.1.0.0/16", 2)],
+                            {1: "isp", 2: "province"})
+    ids = g.lookup(np.array([0x0A010001, 0x0A020001, 0x0B000001], np.uint32))
+    assert [g.label(i) for i in ids] == ["province", "isp", "public"]
+    # empty table: all-unknown, no crash
+    empty = GeoTable.from_cidrs([])
+    assert list(empty.lookup(np.array([1], np.uint32))) == [0]
 
 
 def test_datadog_traces_parse():
