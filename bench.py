@@ -20,12 +20,13 @@ vs_baseline is against the north-star target of 50M records/sec/chip
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import jax
 import jax.numpy as jnp
 
-from deepflow_tpu.aggregator.fanout import FanoutConfig
+from deepflow_tpu.aggregator.fanout import FANOUT_LANES, FanoutConfig
 from deepflow_tpu.aggregator.pipeline import make_ingest_step
 from deepflow_tpu.aggregator.stash import accum_init, stash_init
 from deepflow_tpu.datamodel.schema import FLOW_METER, TAG_SCHEMA
@@ -33,11 +34,16 @@ from deepflow_tpu.ingest.replay import SyntheticFlowGen
 
 TARGET = 50e6  # records/sec/chip north star
 
-BATCH = 1 << 14  # flows per step (→ 4x doc rows)
-CAPACITY = 1 << 16  # stash segments
-ACCUM_BATCHES = 8  # appends per fold (WindowConfig.accum_batches)
+# Shape ceiling: the fold sorts CAPACITY + ACCUM_BATCHES×4×BATCH rows.
+# Remote compiles at ≥~500k rows have taken >550 s and once wedged the
+# accelerator tunnel for hours (PERF.md §5), so the default fold stays
+# ≤ ~200k rows — a measured-safe compile (~35 s at 131k). Larger, faster
+# amortizations can be opted into per-run: BENCH_ACCUM_BATCHES=8 etc.
+BATCH = int(os.environ.get("BENCH_BATCH", 1 << 14))  # flows per step
+CAPACITY = int(os.environ.get("BENCH_CAPACITY", 1 << 16))  # stash segments
+ACCUM_BATCHES = int(os.environ.get("BENCH_ACCUM_BATCHES", 2))
 WARMUP_CYCLES = 1
-CYCLES = 8  # measured (append × ACCUM_BATCHES + fold) cycles
+CYCLES = int(os.environ.get("BENCH_CYCLES", 8))
 
 
 def main():
@@ -51,7 +57,7 @@ def main():
     append = jax.jit(append_fn, donate_argnums=(0, 1))
     fold = jax.jit(fold_fn, donate_argnums=(0, 1))
 
-    doc_rows = 4 * BATCH
+    doc_rows = FANOUT_LANES * BATCH
     state = stash_init(CAPACITY, TAG_SCHEMA, FLOW_METER)
     acc = accum_init(ACCUM_BATCHES * doc_rows, TAG_SCHEMA, FLOW_METER)
 

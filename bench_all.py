@@ -63,7 +63,7 @@ def config1(quick: bool):
 
     BATCH = 1 << 12 if quick else 1 << 14
     CAP = 1 << 16
-    K = 8
+    K = 2  # fold stays ≤ ~200k rows (PERF.md §5 compile ceiling)
     CYCLES = 2 if quick else 8
 
     gen = SyntheticFlowGen(num_tuples=10_000, seed=0)
@@ -133,7 +133,7 @@ def config2(quick: bool):
     append = jax.jit(append_fn, donate_argnums=(0, 1))
     fold = jax.jit(fold_fn, donate_argnums=(0, 1))
     doc_rows = FANOUT_LANES * BATCH
-    K = 8
+    K = 2  # same compile ceiling as config1
     state = stash_init(1 << 16, TAG_SCHEMA, APP_METER)
     acc = accum_init(K * doc_rows, TAG_SCHEMA, APP_METER)
 
