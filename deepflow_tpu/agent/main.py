@@ -39,7 +39,7 @@ from ..utils.stats import StatsCollector
 from .bridge import emissions_to_flow_batch
 from .flow_map import FlowMap, FlowTimeouts
 from .l7.engine import L7Engine
-from .packet import parse_packets
+from .packet import CaptureFilter, parse_packets
 
 
 @dataclasses.dataclass(frozen=True)
@@ -52,6 +52,8 @@ class AgentConfig:
     l4_log_throttle: int = 10_000
     compression: str | int = "auto"
     metrics_window: WindowConfig = WindowConfig(capacity=1 << 14)
+    # dispatcher BPF seat: evaluated as one vectorized mask per batch
+    capture_filter: CaptureFilter | None = None
 
 
 class Agent:
@@ -88,12 +90,32 @@ class Agent:
                     MessageType.PROTOCOLLOG,
                 )
             }
-        self.counters = {"batches": 0, "packets": 0, "docs_sent": 0, "logs_sent": 0}
+        self.counters = {
+            "batches": 0, "packets": 0, "docs_sent": 0, "logs_sent": 0,
+            "packets_filtered": 0,
+        }
 
     # -- pipeline step ---------------------------------------------------
     def step(self, buf: np.ndarray, lengths, ts_s, ts_us) -> None:
         """One capture batch through the whole graph."""
         p = parse_packets(buf, lengths, ts_s, ts_us)
+        if self.config.capture_filter is not None:
+            keep = self.config.capture_filter.mask(p)
+            filtered = p.valid & ~keep
+            if filtered.any():
+                # drop filtered rows from the batch entirely — FlowMap's
+                # invalid_packets counter must keep meaning "capture
+                # garbage", not operator policy
+                self.counters["packets_filtered"] += int(filtered.sum())
+                retain = ~filtered
+                buf = buf[retain]
+                p = dataclasses.replace(
+                    p,
+                    **{
+                        f.name: getattr(p, f.name)[retain]
+                        for f in dataclasses.fields(p)
+                    },
+                )
         self.counters["batches"] += 1
         self.counters["packets"] += int(p.valid.sum())
         self.flow_map.inject(p)

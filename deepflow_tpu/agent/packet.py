@@ -10,8 +10,10 @@ device FlowMap directly.
 
 Covered: Ethernet + up to two 802.1Q VLAN tags, IPv4 (options via IHL),
 IPv6 (fixed header), TCP (flags/seq/ack/payload via data-offset), UDP,
-ICMP, and one VXLAN decap level (UDP :4789 → inner Ethernet), the
-dominant overlay of the reference's decap set (VXLAN/IPIP/ERSPAN/GRE).
+ICMP, and one vectorized decap level covering the reference's overlay
+set (dispatcher decap): VXLAN (UDP :4789 → inner Ethernet), IPIP
+(proto 4/41 → inner IP), GRE (proto 47 → inner IP), and ERSPAN II/III
+over GRE (→ inner Ethernet).
 Unknown ethertypes/protocols yield valid=False rows, never errors —
 capture streams contain garbage by design.
 """
@@ -29,6 +31,7 @@ ETH_QINQ = 0x88A8
 PROTO_ICMP = 1
 PROTO_TCP = 6
 PROTO_UDP = 17
+PROTO_GRE = 47
 VXLAN_PORT = 4789
 
 TCP_FIN = 0x01
@@ -56,7 +59,7 @@ class PacketBatch:
     payload_len: np.ndarray  # [N] u32 (L4 payload bytes)
     payload_off: np.ndarray  # [N] u32 (offset of the L4 payload in the snap)
     packet_len: np.ndarray  # [N] u32 (on-wire length incl. L2)
-    tunnel_type: np.ndarray  # [N] u32 (0 none, 1 vxlan)
+    tunnel_type: np.ndarray  # [N] u32 (0 none, 1 vxlan, 2 ipip, 3 gre, 4 erspan)
     valid: np.ndarray  # [N] bool
 
     @property
@@ -94,7 +97,14 @@ class _Headers:
     l4_off: np.ndarray
 
 
-def _parse_headers(buf: np.ndarray, lengths: np.ndarray, l2_off: np.ndarray) -> _Headers:
+def _parse_headers(
+    buf: np.ndarray, lengths: np.ndarray, l2_off: np.ndarray,
+    l3_off: np.ndarray | None = None,
+) -> _Headers:
+    """Rows parse from an Ethernet header at l2_off; rows whose l3_off
+    is ≥ 0 instead start straight at an IP header (IPIP / GRE-delivered
+    inner packets carry no inner Ethernet) — version nibble decides
+    v4/v6 there."""
     n, snap = buf.shape
     # clamp the L2 start so every fixed-offset read stays in the snap
     # (inner VXLAN offsets are data-driven); rows whose true headers
@@ -108,6 +118,20 @@ def _parse_headers(buf: np.ndarray, lengths: np.ndarray, l2_off: np.ndarray) -> 
         is_vlan = (et == ETH_VLAN) | (et == ETH_QINQ)
         et = np.where(is_vlan, _u16(buf, np.minimum(off + 2, snap - 2).astype(np.int64)), et)
         off = np.where(is_vlan, off + 4, off)
+
+    if l3_off is not None:
+        use3 = np.asarray(l3_off) >= 0
+        l3_c = np.minimum(np.maximum(l3_off, 0), snap - 41).astype(np.int64)
+        ver = _u8(buf, l3_c) >> 4
+        et = np.where(
+            use3,
+            np.where(ver == 6, ETH_IPV6, np.where(ver == 4, ETH_IPV4, 0)),
+            et,
+        )
+        off = np.where(use3, l3_c, off)
+        # +41 matches the snap-41 clamp: an IP header the clamp would
+        # shift is rejected, not parsed one byte early
+        fits = np.where(use3, np.asarray(l3_off) + 41 <= snap, fits)
 
     v4 = et == ETH_IPV4
     v6 = et == ETH_IPV6
@@ -164,8 +188,9 @@ def _parse_headers(buf: np.ndarray, lengths: np.ndarray, l2_off: np.ndarray) -> 
 def parse_packets(
     buf: np.ndarray, lengths: np.ndarray, ts_s: np.ndarray, ts_us: np.ndarray | None = None
 ) -> PacketBatch:
-    """[N, SNAP] u8 capture matrix → PacketBatch columns, with one VXLAN
-    decap pass (same vectorized stage re-run at per-row inner offsets)."""
+    """[N, SNAP] u8 capture matrix → PacketBatch columns, with one
+    vectorized decap pass over VXLAN / IPIP / GRE / ERSPAN II+III (the
+    same header stage re-run at per-row inner offsets)."""
     buf = np.asarray(buf, np.uint8)
     n, snap = buf.shape
     if snap < 54:
@@ -174,14 +199,61 @@ def parse_packets(
     zero_off = np.zeros(n, np.int64)
 
     outer = _parse_headers(buf, lengths, zero_off)
-    is_vxlan = outer.ok & outer.is_udp & (outer.dport == VXLAN_PORT)
     h = outer
     tunnel = np.zeros(n, np.uint32)
-    if is_vxlan.any():
-        inner_l2 = np.where(is_vxlan, outer.l4_off + 8 + 8, zero_off)  # UDP + VXLAN hdr
-        inner = _parse_headers(buf, lengths, inner_l2.astype(np.int64))
-        sel = is_vxlan & inner.ok
-        tunnel = np.where(sel, 1, 0).astype(np.uint32)
+
+    # -- one vectorized decap level: VXLAN / IPIP / GRE / ERSPAN-over-GRE
+    # (the reference's decap set, dispatcher/mod.rs; deeper nesting is a
+    # second pass nobody's traffic needs at the capture edge)
+    is_vxlan = outer.ok & outer.is_udp & (outer.dport == VXLAN_PORT)
+    is_ipip = outer.ok & ((outer.proto == 4) | (outer.proto == 41))
+    is_gre = outer.ok & (outer.proto == PROTO_GRE)
+    l4c = np.minimum(outer.l4_off, snap - 4).astype(np.int64)
+    gre_flags = _u16(buf, l4c)
+    gre_proto = _u16(buf, np.minimum(l4c + 2, snap - 2).astype(np.int64))
+    # base 4 bytes + checksum(+reserved) 4 + key 4 + sequence 4
+    gre_len = (
+        4
+        + 4 * ((gre_flags >> 15) & 1)
+        + 4 * ((gre_flags >> 13) & 1)
+        + 4 * ((gre_flags >> 12) & 1)
+    ).astype(np.int64)
+    gre_ip = is_gre & ((gre_proto == ETH_IPV4) | (gre_proto == ETH_IPV6))
+    erspan2 = is_gre & (gre_proto == 0x88BE)  # ERSPAN type II: 8-byte hdr
+    erspan3 = is_gre & (gre_proto == 0x22EB)  # ERSPAN type III: 12 bytes
+    # type III O bit (LSB of the header's last byte) appends an 8-byte
+    # platform-specific subheader before the inner Ethernet
+    ers3_last = _u8(
+        buf, np.minimum(outer.l4_off + gre_len + 11, snap - 1).astype(np.int64)
+    )
+    ers3_extra = np.where(erspan3 & ((ers3_last & 1) == 1), 8, 0).astype(np.int64)
+
+    minus1 = np.full(n, -1, np.int64)
+    inner_l2 = np.where(
+        is_vxlan,
+        outer.l4_off + 8 + 8,  # UDP + VXLAN hdr
+        np.where(
+            erspan2,
+            outer.l4_off + gre_len + 8,
+            np.where(erspan3, outer.l4_off + gre_len + 12 + ers3_extra, minus1),
+        ),
+    ).astype(np.int64)
+    inner_l3 = np.where(
+        is_ipip, outer.l4_off, np.where(gre_ip, outer.l4_off + gre_len, minus1)
+    ).astype(np.int64)
+
+    want_inner = (inner_l2 >= 0) | (inner_l3 >= 0)
+    if want_inner.any():
+        inner = _parse_headers(
+            buf, lengths, np.maximum(inner_l2, 0), l3_off=inner_l3
+        )
+        sel = want_inner & inner.ok
+        tunnel = np.where(
+            sel & is_vxlan, 1,
+            np.where(sel & is_ipip, 2,
+                     np.where(sel & gre_ip, 3,
+                              np.where(sel & (erspan2 | erspan3), 4, 0))),
+        ).astype(np.uint32)
 
         def pick(o, i):
             return np.where(sel[:, None] if o.ndim == 2 else sel, i, o)
@@ -296,3 +368,47 @@ def to_batch(
         buf[i, : len(b)] = np.frombuffer(b, np.uint8)
     us = np.asarray(ts_us if ts_us is not None else [0] * n, np.uint32)
     return buf, lengths, np.asarray(ts_s, np.uint32), us
+
+
+@dataclasses.dataclass(frozen=True)
+class CaptureFilter:
+    """Vectorized capture filter — the dispatcher's BPF seat.
+
+    The reference compiles operator BPF expressions into the kernel
+    socket (dispatcher/recv_engine BPF filters); here the same common
+    predicates evaluate as one mask over the parsed batch. Empty tuples
+    mean "no constraint"; `exclude_*` wins over includes (classic
+    "not port 22" usage).
+    """
+
+    protocols: tuple = ()  # allowed IP protocol numbers
+    ports: tuple = ()  # allowed ports (either side)
+    hosts: tuple = ()  # allowed IPv4 addresses (either side, u32)
+    exclude_ports: tuple = ()
+    exclude_hosts: tuple = ()
+
+    def mask(self, p: PacketBatch) -> np.ndarray:
+        m = np.ones(p.size, bool)
+        v4 = p.is_ipv6 == 0  # host filters carry IPv4 values; word-3
+        # comparison against a v6 address's low word would be a false hit
+        if self.protocols:
+            m &= np.isin(p.protocol, np.asarray(self.protocols, np.uint32))
+        if self.ports:
+            allow = np.asarray(self.ports, np.uint32)
+            m &= np.isin(p.port_src, allow) | np.isin(p.port_dst, allow)
+        if self.hosts:
+            allow = np.asarray(self.hosts, np.uint32)
+            m &= v4 & (np.isin(p.ip_src[:, 3], allow) | np.isin(p.ip_dst[:, 3], allow))
+        if self.exclude_ports:
+            deny = np.asarray(self.exclude_ports, np.uint32)
+            m &= ~(np.isin(p.port_src, deny) | np.isin(p.port_dst, deny))
+        if self.exclude_hosts:
+            deny = np.asarray(self.exclude_hosts, np.uint32)
+            m &= ~(
+                v4 & (np.isin(p.ip_src[:, 3], deny) | np.isin(p.ip_dst[:, 3], deny))
+            )
+        return m
+
+    def apply(self, p: PacketBatch) -> PacketBatch:
+        p.valid = p.valid & self.mask(p)
+        return p

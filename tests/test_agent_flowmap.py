@@ -346,3 +346,59 @@ def test_rtt_stamped_once_per_flow():
     r2 = fm.tick(T0 + 2).to_rows()[0]
     assert r2["is_new_flow"] == 0
     assert r2["rtt"] == 0 and r2["rtt_client_max"] == 0  # not re-stamped
+
+
+def test_decap_ipip_gre_erspan():
+    """IPIP / GRE / ERSPAN-II inner packets surface the inner 5-tuple
+    (dispatcher decap set, dispatcher/mod.rs)."""
+    import numpy as np
+
+    from deepflow_tpu.agent.packet import parse_packets, to_batch
+
+    inner_frame = craft_tcp(CLI, SRV, 40000, 443, flags=TCP_SYN, seq=5)
+    inner_ip = inner_frame[14:]  # strip inner Ethernet
+
+    def outer_ip_hdr(proto, payload_len, src=0x01010101, dst=0x02020202):
+        import struct as st
+
+        total = 20 + payload_len
+        return st.pack(
+            ">BBHHHBBHII", 0x45, 0, total, 0, 0, 64, proto, 0, src, dst
+        )
+
+    eth = bytes(12) + b"\x08\x00"
+
+    ipip = eth + outer_ip_hdr(4, len(inner_ip)) + inner_ip
+    gre_hdr = b"\x00\x00\x08\x00"  # no options, proto IPv4
+    gre = eth + outer_ip_hdr(47, 4 + len(inner_ip)) + gre_hdr + inner_ip
+    erspan_hdr = b"\x10\x00\x88\xbe" + bytes(4)  # GRE with seq bit + ERSPAN II
+    erspan = (
+        eth
+        + outer_ip_hdr(47, 8 + 8 + len(inner_frame))
+        + erspan_hdr
+        + bytes(8)  # ERSPAN II header
+        + inner_frame
+    )
+
+    b = parse_packets(*to_batch([ipip, gre, erspan], [T0] * 3, snap=256))
+    assert list(b.tunnel_type) == [2, 3, 4]
+    assert b.valid.all()
+    for i in range(3):
+        assert b.ip_src[i, 3] == CLI and b.ip_dst[i, 3] == SRV
+        assert b.port_src[i] == 40000 and b.port_dst[i] == 443
+        assert b.tcp_flags[i] == TCP_SYN
+
+
+def test_capture_filter_masks_batch():
+    from deepflow_tpu.agent.packet import CaptureFilter, parse_packets, to_batch
+
+    pkts = [
+        craft_tcp(CLI, SRV, 40000, 443, flags=TCP_SYN),
+        craft_tcp(CLI, SRV, 40001, 22, flags=TCP_SYN),
+        craft_udp(CLI, SRV, 5353, 53, b"q"),
+    ]
+    b = parse_packets(*to_batch(pkts, [T0] * 3))
+    f = CaptureFilter(protocols=(6,), exclude_ports=(22,))
+    assert f.mask(b).tolist() == [True, False, False]
+    assert CaptureFilter(hosts=(CLI,)).mask(b).tolist() == [True, True, True]
+    assert CaptureFilter(exclude_hosts=(SRV,)).mask(b).tolist() == [False, False, False]
