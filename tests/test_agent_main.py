@@ -131,3 +131,47 @@ def test_agent_to_server_e2e(tmp_path):
         assert "/api/cart" in eps
     finally:
         srv.stop()
+
+
+def test_ebpf_bridge_sessions_skip_l4_metrics():
+    """Socket-data events flow through the L7 engine, come out tagged
+    SignalSource.EBPF, feed the L7 metric plane but never the L4 one
+    (ebpf_dispatcher seat; quadruple_generator.rs:420-423 gate)."""
+    import jax.numpy as jnp
+
+    from deepflow_tpu.agent.ebpf_bridge import EbpfDispatcher, SocketDataEvent
+    from deepflow_tpu.agent.l7.engine import L7Engine
+    from deepflow_tpu.aggregator.fanout import FanoutConfig, fanout_l4, fanout_l7
+    from deepflow_tpu.datamodel.code import SignalSource
+    from deepflow_tpu.flowlog.schema import L7_FLOW_LOG
+
+    disp = EbpfDispatcher(L7Engine())
+    req = SocketDataEvent(
+        pid=7, ip_src=CLI, ip_dst=SRV, port_src=41000, port_dst=80,
+        protocol=6, direction=0,
+        payload=b"GET /k HTTP/1.1\r\nHost: h\r\n\r\n",
+        timestamp_us=T0 * 10**6,
+    )
+    resp = SocketDataEvent(
+        pid=7, ip_src=CLI, ip_dst=SRV, port_src=41000, port_dst=80,
+        protocol=6, direction=1,
+        payload=b"HTTP/1.1 200 OK\r\n\r\n",
+        timestamp_us=T0 * 10**6 + 900,
+    )
+    log_batch, app_batch = disp.process([req, resp])
+    assert log_batch.size == 1  # paired session
+    ii = L7_FLOW_LOG.int_index
+    assert log_batch.ints[0, ii("signal_source")] == int(SignalSource.EBPF)
+    assert log_batch.ints[0, ii("response_duration")] == 900  # µs rrt
+
+    from deepflow_tpu.datamodel.schema import FLOW_METER
+
+    tags = {k: jnp.asarray(v) for k, v in app_batch.tags.items()}
+    app_meters = jnp.asarray(app_batch.meters)
+    valid = jnp.asarray(app_batch.valid)
+    # L4 gate: same tags with FLOW_METER-shaped meters must emit nothing
+    l4_meters = jnp.zeros((app_batch.meters.shape[0], FLOW_METER.num_fields))
+    _t, _m, _ts, l4_valid = fanout_l4(tags, l4_meters, valid, FanoutConfig())
+    assert not bool(np.asarray(l4_valid).any())
+    _t, _m, _ts, l7_valid = fanout_l7(tags, app_meters, valid, FanoutConfig())
+    assert bool(np.asarray(l7_valid).any())
