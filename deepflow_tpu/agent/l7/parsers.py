@@ -299,6 +299,10 @@ _PORT_HINTS = {
     9092: L7Protocol.KAFKA,
     27017: L7Protocol.MONGODB,
     20880: L7Protocol.DUBBO,
+    1883: L7Protocol.MQTT,
+    11211: L7Protocol.MEMCACHED,
+    4222: L7Protocol.NATS,
+    5672: L7Protocol.AMQP,
 }
 
 
@@ -359,6 +363,12 @@ def _register_wave2() -> None:
     register_parser(L7Protocol.POSTGRESQL, ext.check_postgresql, ext.parse_postgresql)
     register_parser(L7Protocol.MONGODB, ext.check_mongodb, ext.parse_mongodb)
     register_parser(L7Protocol.DUBBO, ext.check_dubbo, ext.parse_dubbo)
+    from . import parsers_mq as mq
+
+    register_parser(L7Protocol.MQTT, mq.check_mqtt, mq.parse_mqtt)
+    register_parser(L7Protocol.MEMCACHED, mq.check_memcached, mq.parse_memcached)
+    register_parser(L7Protocol.NATS, mq.check_nats, mq.parse_nats)
+    register_parser(L7Protocol.AMQP, mq.check_amqp, mq.parse_amqp)
     # kafka last: its request heuristic is the loosest (mq/kafka.rs also
     # orders bespoke-magic protocols before it)
     register_parser(L7Protocol.KAFKA, ext.check_kafka, ext.parse_kafka)

@@ -1,0 +1,103 @@
+"""Wave-3 L7 parsers (MQTT, memcached, NATS, AMQP) — golden replays of
+the reference pcap fixtures + synthetic cases."""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import pytest
+
+from deepflow_tpu.agent.l7.parsers import MSG_REQUEST, MSG_RESPONSE, STATUS_OK, STATUS_SERVER_ERROR, infer_protocol
+from deepflow_tpu.agent.l7.parsers_mq import (
+    check_amqp,
+    check_memcached,
+    check_mqtt,
+    check_nats,
+    parse_amqp,
+    parse_memcached,
+    parse_mqtt,
+    parse_nats,
+)
+from deepflow_tpu.datamodel.code import L7Protocol
+from tests.test_l7_parsers_wave2 import FIXTURES, needs_fixtures, tcp_payloads
+
+
+@needs_fixtures
+def test_mqtt_connect_golden():
+    """mqtt_connect.result: CONNECT client_id test-1, then CONNACK code 0."""
+    msgs = [parse_mqtt(p) for _s, _d, p in tcp_payloads(FIXTURES / "mqtt" / "mqtt_connect.pcap")]
+    msgs = [m for m in msgs if m]
+    assert msgs[0].request_type == "CONNECT"
+    assert msgs[0].request_domain == "test-1"
+    connack = next(m for m in msgs if m.request_type == "CONNACK")
+    assert connack.msg_type == MSG_RESPONSE
+    assert connack.status_code == 0 and connack.status == STATUS_OK
+
+
+@needs_fixtures
+def test_mqtt_pub_golden():
+    msgs = [parse_mqtt(p) for _s, _d, p in tcp_payloads(FIXTURES / "mqtt" / "mqtt_pub.pcap")]
+    pubs = [m for m in msgs if m and m.request_type == "PUBLISH"]
+    assert pubs and pubs[0].request_resource  # topic decoded
+    assert pubs[0].msg_type == MSG_REQUEST
+
+
+@needs_fixtures
+def test_memcached_golden():
+    """memcached.result: request 'set foo 0 0 3'."""
+    msgs = [parse_memcached(p) for _s, _d, p in tcp_payloads(FIXTURES / "memcached" / "memcached.pcap")]
+    reqs = [m for m in msgs if m and m.msg_type == MSG_REQUEST]
+    assert any(m.request_type == "set" and m.request_resource.startswith("set foo")
+               for m in reqs)
+    resps = [m for m in msgs if m and m.msg_type == MSG_RESPONSE]
+    assert resps  # STORED / VALUE / END lines parsed
+
+
+@needs_fixtures
+def test_nats_err_golden():
+    """nats-err.result: INFO server banner then -ERR."""
+    msgs = [parse_nats(p) for _s, _d, p in tcp_payloads(FIXTURES / "nats" / "nats-err.pcap")]
+    msgs = [m for m in msgs if m]
+    assert msgs[0].request_type == "INFO"
+    assert any(m.request_type == "-ERR" and m.status == STATUS_SERVER_ERROR
+               for m in msgs)
+
+
+@needs_fixtures
+def test_amqp_golden():
+    """amqp1.result: protocol header session, then Connection.Start."""
+    msgs = [parse_amqp(p) for _s, _d, p in tcp_payloads(FIXTURES / "amqp" / "amqp1.pcap")]
+    msgs = [m for m in msgs if m]
+    assert msgs[0].request_type == "ProtocolHeader"
+    assert any(m.request_type == "Connection.Start" for m in msgs)
+
+
+def test_wave3_inference():
+    connect = bytes([0x10, 18]) + b"\x00\x04MQTT\x04\x02\x00\x3c" + b"\x00\x06client"
+    assert infer_protocol(connect) == L7Protocol.MQTT
+    assert infer_protocol(b"get mykey\r\n", server_port=11211) == L7Protocol.MEMCACHED
+    assert infer_protocol(b"PUB orders.created 5\r\nhello\r\n") == L7Protocol.NATS
+    assert infer_protocol(b"AMQP\x00\x00\x09\x01") == L7Protocol.AMQP
+    # existing protocols still win their own bytes
+    assert infer_protocol(b"GET / HTTP/1.1\r\n\r\n") == L7Protocol.HTTP1
+
+
+def test_mqtt_v5_connect_client_id():
+    # MQTT 5 CONNECT: proto name, level 5, flags, keepalive,
+    # properties (len 0), client id "abc"
+    var = b"\x00\x04MQTT\x05\x02\x00\x3c" + b"\x00" + b"\x00\x03abc"
+    pkt = bytes([0x10, len(var)]) + var
+    m = parse_mqtt(pkt)
+    assert m.request_type == "CONNECT" and m.request_domain == "abc"
+
+
+def test_amqp_handshake_directions():
+    def method_frame(cls, mid):
+        body = cls.to_bytes(2, "big") + mid.to_bytes(2, "big")
+        return b"\x01" + b"\x00\x00" + len(body).to_bytes(4, "big") + body + b"\xce"
+
+    start = parse_amqp(method_frame(10, 10))
+    start_ok = parse_amqp(method_frame(10, 11))
+    assert start.msg_type == MSG_REQUEST  # server-initiated request
+    assert start_ok.msg_type == MSG_RESPONSE
+    assert start.request_type == "Connection.Start"
