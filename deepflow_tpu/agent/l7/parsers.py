@@ -364,7 +364,10 @@ def _register_wave2() -> None:
     register_parser(L7Protocol.MONGODB, ext.check_mongodb, ext.parse_mongodb)
     register_parser(L7Protocol.DUBBO, ext.check_dubbo, ext.parse_dubbo)
     from . import parsers_mq as mq
+    from . import parsers_rpc as rpc
 
+    register_parser(L7Protocol.FASTCGI, rpc.check_fastcgi, rpc.parse_fastcgi)
+    register_parser(L7Protocol.ROCKETMQ, rpc.check_rocketmq, rpc.parse_rocketmq)
     register_parser(L7Protocol.MQTT, mq.check_mqtt, mq.parse_mqtt)
     register_parser(L7Protocol.MEMCACHED, mq.check_memcached, mq.parse_memcached)
     register_parser(L7Protocol.NATS, mq.check_nats, mq.parse_nats)

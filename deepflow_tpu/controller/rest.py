@@ -201,6 +201,13 @@ class RestServer:
             out = df.query_trace(parts[2], org=int(q.get("org") or 1))
             h._json(out if out is not None else {"error": "not found"},
                     200 if out is not None else 404)
+        elif len(parts) == 3 and parts[:2] == ["api", "traces"]:
+            # Tempo datasource shape (Grafana points here)
+            from ..tracing.query import tempo_trace
+
+            out = tempo_trace(df.store, parts[2], org=int(q.get("org") or 1))
+            h._json(out if out is not None else {"error": "trace not found"},
+                    200 if out is not None else 404)
         elif u.path == "/v1/tracemap":
             tr = None
             if q.get("start") or q.get("end"):

@@ -86,6 +86,57 @@ def query_trace(
     return tree.to_dict() if tree is not None else None
 
 
+def tempo_trace(
+    store: ColumnarStore,
+    trace_id: str,
+    org: int = 1,
+    time_range: tuple[int, int] | None = None,
+) -> dict | None:
+    """Tempo/OTLP-shaped trace response — the querier's Tempo adapter
+    seat (the reference serves Grafana's Tempo datasource from its span
+    store). Raw spans come from l7_flow_log; shape follows the OTLP JSON
+    trace schema Grafana consumes: batches → scopeSpans → spans."""
+    db = org_db(FLOW_LOG_DB, org)
+    spans = _spans_from_l7(store, db, trace_id, time_range)
+    if not spans:
+        return None
+    by_service: dict[str, list] = {}
+    for s in spans:
+        by_service.setdefault(s.app_service, []).append(s)
+    batches = []
+    for service, group in by_service.items():
+        batches.append(
+            {
+                "resource": {
+                    "attributes": [
+                        {"key": "service.name", "value": {"stringValue": service}}
+                    ]
+                },
+                "scopeSpans": [
+                    {
+                        "scope": {"name": "deepflow_tpu"},
+                        "spans": [
+                            {
+                                "traceId": trace_id,
+                                "spanId": s.span_id,
+                                "parentSpanId": s.parent_span_id,
+                                "name": service,
+                                "kind": 2,
+                                "startTimeUnixNano": str(s.start_us * 1000),
+                                "endTimeUnixNano": str(
+                                    (s.start_us + s.response_duration_us) * 1000
+                                ),
+                                "status": {"code": 2 if s.server_error else 0},
+                            }
+                            for s in group
+                        ],
+                    }
+                ],
+            }
+        )
+    return {"batches": batches}
+
+
 def trace_map(
     store: ColumnarStore,
     time_range: tuple[int, int] | None = None,

@@ -101,3 +101,57 @@ def test_amqp_handshake_directions():
     assert start.msg_type == MSG_REQUEST  # server-initiated request
     assert start_ok.msg_type == MSG_RESPONSE
     assert start.request_type == "Connection.Start"
+
+
+# -- wave 4: FastCGI + RocketMQ -----------------------------------------
+
+
+@needs_fixtures
+def test_fastcgi_golden():
+    from deepflow_tpu.agent.l7.parsers_rpc import parse_fastcgi
+
+    msgs = [parse_fastcgi(p) for _s, _d, p in
+            tcp_payloads(FIXTURES / "fastcgi" / "fastcgi.pcap")]
+    reqs = [m for m in msgs if m and m.msg_type == MSG_REQUEST]
+    resps = [m for m in msgs if m and m.msg_type == MSG_RESPONSE]
+    assert reqs and resps
+    assert any(m.request_type for m in reqs)  # REQUEST_METHOD decoded
+
+
+@needs_fixtures
+def test_rocketmq_pull_golden():
+    """rocketmq-pull-message.result: PULL_MESSAGE opaque 1429, group
+    otel-consumer-group, topic otel-demo-topic; response SUCCESS."""
+    from deepflow_tpu.agent.l7.parsers_rpc import parse_rocketmq
+
+    msgs = [parse_rocketmq(p) for _s, _d, p in
+            tcp_payloads(FIXTURES / "rocketmq" / "rocketmq-consumer-otel.pcap")]
+    reqs = [m for m in msgs if m and m.msg_type == MSG_REQUEST
+            and m.request_type == "PULL_MESSAGE"]
+    assert reqs
+    assert reqs[0].request_domain == "otel-consumer-group"
+    assert reqs[0].request_resource == "otel-demo-topic"
+    resps = [m for m in msgs if m and m.msg_type == MSG_RESPONSE]
+    assert any(m.request_type == "SUCCESS" and m.status == STATUS_OK for m in resps)
+
+
+@needs_fixtures
+def test_rocketmq_heartbeat_golden():
+    from deepflow_tpu.agent.l7.parsers_rpc import parse_rocketmq
+
+    msgs = [parse_rocketmq(p) for _s, _d, p in
+            tcp_payloads(FIXTURES / "rocketmq" / "rocketmq-heartbeat.pcap")]
+    assert any(m and m.request_type == "HEART_BEAT" for m in msgs)
+
+
+def test_wave4_inference():
+    import json as _json
+
+    from deepflow_tpu.agent.l7.parsers import infer_protocol
+
+    hdr = _json.dumps({"code": 10, "flag": 0, "opaque": 7,
+                       "extFields": {"topic": "t"}}).encode()
+    frame = (len(hdr) + 4).to_bytes(4, "big") + len(hdr).to_bytes(4, "big") + hdr
+    assert infer_protocol(frame) == L7Protocol.ROCKETMQ
+    fcgi = bytes([1, 1, 0, 5, 0, 8, 0, 0]) + bytes(8)
+    assert infer_protocol(fcgi, server_port=9000) == L7Protocol.FASTCGI

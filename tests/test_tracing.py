@@ -303,3 +303,44 @@ def test_otlp_to_trace_tree_e2e():
         ing.stop()
         builder.stop()
         recv.stop()
+
+
+def test_tempo_trace_shape():
+    """GET /api/traces/{id} serves the OTLP-JSON shape Grafana's Tempo
+    datasource consumes (querier Tempo adapter seat)."""
+    store = ColumnarStore()
+    from deepflow_tpu.flowlog.aggr import FlowLogBatch
+    from deepflow_tpu.flowlog.schema import L7_FLOW_LOG
+    from deepflow_tpu.flowlog.server import log_batch_to_columns, log_table_schema
+    from deepflow_tpu.storage.writer import TableWriter
+    from deepflow_tpu.tracing.query import tempo_trace
+
+    s = L7_FLOW_LOG
+    n = 2
+    ints = np.zeros((n, len(s.ints)), np.uint32)
+    nums = np.zeros((n, len(s.nums)), np.float32)
+    strs = {f.name: [""] * n for f in s.strs}
+    for r, (sid, psid, svc) in enumerate([("a", "", "gw"), ("b", "a", "db")]):
+        ints[r, s.int_index("end_time")] = T0
+        ints[r, s.int_index("start_time")] = T0
+        ints[r, s.int_index("response_duration")] = 500
+        strs["trace_id"][r] = "tempo-1"
+        strs["span_id"][r] = sid
+        strs["parent_span_id"][r] = psid
+        strs["app_service"][r] = svc
+    w = TableWriter(store, "flow_log", log_table_schema(s), flush_interval_s=0.01)
+    w.put(log_batch_to_columns(FlowLogBatch(s, ints, nums, np.ones(n, bool), strs)))
+    w.flush()
+
+    out = tempo_trace(store, "tempo-1")
+    assert out is not None and len(out["batches"]) == 2
+    svc_names = {
+        b["resource"]["attributes"][0]["value"]["stringValue"]
+        for b in out["batches"]
+    }
+    assert svc_names == {"gw", "db"}
+    span = out["batches"][0]["scopeSpans"][0]["spans"][0]
+    assert span["traceId"] == "tempo-1"
+    assert int(span["endTimeUnixNano"]) - int(span["startTimeUnixNano"]) == 500_000
+    assert tempo_trace(store, "nope") is None
+    w.stop()
