@@ -28,7 +28,7 @@ from ...datamodel.code import Direction, L7Protocol, SignalSource
 from ...datamodel.schema import APP_METER
 from ...flowlog.aggr import FlowLogBatch
 from ...flowlog.schema import L7_FLOW_LOG
-from ..packet import PROTO_TCP, PROTO_UDP, PacketBatch
+from ..packet import PROTO_ICMP, PROTO_TCP, PROTO_UDP, PacketBatch
 from .parsers import (
     MSG_REQUEST,
     MSG_RESPONSE,
@@ -95,7 +95,11 @@ class L7Engine:
         """One capture batch → (l7 log rows, AppMeter records)."""
         sessions: list[dict] = []
         buf = np.asarray(buf, np.uint8)
-        idx = np.nonzero(p.valid & (p.payload_len > 0) & ((p.protocol == PROTO_TCP) | (p.protocol == PROTO_UDP)))[0]
+        idx = np.nonzero(
+            p.valid
+            & (p.payload_len > 0)
+            & ((p.protocol == PROTO_TCP) | (p.protocol == PROTO_UDP) | (p.protocol == PROTO_ICMP))
+        )[0]
         for i in idx:
             self._one_packet(buf, p, int(i), sessions)
         # session-timeout sweep on the batch's max clock
@@ -128,7 +132,16 @@ class L7Engine:
             if fl.tries >= _MAX_INFER_TRIES:
                 return
             fl.tries += 1
-            proto = infer_protocol(payload, dport) or infer_protocol(payload, sport)
+            if int(p.protocol[i]) == PROTO_ICMP:
+                # ICMP never enters the TCP/UDP probe chain: echo frames
+                # go straight to PING, everything else stays UNKNOWN
+                from .parsers_w4 import check_ping
+
+                if not check_ping(payload):
+                    return
+                proto = L7Protocol.PING
+            else:
+                proto = infer_protocol(payload, dport) or infer_protocol(payload, sport)
             if proto == L7Protocol.UNKNOWN:
                 return
             fl.protocol = proto

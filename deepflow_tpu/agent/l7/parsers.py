@@ -303,6 +303,12 @@ _PORT_HINTS = {
     11211: L7Protocol.MEMCACHED,
     4222: L7Protocol.NATS,
     5672: L7Protocol.AMQP,
+    6650: L7Protocol.PULSAR,
+    61616: L7Protocol.OPENWIRE,
+    1521: L7Protocol.ORACLE,
+    12200: L7Protocol.SOFARPC,
+    30490: L7Protocol.SOME_IP,
+    30509: L7Protocol.SOME_IP,
 }
 
 
@@ -377,7 +383,30 @@ def _register_wave2() -> None:
     register_parser(L7Protocol.KAFKA, ext.check_kafka, ext.parse_kafka)
 
 
+def _register_wave4() -> None:
+    """Wave 4: the remaining reference parsers (rpc/mq/sql/ping.rs).
+    All have strict magics, so they slot in ahead of kafka's loose
+    heuristic; ping goes last (its only guard is the ICMP checksum)."""
+    from . import parsers_w4 as w4
+
+    kafka = next(p for p in _PARSERS if p[0] == L7Protocol.KAFKA)
+    _PARSERS.remove(kafka)
+    register_parser(L7Protocol.SOFARPC, w4.check_sofarpc, w4.parse_sofarpc)
+    register_parser(L7Protocol.BRPC, w4.check_brpc, w4.parse_brpc)
+    register_parser(L7Protocol.TARS, w4.check_tars, w4.parse_tars)
+    register_parser(L7Protocol.SOME_IP, w4.check_someip, w4.parse_someip)
+    register_parser(L7Protocol.PULSAR, w4.check_pulsar, w4.parse_pulsar)
+    register_parser(L7Protocol.OPENWIRE, w4.check_openwire, w4.parse_openwire)
+    register_parser(L7Protocol.ZMTP, w4.check_zmtp, w4.parse_zmtp)
+    register_parser(L7Protocol.ORACLE, w4.check_oracle, w4.parse_oracle)
+    _PARSERS.append(kafka)
+    # PING parses only ICMP flows; the engine dispatches those directly
+    # (engine._one_packet), so its probe never fires on TCP/UDP payloads
+    register_parser(L7Protocol.PING, lambda p, port=0: False, w4.parse_ping)
+
+
 _register_wave2()
+_register_wave4()
 
 # GRPC rides the HTTP2 parser (content-type dispatch); parse_payload on
 # GRPC must resolve too

@@ -230,11 +230,15 @@ def check_amqp(payload: bytes, port: int = 0) -> bool:
         return False
     ftype = payload[0]
     size = int.from_bytes(payload[3:7], "big")
-    # off-port we demand the whole frame in the segment; on :5672 a frame
-    # may span segments, so only a sane size bound applies
-    return ftype in (1, 2, 3, 8) and (
-        size + 8 <= len(payload) or (port == 5672 and size < 1 << 24)
-    )
+    # off-port we demand the whole frame in the segment WITH the 0xCE
+    # frame-end octet (spec §2.3.5) — that end marker is what keeps
+    # arbitrary length-prefixed binary from classifying as AMQP; on
+    # :5672 a frame may span segments, so only a sane size bound applies
+    if ftype not in (1, 2, 3, 8):
+        return False
+    if size + 8 <= len(payload):
+        return payload[7 + size] == 0xCE
+    return port == 5672 and size < 1 << 24
 
 
 def parse_amqp(payload: bytes) -> L7Message | None:

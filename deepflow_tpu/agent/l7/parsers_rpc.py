@@ -47,6 +47,8 @@ def _fcgi_records(payload: bytes):
         plen = payload[off + 6]
         if version != 1 or rtype not in _FCGI_TYPES:
             return
+        if rtype == 1 and clen != 8:  # spec: BEGIN_REQUEST body is exactly 8B
+            return
         yield rtype, req_id, payload[off + 8 : off + 8 + clen]
         off += 8 + clen + plen
 
@@ -154,11 +156,15 @@ def check_rocketmq(payload: bytes, port: int = 0) -> bool:
     meta = int.from_bytes(payload[4:8], "big")
     hlen = meta & 0xFFFFFF
     serializer = meta >> 24
+    # only the JSON serializer (0) is parseable below; accepting the
+    # binary one would pin flows to a protocol that then never parses
+    # (and its loose shape swallows SofaRPC/Bolt frames)
     return (
         4 <= total <= 1 << 25
-        and serializer in (0, 1)
+        and serializer == 0
+        and 2 <= hlen
         and hlen + 4 <= total
-        and (serializer == 1 or payload[8:9] == b"{")
+        and payload[8:9] == b"{"
     )
 
 

@@ -164,7 +164,9 @@ def _parse_headers(
     flags = _u8(buf, l4_c + 13)
     l4_hdr = np.where(is_tcp, doff, np.where(is_udp, 8, 0))
     payload = ip_total.astype(np.int64) - (l4_off - off_c) - l4_hdr
-    payload = np.where(is_tcp | is_udp, np.maximum(payload, 0), 0)
+    # ICMP keeps the whole message (type byte onward) as its payload so
+    # the PING parser sees the echo header (ping.rs ICMP seat)
+    payload = np.where(is_tcp | is_udp | (proto == PROTO_ICMP), np.maximum(payload, 0), 0)
 
     ok = fits & (v4 | v6) & (lengths >= 34) & (l4_off + np.where(is_tcp, 20, 8) <= snap)
     return _Headers(
@@ -348,6 +350,22 @@ def craft_udp(src_ip: int, dst_ip: int, sport: int, dport: int, payload: bytes =
         + dst_ip.to_bytes(4, "big")
     )
     return eth + ip + udp + payload
+
+
+def craft_icmp(src_ip: int, dst_ip: int, icmp: bytes) -> bytes:
+    """IPv4 frame carrying a raw ICMP message (echo header + data)."""
+    eth = b"\x02\x00\x00\x00\x00\x01\x02\x00\x00\x00\x00\x02" + (0x0800).to_bytes(2, "big")
+    total = 20 + len(icmp)
+    ip = (
+        bytes([0x45, 0])
+        + total.to_bytes(2, "big")
+        + b"\x00\x00\x40\x00\x40"
+        + bytes([PROTO_ICMP])
+        + b"\x00\x00"
+        + src_ip.to_bytes(4, "big")
+        + dst_ip.to_bytes(4, "big")
+    )
+    return eth + ip + icmp
 
 
 def craft_vxlan(outer_src: int, outer_dst: int, vni: int, inner: bytes) -> bytes:

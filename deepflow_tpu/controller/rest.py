@@ -74,6 +74,14 @@ def _sample_cpu(seconds: float, hz: float = 99.0) -> dict[str, int]:
     return folded
 
 
+def _q_time_range(q) -> tuple[int, int] | None:
+    """start/end unix-second query params → store time_range (Grafana
+    sends these on trace lookups and tracemap queries)."""
+    if q.get("start") or q.get("end"):
+        return (int(q.get("start") or 0), int(q.get("end") or (1 << 31)))
+    return None
+
+
 class RestServer:
     def __init__(self, server, *, host: str = "127.0.0.1", port: int = 0):
         self._df = server
@@ -205,14 +213,14 @@ class RestServer:
             # Tempo datasource shape (Grafana points here)
             from ..tracing.query import tempo_trace
 
-            out = tempo_trace(df.store, parts[2], org=int(q.get("org") or 1))
+            out = tempo_trace(
+                df.store, parts[2], org=int(q.get("org") or 1),
+                time_range=_q_time_range(q),
+            )
             h._json(out if out is not None else {"error": "trace not found"},
                     200 if out is not None else 404)
         elif u.path == "/v1/tracemap":
-            tr = None
-            if q.get("start") or q.get("end"):
-                tr = (int(q.get("start") or 0), int(q.get("end") or (1 << 31)))
-            h._json(df.trace_map(time_range=tr, org=int(q.get("org") or 1)))
+            h._json(df.trace_map(time_range=_q_time_range(q), org=int(q.get("org") or 1)))
         elif u.path == "/v1/profile/stacks":
             h._json(_thread_stacks())
         elif u.path == "/v1/profile/cpu":

@@ -31,8 +31,8 @@ def _spans_from_l7(store: ColumnarStore, db: str, trace_id: str,
             time_range=time_range,
             columns=[
                 "time", "trace_id", "span_id", "parent_span_id",
-                "app_service", "tap_side", "start_time", "end_time",
-                "response_duration", "status",
+                "app_service", "tap_side", "endpoint", "start_time",
+                "end_time", "response_duration", "status",
             ],
         )
     except KeyError:
@@ -49,6 +49,7 @@ def _spans_from_l7(store: ColumnarStore, db: str, trace_id: str,
                 parent_span_id=str(cols["parent_span_id"][i]),
                 app_service=str(cols["app_service"][i]),
                 tap_side=int(cols["tap_side"][i]),
+                endpoint=str(cols["endpoint"][i]),
                 start_us=int(cols["start_time"][i]) * 1_000_000,
                 end_us=int(cols["end_time"][i]) * 1_000_000,
                 response_duration_us=int(cols["response_duration"][i]),
@@ -120,8 +121,11 @@ def tempo_trace(
                                 "traceId": trace_id,
                                 "spanId": s.span_id,
                                 "parentSpanId": s.parent_span_id,
-                                "name": service,
-                                "kind": 2,
+                                "name": s.endpoint or service,
+                                # OTLP: 2=SERVER, 3=CLIENT — derived
+                                # from which side of the call the tap
+                                # observed (TapSide.CLIENT bit)
+                                "kind": 3 if (s.tap_side & 1) else 2,
                                 "startTimeUnixNano": str(s.start_us * 1000),
                                 "endTimeUnixNano": str(
                                     (s.start_us + s.response_duration_us) * 1000

@@ -49,6 +49,7 @@ class SpanRow:
     app_service: str
     auto_service_id: int = 0
     tap_side: int = 0
+    endpoint: str = ""
     start_us: int = 0
     end_us: int = 0
     response_duration_us: int = 0
