@@ -38,6 +38,7 @@ from ..ingest.sender import UniformSender
 from ..utils.stats import StatsCollector
 from .bridge import emissions_to_flow_batch
 from .flow_map import FlowMap, FlowTimeouts
+from .policy import ACTION_DROP, ACTION_PCAP, PolicyLabeler, pcap_frames
 from .l7.engine import L7Engine
 from .packet import CaptureFilter, parse_packets
 
@@ -54,6 +55,17 @@ class AgentConfig:
     metrics_window: WindowConfig = WindowConfig(capacity=1 << 14)
     # dispatcher BPF seat: evaluated as one vectorized mask per batch
     capture_filter: CaptureFilter | None = None
+    # policy plane (labeler.rs seat): ACLs in priority order; DROP
+    # removes packets pre-FlowMap, PCAP ships RAW_PCAP frames
+    acls: tuple = ()
+
+
+def _compact(buf: np.ndarray, p, retain: np.ndarray):
+    """Drop rows from the capture batch (capture-filter / policy-drop
+    compaction): slice the snap buffer and every PacketBatch field."""
+    return buf[retain], dataclasses.replace(
+        p, **{f.name: getattr(p, f.name)[retain] for f in dataclasses.fields(p)}
+    )
 
 
 class Agent:
@@ -89,10 +101,12 @@ class Agent:
                     MessageType.TAGGEDFLOW,
                     MessageType.PROTOCOLLOG,
                 )
+                + ((MessageType.RAW_PCAP,) if c.acls else ())
             }
+        self.policy = PolicyLabeler(list(c.acls)) if c.acls else None
         self.counters = {
             "batches": 0, "packets": 0, "docs_sent": 0, "logs_sent": 0,
-            "packets_filtered": 0,
+            "packets_filtered": 0, "packets_dropped_policy": 0, "pcap_sent": 0,
         }
 
     # -- pipeline step ---------------------------------------------------
@@ -107,15 +121,18 @@ class Agent:
                 # invalid_packets counter must keep meaning "capture
                 # garbage", not operator policy
                 self.counters["packets_filtered"] += int(filtered.sum())
-                retain = ~filtered
-                buf = buf[retain]
-                p = dataclasses.replace(
-                    p,
-                    **{
-                        f.name: getattr(p, f.name)[retain]
-                        for f in dataclasses.fields(p)
-                    },
-                )
+                buf, p = _compact(buf, p, ~filtered)
+        if self.policy is not None:
+            acl_id, action = self.policy.match(p)
+            pcap_idx = np.nonzero(action == ACTION_PCAP)[0]
+            if pcap_idx.size:
+                frames = pcap_frames(buf, p, pcap_idx, acl_id)
+                self._send(MessageType.RAW_PCAP, frames)
+                self.counters["pcap_sent"] += len(frames)
+            dropped = action == ACTION_DROP
+            if dropped.any():
+                self.counters["packets_dropped_policy"] += int(dropped.sum())
+                buf, p = _compact(buf, p, ~dropped)
         self.counters["batches"] += 1
         self.counters["packets"] += int(p.valid.sum())
         self.flow_map.inject(p)

@@ -1,0 +1,160 @@
+"""ACL policy labeler — the agent's policy plane, vectorized.
+
+The reference's policy module (agent/src/policy/labeler.rs endpoint
+resolution; first_path/fast_path.rs ACL matching) classifies every
+packet against operator ACLs and attaches actions: NPB forwarding,
+policy-triggered PCAP, drop. Its two-tier first-path/fast-path cache
+exists because scalar per-packet matching is expensive on a CPU; here
+the whole batch matches against the whole ACL table in one broadcast
+pass ([A, N] masks), which IS the fast path on this architecture —
+no per-flow cache to invalidate (documented deviation).
+
+Actions follow the reference's semantics:
+  * DROP    — packet removed before FlowMap/L7 (policy drop).
+  * PCAP    — packet captured into the pcap plane (RAW_PCAP frames →
+              pcap ingester, server/ingester/pcap).
+  * NPB     — counted and labeled; there is no packet-broker fabric in
+              this environment, so NPB marks flows for export only.
+ACL order is priority order: the first matching ACL wins
+(first_path.rs first-hit semantics).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+
+import numpy as np
+
+from .packet import PacketBatch
+
+ACTION_NONE = 0
+ACTION_NPB = 1
+ACTION_PCAP = 2
+ACTION_DROP = 3
+
+
+def parse_cidr(cidr: str) -> tuple[int, int]:
+    """'10.0.0.0/8' → (u32 net, prefix_len). '0.0.0.0/0' matches any."""
+    ip, _, plen = cidr.partition("/")
+    parts = [int(x) for x in ip.split(".")]
+    net = (parts[0] << 24) | (parts[1] << 16) | (parts[2] << 8) | parts[3]
+    return net, int(plen or 32)
+
+
+@dataclasses.dataclass(frozen=True)
+class Acl:
+    """One ACL entry (reference: trisolaris-pushed FlowAcl). IPv4 CIDRs;
+    prefix 0 means any address (and also matches IPv6 packets — "any"
+    is address-family agnostic, everything narrower is v4-only)."""
+
+    id: int
+    action: int = ACTION_NONE
+    src: str = "0.0.0.0/0"
+    dst: str = "0.0.0.0/0"
+    src_ports: tuple | None = None  # (lo, hi) inclusive
+    dst_ports: tuple | None = None
+    protocol: int = 0  # 0 = any IP protocol
+    symmetric: bool = True  # match the reverse direction too
+
+
+class PolicyLabeler:
+    def __init__(self, acls: list[Acl]):
+        self.acls = list(acls)
+        n = len(self.acls)
+        self._ids = np.asarray([a.id for a in self.acls], np.uint32)
+        self._actions = np.asarray([a.action for a in self.acls], np.uint32)
+        self._proto = np.asarray([a.protocol for a in self.acls], np.uint32)
+        self._sym = np.asarray([a.symmetric for a in self.acls], bool)
+
+        def nets(field):
+            net = np.zeros(n, np.uint32)
+            mask = np.zeros(n, np.uint32)
+            for i, a in enumerate(self.acls):
+                v, plen = parse_cidr(getattr(a, field))
+                net[i] = v
+                mask[i] = ((0xFFFFFFFF << (32 - plen)) & 0xFFFFFFFF) if plen else 0
+            return net & mask, mask
+
+        self._src_net, self._src_mask = nets("src")
+        self._dst_net, self._dst_mask = nets("dst")
+
+        def ports(field):
+            lo = np.zeros(n, np.uint32)
+            hi = np.full(n, 65535, np.uint32)
+            for i, a in enumerate(self.acls):
+                r = getattr(a, field)
+                if r is not None:
+                    lo[i], hi[i] = r
+            return lo, hi
+
+        self._sp_lo, self._sp_hi = ports("src_ports")
+        self._dp_lo, self._dp_hi = ports("dst_ports")
+        self.counters = {"matched": 0, "dropped": 0, "pcap": 0, "npb": 0}
+
+    def match(self, p: PacketBatch) -> tuple[np.ndarray, np.ndarray]:
+        """→ (acl_id[N] u32, action[N] u32); 0/NONE where nothing hits.
+        One broadcast pass: [A, 1] ACL columns against [N] packet rows.
+        """
+        if not self.acls:
+            z = np.zeros(p.size, np.uint32)
+            return z, z
+        ip_s = p.ip_src[:, 3].astype(np.uint32)[None, :]  # [1, N]
+        ip_d = p.ip_dst[:, 3].astype(np.uint32)[None, :]
+        v4 = (p.is_ipv6 == 0)[None, :]
+        sp = p.port_src[None, :]
+        dp = p.port_dst[None, :]
+
+        src_net = self._src_net[:, None]
+        src_mask = self._src_mask[:, None]
+        dst_net = self._dst_net[:, None]
+        dst_mask = self._dst_mask[:, None]
+
+        def side(ip, net, mask):
+            # mask 0 ("any") also admits IPv6; narrower CIDRs are v4-only
+            return ((ip & mask) == net) & (v4 | (mask == 0))
+
+        proto_ok = (self._proto[:, None] == 0) | (
+            self._proto[:, None] == p.protocol[None, :]
+        )
+        fwd = (
+            side(ip_s, src_net, src_mask)
+            & side(ip_d, dst_net, dst_mask)
+            & (sp >= self._sp_lo[:, None]) & (sp <= self._sp_hi[:, None])
+            & (dp >= self._dp_lo[:, None]) & (dp <= self._dp_hi[:, None])
+        )
+        rev = (
+            side(ip_d, src_net, src_mask)
+            & side(ip_s, dst_net, dst_mask)
+            & (dp >= self._sp_lo[:, None]) & (dp <= self._sp_hi[:, None])
+            & (sp >= self._dp_lo[:, None]) & (sp <= self._dp_hi[:, None])
+        )
+        hits = proto_ok & (fwd | (rev & self._sym[:, None]))  # [A, N]
+        hits &= p.valid[None, :]
+
+        any_hit = hits.any(axis=0)
+        first = np.argmax(hits, axis=0)  # lowest ACL index = priority
+        acl_id = np.where(any_hit, self._ids[first], 0).astype(np.uint32)
+        action = np.where(any_hit, self._actions[first], 0).astype(np.uint32)
+
+        self.counters["matched"] += int(any_hit.sum())
+        self.counters["dropped"] += int((action == ACTION_DROP).sum())
+        self.counters["pcap"] += int((action == ACTION_PCAP).sum())
+        self.counters["npb"] += int((action == ACTION_NPB).sum())
+        return acl_id, action
+
+
+def pcap_frames(buf: np.ndarray, p: PacketBatch, idx: np.ndarray,
+                acl_id: np.ndarray) -> list[bytes]:
+    """Policy-PCAP packets → the pcap plane's binary frame layout
+    ([flow_id u64 BE][ts_us u64 BE][len u32 BE][bytes] — must match
+    server/events.py _pcap's `>QQI`). flow_id carries the ACL id so the
+    pcap table records which policy fired."""
+    out = []
+    for i in idx:
+        i = int(i)
+        ln = min(int(p.packet_len[i]), buf.shape[1])
+        ts = int(p.timestamp_s[i]) * 1_000_000 + int(p.timestamp_us[i])
+        pkt = buf[i, :ln].tobytes()
+        out.append(struct.pack(">QQI", int(acl_id[i]), ts, len(pkt)) + pkt)
+    return out

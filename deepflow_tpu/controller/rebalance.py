@@ -1,0 +1,123 @@
+"""Analyzer assignment & rebalance.
+
+The reference's controller/monitor/analyzer.go watches ingester
+(analyzer) liveness and redistributes agents when one dies or load
+skews (vtap counts weighted by analyzer capacity); assignments ride to
+agents in the trisolaris sync response. Same model: analyzers register
+with a capacity weight and heartbeat; `assign()` gives an agent the
+least-loaded live analyzer and is sticky; `rebalance()` drains dead
+analyzers and narrows the load spread to within one agent of the
+weighted ideal.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class AnalyzerBalancer:
+    def __init__(self, *, dead_after_s: float = 60.0):
+        self.dead_after_s = dead_after_s
+        self._analyzers: dict[str, dict] = {}  # ip → {capacity, last_seen}
+        self._assign: dict[int, str] = {}  # agent_id → analyzer ip
+        self._lock = threading.Lock()
+        self.counters = {"assigns": 0, "moves": 0, "drains": 0}
+
+    # -- analyzer registry ---------------------------------------------
+    def register(self, ip: str, *, capacity: int = 1) -> None:
+        with self._lock:
+            self._analyzers[ip] = {"capacity": max(1, capacity), "last_seen": time.time()}
+
+    def heartbeat(self, ip: str, now: float | None = None) -> None:
+        now = time.time() if now is None else now
+        with self._lock:
+            if ip in self._analyzers:
+                self._analyzers[ip]["last_seen"] = now
+
+    def _alive(self, now: float) -> list[str]:
+        return [
+            ip
+            for ip, a in self._analyzers.items()
+            if now - a["last_seen"] <= self.dead_after_s
+        ]
+
+    def _loads(self) -> dict[str, int]:
+        loads = {ip: 0 for ip in self._analyzers}
+        for ip in self._assign.values():
+            if ip in loads:
+                loads[ip] += 1
+        return loads
+
+    # -- assignment -----------------------------------------------------
+    def assign(self, agent_id: int, now: float | None = None) -> str | None:
+        """Sticky least-normalized-load placement; None when no live
+        analyzer exists (agents then keep their last assignment —
+        escape semantics live agent-side)."""
+        now = time.time() if now is None else now
+        with self._lock:
+            alive = set(self._alive(now))
+            cur = self._assign.get(agent_id)
+            if cur in alive:
+                return cur
+            if not alive:
+                return None
+            loads = self._loads()
+            ip = min(
+                alive,
+                key=lambda i: (loads[i] / self._analyzers[i]["capacity"], i),
+            )
+            self._assign[agent_id] = ip
+            self.counters["assigns"] += 1
+            return ip
+
+    def rebalance(self, now: float | None = None) -> int:
+        """Drain dead analyzers, then move agents from over- to
+        under-loaded ones until every analyzer is within one agent of
+        its weighted share. Returns number of moves."""
+        now = time.time() if now is None else now
+        moves = 0
+        with self._lock:
+            alive = self._alive(now)
+            if not alive:
+                return 0
+            alive_set = set(alive)
+            # 1. drain: agents on dead analyzers
+            orphans = [a for a, ip in self._assign.items() if ip not in alive_set]
+            for a in orphans:
+                del self._assign[a]
+            self.counters["drains"] += len(orphans)
+
+            total_cap = sum(self._analyzers[ip]["capacity"] for ip in alive)
+
+            def ideal(ip: str, n_agents: int) -> float:
+                return n_agents * self._analyzers[ip]["capacity"] / total_cap
+
+            # re-place orphans least-loaded-first
+            for a in sorted(orphans):
+                loads = self._loads()
+                ip = min(
+                    alive, key=lambda i: (loads[i] / self._analyzers[i]["capacity"], i)
+                )
+                self._assign[a] = ip
+                moves += 1
+
+            # 2. narrow the spread
+            n = len(self._assign)
+            for _ in range(n):
+                loads = self._loads()
+                over = max(alive, key=lambda i: loads[i] - ideal(i, n))
+                under = min(alive, key=lambda i: loads[i] - ideal(i, n))
+                if loads[over] - ideal(over, n) <= 1.0:
+                    break
+                movable = [a for a, ip in self._assign.items() if ip == over]
+                if not movable:
+                    break
+                self._assign[min(movable)] = under
+                moves += 1
+            self.counters["moves"] += moves
+        return moves
+
+    def assignments(self) -> dict[int, str]:
+        with self._lock:
+            return dict(self._assign)

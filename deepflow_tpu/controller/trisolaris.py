@@ -40,8 +40,14 @@ class AgentGroupConfig:
 
 
 class TrisolarisService:
-    def __init__(self, db: ResourceDB, *, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, db: ResourceDB, *, host: str = "127.0.0.1", port: int = 0,
+                 genesis=None, balancer=None):
         self.db = db
+        # optional plane hookups: genesis store (agents report local
+        # interfaces through sync) and analyzer balancer (sync response
+        # carries the agent's assigned ingester)
+        self.genesis = genesis
+        self.balancer = balancer
         self._groups: dict[str, AgentGroupConfig] = {"default": AgentGroupConfig()}
         self._agent_group: dict[int, str] = {}
         self.agents: dict[int, dict] = {}  # liveness registry
@@ -120,6 +126,12 @@ class TrisolarisService:
         if req.get("platform_version", 0) != self.db.version:
             resp["platform"] = self._platform_snapshot()
             self.counters["platform_pushes"] += 1
+        if self.genesis is not None and "genesis" in req:
+            self.genesis.report(agent_id, req["genesis"])
+        if self.balancer is not None:
+            ip = self.balancer.assign(agent_id)
+            if ip is not None:
+                resp["analyzer_ip"] = ip
         return resp
 
     def _handle_upgrade(self, req: dict) -> dict:

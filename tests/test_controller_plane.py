@@ -1,0 +1,198 @@
+"""Controller resource plane: cloud discovery → recorder reconcile →
+ResourceDB, genesis agent reports, analyzer rebalance — behavioral
+peers of server/controller/{cloud,genesis,recorder,monitor}."""
+
+import time
+
+from deepflow_tpu.controller.cloud import CloudTask, FileReaderPlatform, KubernetesGather
+from deepflow_tpu.controller.genesis import GenesisStore
+from deepflow_tpu.controller.rebalance import AnalyzerBalancer
+from deepflow_tpu.controller.recorder import Recorder
+from deepflow_tpu.controller.resources import ResourceDB
+from deepflow_tpu.controller.trisolaris import TrisolarisService
+
+
+def _k8s_objects(pods=2):
+    return {
+        "nodes": [
+            {
+                "metadata": {"name": "node-1"},
+                "status": {"addresses": [{"type": "InternalIP", "address": "10.1.0.1"}]},
+            }
+        ],
+        "namespaces": [{"metadata": {"name": "prod"}}],
+        "pods": [
+            {
+                "metadata": {
+                    "name": f"web-{i}",
+                    "namespace": "prod",
+                    "ownerReferences": [{"kind": "ReplicaSet", "name": "web"}],
+                },
+                "spec": {"nodeName": "node-1"},
+                "status": {"podIP": f"10.2.0.{i + 1}"},
+            }
+            for i in range(pods)
+        ],
+        "services": [
+            {
+                "metadata": {"name": "web-svc", "namespace": "prod"},
+                "spec": {"clusterIP": "10.3.0.1"},
+            }
+        ],
+    }
+
+
+def test_recorder_create_update_delete_cycle():
+    db = ResourceDB()
+    events = []
+    rec = Recorder(db, event_sink=events.append)
+
+    snap = {
+        "resources": {
+            "pod": [
+                {"uid": "p/a", "name": "a"},
+                {"uid": "p/b", "name": "b"},
+            ]
+        }
+    }
+    cs = rec.reconcile("dom", snap)
+    assert len(cs.created) == 2 and not cs.updated and not cs.deleted
+    ida = rec.id_of("dom", "pod", "p/a")
+    assert db.get("pod", ida).name == "a"
+
+    # idempotent: same snapshot → no changes, no version churn
+    v = db.version
+    cs = rec.reconcile("dom", snap)
+    assert cs.total == 0 and db.version == v
+
+    # rename + drop: ids stay stable across updates
+    snap2 = {"resources": {"pod": [{"uid": "p/a", "name": "a2"}]}}
+    cs = rec.reconcile("dom", snap2)
+    assert cs.updated == [("pod", "p/a")] and cs.deleted == [("pod", "p/b")]
+    assert rec.id_of("dom", "pod", "p/a") == ida
+    assert db.get("pod", ida).name == "a2"
+    assert [e["type"] for e in events].count("create-pod") == 2
+    assert [e["type"] for e in events].count("delete-pod") == 1
+
+
+def test_recorder_domains_are_isolated():
+    db = ResourceDB()
+    rec = Recorder(db)
+    rec.reconcile("a", {"resources": {"host": [{"uid": "h1", "name": "h1"}]}})
+    rec.reconcile("b", {"resources": {"host": [{"uid": "h1", "name": "h1b"}]}})
+    # same uid in two domains → two distinct resources
+    assert len(db.list("host")) == 2
+    # emptying domain a leaves b untouched
+    rec.reconcile("a", {"resources": {}})
+    names = [r.name for r in db.list("host")]
+    assert names == ["h1b"]
+
+
+def test_k8s_gather_to_db_e2e():
+    db = ResourceDB()
+    rec = Recorder(db)
+    gather = KubernetesGather(_k8s_objects(pods=2), cluster_name="c1", epc_id=7)
+    task = CloudTask(gather, rec)
+    task.poll()
+
+    assert [r.name for r in db.list("pod_cluster")] == ["c1"]
+    assert [r.name for r in db.list("pod_node")] == ["node-1"]
+    assert [r.name for r in db.list("pod_ns")] == ["prod"]
+    assert [r.name for r in db.list("pod_group")] == ["web"]
+    assert sorted(r.name for r in db.list("pod")) == ["web-0", "web-1"]
+    assert [r.name for r in db.list("pod_service")] == ["web-svc"]
+
+    # second poll resolves pod vinterface pod_id markers to real ids
+    task.poll()
+    vifs = db._vifs
+    pod_ids = {rec.id_of("k8s", "pod", f"k8s/c1/pod/prod/web-{i}") for i in range(2)}
+    assert {v["pod_id"] for v in vifs} == pod_ids
+
+    # scale down to 1 pod: resource + vif disappear
+    gather.update(_k8s_objects(pods=1))
+    cs = task.poll()
+    assert ("pod", "k8s/c1/pod/prod/web-1") in cs.deleted
+    assert len([r for r in db.list("pod")]) == 1
+
+
+def test_genesis_lease_and_snapshot():
+    g = GenesisStore(lease_s=100.0, epc_id=3)
+    t0 = 1000.0
+    g.report(1, {"hostname": "hostA", "interfaces": [
+        {"mac": 0xAA, "ips": ["192.168.0.5"], "name": "eth0"}]}, now=t0)
+    g.report(2, {"hostname": "hostB", "interfaces": [
+        {"mac": 0xBB, "ips": ["192.168.0.6"], "name": "eth0"}]}, now=t0)
+
+    snap = g.snapshot(now=t0 + 10)
+    assert [h["name"] for h in snap["resources"]["host"]] == ["hostA", "hostB"]
+    assert len(snap["vinterfaces"]) == 2
+    assert snap["vinterfaces"][0]["epc_id"] == 3
+
+    # agent 1 refreshes; agent 2's lease expires
+    g.report(1, {"hostname": "hostA", "interfaces": []}, now=t0 + 90)
+    snap = g.snapshot(now=t0 + 150)
+    assert [h["name"] for h in snap["resources"]["host"]] == ["hostA"]
+    assert g.counters["expired"] == 1
+
+    # genesis feeds the recorder like any cloud source
+    db = ResourceDB()
+    rec = Recorder(db)
+    rec.reconcile(g.domain, snap)
+    assert [r.name for r in db.list("host")] == ["hostA"]
+
+
+def test_balancer_sticky_and_least_loaded():
+    b = AnalyzerBalancer(dead_after_s=60)
+    t0 = time.time()
+    b.register("10.0.0.1", capacity=1)
+    b.register("10.0.0.2", capacity=1)
+    ips = [b.assign(a, now=t0) for a in range(4)]
+    assert sorted(ips.count(ip) for ip in {"10.0.0.1", "10.0.0.2"}) == [2, 2]
+    # sticky
+    assert b.assign(0, now=t0) == ips[0]
+
+
+def test_balancer_drains_dead_analyzer():
+    b = AnalyzerBalancer(dead_after_s=60)
+    t0 = 1_000_000.0
+    b.register("10.0.0.1")
+    b.register("10.0.0.2")
+    b.heartbeat("10.0.0.1", now=t0)
+    b.heartbeat("10.0.0.2", now=t0)
+    for a in range(6):
+        b.assign(a, now=t0)
+    # analyzer 2 dies; rebalance moves its agents to 1
+    b.heartbeat("10.0.0.1", now=t0 + 100)
+    moves = b.rebalance(now=t0 + 100)
+    assert moves >= 1
+    assert set(b.assignments().values()) == {"10.0.0.1"}
+    # it recovers with double capacity → spread narrows toward 2:4
+    b.register("10.0.0.2", capacity=2)
+    b.heartbeat("10.0.0.2", now=t0 + 100)
+    b.rebalance(now=t0 + 100)
+    loads = list(b.assignments().values())
+    assert loads.count("10.0.0.2") >= 3  # weighted ideal = 4 of 6
+
+
+def test_trisolaris_carries_genesis_and_analyzer():
+    db = ResourceDB()
+    g = GenesisStore()
+    b = AnalyzerBalancer()
+    b.register("10.9.9.9")
+    svc = TrisolarisService(db, genesis=g, balancer=b)
+    try:
+        resp = svc.handle_sync(
+            {
+                "agent_id": 5,
+                "config_rev": 0,
+                "platform_version": 0,
+                "genesis": {"hostname": "n1", "interfaces": [
+                    {"mac": 1, "ips": ["172.16.0.9"]}]},
+            }
+        )
+        assert resp["analyzer_ip"] == "10.9.9.9"
+        snap = g.snapshot()
+        assert snap["resources"]["host"][0]["name"] == "n1"
+        assert snap["vinterfaces"][0]["ips"] == ["172.16.0.9"]
+    finally:
+        svc.stop()

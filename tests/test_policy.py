@@ -1,0 +1,169 @@
+"""Agent ACL policy labeler: vectorized first-hit matching, drop/pcap
+actions through Agent.step — behavioral peer of policy/labeler.rs +
+first_path/fast_path ACL semantics."""
+
+import struct
+
+import numpy as np
+
+from deepflow_tpu.agent.main import Agent, AgentConfig
+from deepflow_tpu.agent.packet import (
+    PROTO_TCP,
+    PROTO_UDP,
+    craft_tcp,
+    craft_udp,
+    parse_packets,
+    to_batch,
+)
+from deepflow_tpu.agent.policy import (
+    ACTION_DROP,
+    ACTION_NPB,
+    ACTION_PCAP,
+    Acl,
+    PolicyLabeler,
+    parse_cidr,
+    pcap_frames,
+)
+from deepflow_tpu.ingest.framing import MessageType
+
+A1, B1 = 0x0A000001, 0x0A000002  # 10.0.0.1/2
+C1 = 0xC0A80005  # 192.168.0.5
+
+
+def _batch(specs):
+    """specs: (src, dst, sport, dport, proto)"""
+    pkts = [
+        craft_tcp(s, d, sp, dp, payload=b"x") if pr == PROTO_TCP
+        else craft_udp(s, d, sp, dp, b"x")
+        for s, d, sp, dp, pr in specs
+    ]
+    buf, lengths, ts_s, ts_us = to_batch(pkts, [100] * len(pkts), [0] * len(pkts), snap=256)
+    return buf, parse_packets(buf, lengths, ts_s, ts_us)
+
+
+def test_parse_cidr():
+    assert parse_cidr("10.0.0.0/8") == (0x0A000000, 8)
+    assert parse_cidr("0.0.0.0/0") == (0, 0)
+    assert parse_cidr("192.168.0.5") == (0xC0A80005, 32)
+
+
+def test_first_hit_priority_and_cidr():
+    lab = PolicyLabeler(
+        [
+            Acl(id=10, action=ACTION_DROP, src="10.0.0.0/24", dst_ports=(22, 22)),
+            Acl(id=20, action=ACTION_NPB, src="10.0.0.0/8"),
+        ]
+    )
+    _, p = _batch(
+        [
+            (A1, B1, 40000, 22, PROTO_TCP),   # hits both → first (10) wins
+            (A1, B1, 40000, 80, PROTO_TCP),   # only 20
+            (C1, 0xC0A80006, 40000, 22, PROTO_TCP),  # both sides off-net
+        ]
+    )
+    acl_id, action = lab.match(p)
+    assert list(acl_id) == [10, 20, 0]
+    assert list(action) == [ACTION_DROP, ACTION_NPB, 0]
+    assert lab.counters["matched"] == 2
+
+
+def test_symmetric_matches_reverse_direction():
+    lab = PolicyLabeler([Acl(id=5, action=ACTION_PCAP, dst="10.0.0.2/32", dst_ports=(53, 53), protocol=PROTO_UDP)])
+    _, p = _batch(
+        [
+            (A1, B1, 5555, 53, PROTO_UDP),  # forward
+            (B1, A1, 53, 5555, PROTO_UDP),  # reverse (response)
+            (A1, B1, 5555, 53, PROTO_TCP),  # wrong protocol
+        ]
+    )
+    acl_id, _ = lab.match(p)
+    assert list(acl_id) == [5, 5, 0]
+
+    asym = PolicyLabeler([Acl(id=5, dst="10.0.0.2/32", dst_ports=(53, 53), symmetric=False)])
+    acl_id, _ = asym.match(p)
+    assert list(acl_id) == [5, 0, 5]
+
+
+def test_any_cidr_matches_ipv6_but_narrow_does_not():
+    lab = PolicyLabeler([Acl(id=1, action=ACTION_NPB)])
+    _, p = _batch([(A1, B1, 1, 2, PROTO_TCP)])
+    # force the row v6: "any" still matches
+    p6 = p
+    p6.is_ipv6[:] = 1
+    acl_id, _ = lab.match(p6)
+    assert list(acl_id) == [1]
+    narrow = PolicyLabeler([Acl(id=1, src="10.0.0.0/8", action=ACTION_NPB)])
+    acl_id, _ = narrow.match(p6)
+    assert list(acl_id) == [0]
+
+
+class _Capture:
+    def __init__(self):
+        self.msgs = []
+
+    def send(self, msgs):
+        self.msgs.extend(msgs)
+
+
+def test_agent_policy_drop_and_pcap():
+    pcap_sink = _Capture()
+    agent = Agent(
+        AgentConfig(
+            acls=(
+                Acl(id=7, action=ACTION_PCAP, dst_ports=(8080, 8080)),
+                Acl(id=9, action=ACTION_DROP, dst_ports=(22, 22)),
+            )
+        ),
+        senders={MessageType.RAW_PCAP: pcap_sink},
+    )
+    pkts = [
+        craft_tcp(A1, B1, 40000, 8080, payload=b"GET / HTTP/1.1\r\n\r\n"),
+        craft_tcp(A1, B1, 40001, 22, payload=b"SSH-2.0\r\n"),
+        craft_tcp(A1, B1, 40002, 9999, payload=b"zz"),
+    ]
+    buf, lengths, ts_s, ts_us = to_batch(pkts, [100, 100, 100], [0, 0, 0], snap=256)
+    agent.step(buf, lengths, ts_s, ts_us)
+
+    assert agent.counters["packets_dropped_policy"] == 1
+    assert agent.counters["pcap_sent"] == 1
+    assert agent.counters["packets"] == 2  # post-drop
+    # pcap frame decodes back: [acl_id u64][ts_us u64][len u32][bytes]
+    flow_id, ts, ln = struct.unpack(">QQI", pcap_sink.msgs[0][:20])
+    assert flow_id == 7 and ln > 0
+    pkt = pcap_sink.msgs[0][20 : 20 + ln]
+    assert pkt[:6] == b"\x02\x00\x00\x00\x00\x01"  # the crafted eth frame
+    agent.close()
+
+
+def test_pcap_frames_roundtrip_through_real_ingester():
+    """The frames pcap_frames emits decode through the ACTUAL
+    server-side pcap decoder (server/events.py _pcap) into pcap-table
+    rows — not just a re-unpack with the same format string."""
+    from deepflow_tpu.ingest.framing import FlowHeader
+    from deepflow_tpu.server.events import EventIngester
+    from deepflow_tpu.storage.store import ColumnarStore
+
+    class _StubReceiver:
+        def register_handler(self, mt, queues):
+            pass
+
+    buf, lengths, ts_s, ts_us = to_batch(
+        [craft_tcp(A1, B1, 1234, 8080, payload=b"y")], [100], [7], snap=128
+    )
+    pb = parse_packets(buf, lengths, ts_s, ts_us)
+    frames = pcap_frames(buf, pb, np.asarray([0]), np.asarray([42], np.uint32))
+
+    store = ColumnarStore()
+    ing = EventIngester(_StubReceiver(), store, writer_args={"flush_interval_s": 0.05})
+    hdr = FlowHeader(
+        msg_type=int(MessageType.RAW_PCAP), agent_id=5, organization_id=1, team_id=1
+    )
+    ing._pcap(1, hdr, frames[0])
+    ing.flush()
+    cols = store.scan("pcap", "pcap", columns=["flow_id_lo", "ts_us", "packet_len", "packet"])
+    assert list(cols["flow_id_lo"]) == [42]
+    assert int(cols["ts_us"][0]) == 100 * 1_000_000 + 7
+    pkt = bytes.fromhex(str(cols["packet"][0]))
+    assert int(cols["packet_len"][0]) == len(pkt)
+    assert pkt[:6] == b"\x02\x00\x00\x00\x00\x01"
+    ing.stop()
