@@ -114,7 +114,7 @@ def parse_http(payload: bytes) -> L7Message | None:
 _QTYPES = {1: "A", 2: "NS", 5: "CNAME", 6: "SOA", 12: "PTR", 15: "MX", 16: "TXT", 28: "AAAA", 33: "SRV"}
 
 
-def check_dns(payload: bytes, port: int = 0) -> bool:
+def _dns_check_raw(payload: bytes, port: int) -> bool:
     if len(payload) < 12:
         return False
     qd = int.from_bytes(payload[4:6], "big")
@@ -122,8 +122,25 @@ def check_dns(payload: bytes, port: int = 0) -> bool:
     return (port == 53 or 1 <= qd <= 4) and opcode_ok and qd >= 1
 
 
+def _dns_tcp_strip(payload: bytes, port: int = 0) -> bytes:
+    """DNS over TCP prefixes the message with a u16 length (RFC 1035
+    §4.2.2; dns.rs handles both transports). Only strip when the raw
+    bytes do NOT already form a plausible DNS message — a UDP query
+    whose txid happens to equal len-2 must not lose its first bytes."""
+    if _dns_check_raw(payload, port):
+        return payload
+    if len(payload) >= 14 and int.from_bytes(payload[:2], "big") == len(payload) - 2:
+        return payload[2:]
+    return payload
+
+
+def check_dns(payload: bytes, port: int = 0) -> bool:
+    return _dns_check_raw(_dns_tcp_strip(payload, port), port)
+
+
 def parse_dns(payload: bytes) -> L7Message | None:
     try:
+        payload = _dns_tcp_strip(payload)
         if len(payload) < 12:
             return None
         txid = int.from_bytes(payload[0:2], "big")
@@ -232,11 +249,26 @@ def obfuscate_sql(stmt: str) -> str:
     return _SQL_NUM.sub("?", stmt)
 
 
+def _mysql_greeting(payload: bytes) -> bool:
+    """Server handshake v10: [len u24][seq=0][0x0a]["x.y.z\\0"…] — the
+    signature mysql.rs uses to classify off-port flows (the server
+    greets first, so this is the first payload the probe sees)."""
+    if len(payload) < 7 or payload[3] != 0 or payload[4] != 0x0A:
+        return False
+    nul = payload.find(b"\x00", 5, 5 + 24)
+    if nul < 0:
+        return False
+    ver = payload[5:nul]
+    return bool(ver) and all(0x20 < b < 0x7F for b in ver) and ver[0:1].isdigit()
+
+
 def check_mysql(payload: bytes, port: int = 0) -> bool:
     if len(payload) < 5:
         return False
     ln = int.from_bytes(payload[0:3], "little")
-    return port == 3306 and 0 < ln <= len(payload) - 4
+    if not 0 < ln <= len(payload) - 4:
+        return False
+    return port == 3306 or _mysql_greeting(payload)
 
 
 def parse_mysql(payload: bytes) -> L7Message | None:

@@ -109,7 +109,10 @@ class KubernetesGather:
                  "cluster": cluster_uid}
             )
 
-        # pod groups come from ownerReferences (Deployment/StatefulSet…)
+        # pod groups come from ownerReferences; Deployment-managed pods
+        # reference the ReplicaSet (name = "<deployment>-<template-hash>"),
+        # so trim the hash to keep group identity stable across rollouts
+        # (kubernetes_gather's RS→Deployment resolution)
         groups: dict[str, dict] = {}
         for pod in o.get("pods", []):
             md = pod["metadata"]
@@ -117,6 +120,10 @@ class KubernetesGather:
             owner = ""
             for ref in md.get("ownerReferences", []):
                 owner = ref.get("name", "")
+                if ref.get("kind") == "ReplicaSet" and "-" in owner:
+                    stem, _, tail = owner.rpartition("-")
+                    if 5 <= len(tail) <= 10 and tail.isalnum():
+                        owner = stem
             if owner:
                 guid = f"{cluster_uid}/group/{ns}/{owner}"
                 groups.setdefault(
@@ -166,7 +173,7 @@ class CloudTask:
         self.source = source
         self.recorder = recorder
         self.interval_s = interval_s
-        self._running = False
+        self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.last_change = None
         self.last_error: Exception | None = None
@@ -187,20 +194,20 @@ class CloudTask:
         return self.last_change
 
     def start(self):
-        self._running = True
+        self._stop.clear()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
     def _loop(self):
-        while self._running:
+        while not self._stop.is_set():
             try:
                 self.poll()
             except Exception as e:  # keep polling, but leave a trail
                 self.last_error = e
                 self.counters["errors"] += 1
-            time.sleep(self.interval_s)
+            self._stop.wait(self.interval_s)
 
     def stop(self):
-        self._running = False
+        self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=2.0)

@@ -25,9 +25,10 @@ class AnalyzerBalancer:
         self.counters = {"assigns": 0, "moves": 0, "drains": 0}
 
     # -- analyzer registry ---------------------------------------------
-    def register(self, ip: str, *, capacity: int = 1) -> None:
+    def register(self, ip: str, *, capacity: int = 1, now: float | None = None) -> None:
+        now = time.time() if now is None else now
         with self._lock:
-            self._analyzers[ip] = {"capacity": max(1, capacity), "last_seen": time.time()}
+            self._analyzers[ip] = {"capacity": max(1, capacity), "last_seen": now}
 
     def heartbeat(self, ip: str, now: float | None = None) -> None:
         now = time.time() if now is None else now

@@ -137,13 +137,9 @@ class Recorder:
     def _rebuild_vifs(self) -> None:
         """Vinterfaces have no per-row identity in ResourceDB, so the
         recorder replaces the whole set (all domains) when any domain's
-        set changes — one version bump, consumers refresh wholesale."""
-        with self.db._lock:
-            self.db._vifs.clear()
-            # the clear itself must be visible to version-synced
-            # consumers — a domain shrinking to zero interfaces would
-            # otherwise never trigger a platform push
-            self.db.version += 1
-        for dom_vifs in self._vifs.values():
-            for v in dom_vifs:
-                self.db.add_vinterface(**v)
+        set changes — one atomic swap, one version bump (a shrink to
+        zero still pushes, and no consumer can observe a half-built
+        table)."""
+        self.db.replace_vinterfaces(
+            [v for dom_vifs in self._vifs.values() for v in dom_vifs]
+        )

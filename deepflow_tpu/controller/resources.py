@@ -113,6 +113,28 @@ class ResourceDB:
             )
             self.version += 1
 
+    def replace_vinterfaces(self, vifs: list[dict]) -> None:
+        """Atomically swap the whole vinterface set (recorder full-state
+        writes): one version bump, no window where consumers can observe
+        a cleared-but-not-yet-refilled table."""
+        defaults = dict(
+            epc_id=0, ips=[], mac=0, pod_id=0, region_id=0, az_id=0,
+            subnet_id=0, host_id=0, pod_node_id=0, pod_ns_id=0,
+            pod_group_id=0, pod_cluster_id=0, l3_device_id=0,
+            l3_device_type=0,
+        )
+        rows = []
+        for v in vifs:
+            row = dict(defaults)
+            for k, val in v.items():
+                row["l3_device_id" if k == "device_id" else
+                    "l3_device_type" if k == "device_type" else k] = val
+            row["ips"] = list(row["ips"])
+            rows.append(row)
+        with self._lock:
+            self._vifs[:] = rows
+            self.version += 1
+
     # -- reads ----------------------------------------------------------
     def get(self, kind: str, id: int) -> Resource | None:
         with self._lock:

@@ -26,7 +26,9 @@ def _k8s_objects(pods=2):
                 "metadata": {
                     "name": f"web-{i}",
                     "namespace": "prod",
-                    "ownerReferences": [{"kind": "ReplicaSet", "name": "web"}],
+                    # RS name carries the pod-template hash; the gather
+                    # must trim it so the group survives rollouts
+                    "ownerReferences": [{"kind": "ReplicaSet", "name": "web-5d9f7d6c4d"}],
                 },
                 "spec": {"nodeName": "node-1"},
                 "status": {"podIP": f"10.2.0.{i + 1}"},
