@@ -6,11 +6,18 @@ replaying the BASELINE config-1 workload shape: synthetic
 accumulated-flow batches over 10k unique 5-tuples at 1s windows.
 
 The cycle is the production cadence (aggregator/pipeline.py): per batch
-one `append` (fanout → fingerprint → accumulator write), and every
-ACCUM_BATCHES batches one `fold` (the amortized sort+segment reduce of
-[stash + accumulator] rows — see PERF.md for why this shape wins on
-TPU). Reported records/sec therefore includes the full amortized cost
-of aggregation, not just the append.
+one `append` (batch-local groupby pre-reduce → fanout → fingerprint →
+accumulator write), and every ACCUM_BATCHES batches one `fold` (the
+amortized sort+segment reduce of [stash + accumulator] rows). The
+pre-reduce (PERF.md §7) collapses each batch to its unique raw keys
+BEFORE the 4-lane doc fanout — exact for any workload, and the reason
+fold rows stop scaling with the dup factor. Reported records/sec
+includes the full amortized cost of aggregation, not just the append.
+
+Timing uses an explicit host fetch as the sync point: on the remote
+accelerator tunnel `block_until_ready` returns before execution
+completes (PERF.md §6), so the loop chains state through K cycles and
+subtracts one measured fetch latency.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 vs_baseline is against the north-star target of 50M records/sec/chip
@@ -25,6 +32,7 @@ import time
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from deepflow_tpu.aggregator.fanout import FANOUT_LANES, FanoutConfig
 from deepflow_tpu.aggregator.pipeline import make_ingest_step
@@ -34,14 +42,16 @@ from deepflow_tpu.ingest.replay import SyntheticFlowGen
 
 TARGET = 50e6  # records/sec/chip north star
 
-# Shape ceiling: the fold sorts CAPACITY + ACCUM_BATCHES×4×BATCH rows.
-# Remote compiles at ≥~500k rows have taken >550 s and once wedged the
-# accelerator tunnel for hours (PERF.md §5), so the default fold stays
-# ≤ ~200k rows — a measured-safe compile (~35 s at 131k). Larger, faster
-# amortizations can be opted into per-run: BENCH_ACCUM_BATCHES=8 etc.
-BATCH = int(os.environ.get("BENCH_BATCH", 1 << 14))  # flows per step
+# Measured-safe shapes (PERF.md §7, 2026-07-30 on-chip): compile+first
+# ~100 s at these sizes, steady 14.8 M rec/s at 512k / 16.8 M at 1M.
+# The fold sorts CAPACITY + ACCUM_BATCHES×4×UNIQUE_CAP rows (262k here);
+# the appends sort BATCH raw rows. UNIQUE_CAP bounds per-batch unique
+# keys (3x headroom over the 10k-tuple workload); overflow is shed and
+# counted, never silent.
+BATCH = int(os.environ.get("BENCH_BATCH", 1 << 20))  # flows per step
 CAPACITY = int(os.environ.get("BENCH_CAPACITY", 1 << 16))  # stash segments
 ACCUM_BATCHES = int(os.environ.get("BENCH_ACCUM_BATCHES", 2))
+UNIQUE_CAP = int(os.environ.get("BENCH_UNIQUE_CAP", 1 << 15))
 WARMUP_CYCLES = 1
 CYCLES = int(os.environ.get("BENCH_CYCLES", 8))
 
@@ -53,28 +63,34 @@ def main():
     meters = jnp.asarray(fb.meters)
     valid = jnp.asarray(fb.valid)
 
-    append_fn, fold_fn = make_ingest_step(FanoutConfig(), interval=1)
+    append_fn, fold_fn = make_ingest_step(
+        FanoutConfig(), interval=1, batch_unique_cap=UNIQUE_CAP or None
+    )
     append = jax.jit(append_fn, donate_argnums=(0, 1))
     fold = jax.jit(fold_fn, donate_argnums=(0, 1))
 
-    doc_rows = FANOUT_LANES * BATCH
+    stride = FANOUT_LANES * (UNIQUE_CAP or BATCH)
     state = stash_init(CAPACITY, TAG_SCHEMA, FLOW_METER)
-    acc = accum_init(ACCUM_BATCHES * doc_rows, TAG_SCHEMA, FLOW_METER)
+    acc = accum_init(ACCUM_BATCHES * stride, TAG_SCHEMA, FLOW_METER)
 
     def cycle(state, acc):
         for k in range(ACCUM_BATCHES):
-            state, acc = append(state, acc, jnp.int32(k * doc_rows), tags, meters, valid)
+            state, acc = append(state, acc, jnp.int32(k * stride), tags, meters, valid)
         return fold(state, acc)
 
     for _ in range(WARMUP_CYCLES):
         state, acc = cycle(state, acc)
-    jax.block_until_ready((state, acc))
+    _ = np.asarray(state.slot[:1])  # true host sync (compile + warmup done)
+
+    t0 = time.perf_counter()
+    _ = np.asarray(state.slot[:1])
+    fetch_base = time.perf_counter() - t0
 
     t0 = time.perf_counter()
     for _ in range(CYCLES):
         state, acc = cycle(state, acc)
-    jax.block_until_ready((state, acc))
-    dt = time.perf_counter() - t0
+    _ = np.asarray(state.slot[:1])
+    dt = time.perf_counter() - t0 - fetch_base
 
     rate = BATCH * ACCUM_BATCHES * CYCLES / dt
     print(

@@ -100,6 +100,7 @@ class Agent:
                     MessageType.METRICS,
                     MessageType.TAGGEDFLOW,
                     MessageType.PROTOCOLLOG,
+                    MessageType.AGENT_LOG,
                 )
                 + ((MessageType.RAW_PCAP,) if c.acls else ())
             }
@@ -180,6 +181,12 @@ class Agent:
         s = self.senders.get(mt)
         if s is not None and msgs:
             s.send(msgs)
+
+    def ship_log(self, line: str, severity: int = 6) -> None:
+        """Forward one agent log line to the server's AGENT_LOG lane
+        (droplet-message type 18 → application_log table); RFC 3164
+        <PRI> prefix carries the severity."""
+        self._send(MessageType.AGENT_LOG, [f"<{8 + severity}>{line}".encode()])
 
     # -- drivers ---------------------------------------------------------
     def run_pcap(self, path, *, batch_size: int | None = None) -> dict:

@@ -122,7 +122,12 @@ class KubernetesGather:
                 owner = ref.get("name", "")
                 if ref.get("kind") == "ReplicaSet" and "-" in owner:
                     stem, _, tail = owner.rpartition("-")
-                    if 5 <= len(tail) <= 10 and tail.isalnum():
+                    # pod-template hashes use the vowel-free alphabet
+                    # [0-9bcdfghjklmnpqrstvwxz] — checking it keeps
+                    # bare ReplicaSets like "redis-master" distinct
+                    if 5 <= len(tail) <= 10 and all(
+                        ch in "0123456789bcdfghjklmnpqrstvwxz" for ch in tail
+                    ):
                         owner = stem
             if owner:
                 guid = f"{cluster_uid}/group/{ns}/{owner}"

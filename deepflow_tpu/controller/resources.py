@@ -72,65 +72,40 @@ class ResourceDB:
                 self.version += 1
         return existed
 
-    def add_vinterface(
-        self,
-        *,
-        epc_id: int,
-        ips: list,
-        mac: int = 0,
-        pod_id: int = 0,
-        region_id: int = 0,
-        az_id: int = 0,
-        subnet_id: int = 0,
-        host_id: int = 0,
-        pod_node_id: int = 0,
-        pod_ns_id: int = 0,
-        pod_group_id: int = 0,
-        pod_cluster_id: int = 0,
-        device_id: int = 0,
-        device_type: int = 0,
-    ) -> None:
+    # one row-normalization shared by both vif write paths, so the
+    # incremental and full-state writers cannot drift apart
+    _VIF_DEFAULTS = dict(
+        epc_id=0, ips=(), mac=0, pod_id=0, region_id=0, az_id=0,
+        subnet_id=0, host_id=0, pod_node_id=0, pod_ns_id=0,
+        pod_group_id=0, pod_cluster_id=0, l3_device_id=0,
+        l3_device_type=0,
+    )
+    _VIF_ALIASES = {"device_id": "l3_device_id", "device_type": "l3_device_type"}
+
+    @classmethod
+    def _normalize_vif_row(cls, v: dict) -> dict:
+        row = dict(cls._VIF_DEFAULTS)
+        for k, val in v.items():
+            k = cls._VIF_ALIASES.get(k, k)
+            if k not in cls._VIF_DEFAULTS:
+                raise KeyError(f"unknown vinterface field {k}")
+            row[k] = val
+        row["ips"] = list(row["ips"])
+        return row
+
+    def add_vinterface(self, *, epc_id: int, ips: list, **fields) -> None:
         """One interface (the vinterface/IP rows joined): what agents and
         the ingester resolve MAC/EPC+IP against."""
+        row = self._normalize_vif_row(dict(epc_id=epc_id, ips=ips, **fields))
         with self._lock:
-            self._vifs.append(
-                dict(
-                    epc_id=epc_id,
-                    ips=list(ips),
-                    mac=mac,
-                    pod_id=pod_id,
-                    region_id=region_id,
-                    az_id=az_id,
-                    subnet_id=subnet_id,
-                    host_id=host_id,
-                    pod_node_id=pod_node_id,
-                    pod_ns_id=pod_ns_id,
-                    pod_group_id=pod_group_id,
-                    pod_cluster_id=pod_cluster_id,
-                    l3_device_id=device_id,
-                    l3_device_type=device_type,
-                )
-            )
+            self._vifs.append(row)
             self.version += 1
 
     def replace_vinterfaces(self, vifs: list[dict]) -> None:
         """Atomically swap the whole vinterface set (recorder full-state
         writes): one version bump, no window where consumers can observe
         a cleared-but-not-yet-refilled table."""
-        defaults = dict(
-            epc_id=0, ips=[], mac=0, pod_id=0, region_id=0, az_id=0,
-            subnet_id=0, host_id=0, pod_node_id=0, pod_ns_id=0,
-            pod_group_id=0, pod_cluster_id=0, l3_device_id=0,
-            l3_device_type=0,
-        )
-        rows = []
-        for v in vifs:
-            row = dict(defaults)
-            for k, val in v.items():
-                row["l3_device_id" if k == "device_id" else
-                    "l3_device_type" if k == "device_type" else k] = val
-            row["ips"] = list(row["ips"])
-            rows.append(row)
+        rows = [self._normalize_vif_row(v) for v in vifs]
         with self._lock:
             self._vifs[:] = rows
             self.version += 1

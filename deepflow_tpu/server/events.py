@@ -127,6 +127,8 @@ class EventIngester:
         MessageType.APPLICATION_LOG,
         MessageType.RAW_PCAP,
         MessageType.PACKETSEQUENCE,
+        MessageType.SYSLOG,
+        MessageType.AGENT_LOG,
     )
 
     def __init__(
@@ -201,6 +203,8 @@ class EventIngester:
             self._pcap(org, header, msg)
         elif mt == MessageType.PACKETSEQUENCE:
             self._l4_packet(org, header, msg)
+        elif mt in (MessageType.SYSLOG, MessageType.AGENT_LOG):
+            self._syslog(org, header, msg, mt)
 
     def _event(self, org: int, header: FlowHeader, msg: bytes, mt) -> None:
         ev = json.loads(msg)
@@ -260,6 +264,55 @@ class EventIngester:
                 "trace_id": np.array([str(ev.get("trace_id", ""))]),
                 "span_id": np.array([str(ev.get("span_id", ""))]),
                 "attributes": np.array([json.dumps(ev.get("attributes", {}), sort_keys=True)]),
+            }
+        )
+        with self._lock:
+            self.counters["rows_written"] += 1
+
+    # RFC 5424 severity (0=emergency … 7=debug) → (OTel severity_number,
+    # text). The application_log column is OTel-scaled (higher = worse,
+    # _SEVERITIES writes info=9/error=17), so syslog levels must be
+    # translated onto that scale or filters/sorts interleave two
+    # opposite-direction scales in one table.
+    _SYSLOG_SEV = {
+        0: (24, "emergency"), 1: (22, "alert"), 2: (21, "critical"),
+        3: (17, "error"), 4: (13, "warning"), 5: (10, "notice"),
+        6: (9, "info"), 7: (5, "debug"),
+    }
+
+    def _syslog(self, org: int, header: FlowHeader, msg: bytes, mt) -> None:
+        """SYSLOG / AGENT_LOG frames → application_log rows.
+
+        The reference routes agent-forwarded syslog and the agent's own
+        log stream to the server (droplet-message TYPE_SYSLOG /
+        AGENT_LOG); here both land in the same application_log table the
+        OTel/app-log lane writes, tagged by source. Payload is the raw
+        text line, optionally RFC 3164/5424 "<PRI>" prefixed; ts comes
+        from the frame when no structured time is present."""
+        import time as _time
+
+        line = msg.decode(errors="replace").rstrip("\n")
+        syslog_sev = 6  # info default
+        if line.startswith("<"):
+            end = line.find(">", 1, 6)
+            if end > 0 and line[1:end].isdigit():
+                syslog_sev = int(line[1:end]) & 0x7
+                line = line[end + 1 :]
+        sev_num, sev_text = self._SYSLOG_SEV[syslog_sev]
+        svc = "syslog" if mt == MessageType.SYSLOG else "deepflow-agent"
+        ts_us = int(_time.time() * 1_000_000)
+        self._writer(org_db("application_log", org), APP_LOG_SCHEMA).put(
+            {
+                "time": np.array([ts_us // 1_000_000], np.uint32),
+                "timestamp_us": np.array([ts_us], np.uint64),
+                "agent_id": np.array([header.agent_id], np.uint32),
+                "app_service": np.array([svc]),
+                "severity_number": np.array([sev_num], np.uint32),
+                "severity_text": np.array([sev_text]),
+                "body": np.array([line]),
+                "trace_id": np.array([""]),
+                "span_id": np.array([""]),
+                "attributes": np.array(["{}"]),
             }
         )
         with self._lock:

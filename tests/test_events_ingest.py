@@ -148,3 +148,24 @@ def test_malformed_event_counted_not_fatal():
     finally:
         ing.stop()
         recv.stop()
+
+
+def test_syslog_and_agent_log_to_application_log():
+    """SYSLOG/AGENT_LOG frames (droplet-message types 1/18) land in the
+    application_log table with RFC 3164 <PRI> severity decoded."""
+    recv, store, ing = _stack()
+    try:
+        _send(recv, MessageType.SYSLOG, [b"<11>host app: disk read failure"])
+        _send(recv, MessageType.AGENT_LOG, [b"dispatcher: rx ring resized"])
+        assert _wait(lambda: ing.get_counters()["rows_written"] >= 2)
+        ing.flush()
+        cols = store.scan("application_log", "log",
+                          columns=["app_service", "severity_text", "body"])
+        rows = {str(s): (str(sev), str(b)) for s, sev, b in
+                zip(cols["app_service"], cols["severity_text"], cols["body"])}
+        assert rows["syslog"] == ("error", "host app: disk read failure")
+        assert rows["deepflow-agent"][0] == "info"
+        assert "rx ring" in rows["deepflow-agent"][1]
+    finally:
+        ing.stop()
+        recv.stop()

@@ -139,3 +139,52 @@ def test_l4_both_inactive_record_dropped():
     out = pipe.ingest(FlowBatch.from_records([rec])) + pipe.drain()
     assert all(db.size == 0 for db in out)
     assert oracle_l4_rollup([rec], cfg) == {}
+
+
+def test_batch_unique_cap_prereduce_exact():
+    """The batch-local pre-reduce (fanout-after-reduce, PERF.md §7) must
+    be EXACT: same fold output as the plain step, because identical raw
+    tag rows land identical doc rows per lane and the lane meter
+    transforms are column permutations (sum/max commute)."""
+    import jax.numpy as jnp
+
+    from deepflow_tpu.aggregator.fanout import FanoutConfig
+    from deepflow_tpu.aggregator.pipeline import make_ingest_step
+    from deepflow_tpu.aggregator.stash import accum_init, stash_init
+    from deepflow_tpu.datamodel.schema import FLOW_METER, TAG_SCHEMA
+
+    gen = SyntheticFlowGen(num_tuples=37, seed=3)  # heavy dup factor
+    batch = 512
+    fb = gen.flow_batch(batch, 1_700_000_000)
+    tags = {k: jnp.asarray(v) for k, v in fb.tags.items()}
+    meters = jnp.asarray(fb.meters)
+    valid = jnp.asarray(fb.valid)
+
+    def run(cap):
+        append, fold = make_ingest_step(FanoutConfig(), interval=1,
+                                        batch_unique_cap=cap)
+        n_doc = 4 * (cap if cap else batch)
+        state = stash_init(1 << 11, TAG_SCHEMA, FLOW_METER)
+        acc = accum_init(2 * n_doc, TAG_SCHEMA, FLOW_METER)
+        state, acc = append(state, acc, jnp.int32(0), tags, meters, valid)
+        state, acc = append(state, acc, jnp.int32(n_doc), tags, meters, valid)
+        state, acc = fold(state, acc)
+        return state
+
+    plain = run(None)
+    reduced = run(256)  # 37 tuples → plenty of cap headroom
+
+    # identical live segments: same keys, same slots, same reduced meters
+    np.testing.assert_array_equal(np.asarray(plain.valid), np.asarray(reduced.valid))
+    m = np.asarray(plain.valid)
+    for field in ("slot", "key_hi", "key_lo"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(plain, field))[m], np.asarray(getattr(reduced, field))[m])
+    np.testing.assert_array_equal(np.asarray(plain.tags)[:, m], np.asarray(reduced.tags)[:, m])
+    np.testing.assert_allclose(
+        np.asarray(plain.meters)[:, m], np.asarray(reduced.meters)[:, m], rtol=0, atol=0)
+    assert int(reduced.dropped_overflow) == 0
+
+    # cap overflow is shed + counted, not silently merged
+    capped = run(16)  # 37 uniques > 16
+    assert int(capped.dropped_overflow) > 0
