@@ -272,9 +272,12 @@ class L7Engine:
             ints[r, ii("type")] = (
                 TYPE_SESSION if req and resp else TYPE_REQUEST if req else TYPE_RESPONSE
             )
-            ints[r, ii("request_id")] = (head.request_id or 0) if head else 0
+            # ids/codes are pairing cookies, not quantities — mask into
+            # the u32 columns (bRPC correlation ids are 64-bit varints,
+            # Tars iRet is signed)
+            ints[r, ii("request_id")] = ((head.request_id or 0) if head else 0) & 0xFFFFFFFF
             ints[r, ii("status")] = status
-            ints[r, ii("status_code")] = resp.status_code if resp else 0
+            ints[r, ii("status_code")] = (resp.status_code if resp else 0) & 0xFFFFFFFF
             ints[r, ii("start_time")] = sess.get("req_ts_us", sess["ts_us"]) // 1_000_000
             ints[r, ii("end_time")] = sec
             ints[r, ii("response_duration")] = sess["rrt_us"]

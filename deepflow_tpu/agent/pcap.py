@@ -17,6 +17,10 @@ import numpy as np
 
 MAGIC_US = 0xA1B2C3D4
 MAGIC_NS = 0xA1B23C4D
+# "modified" pcap (Alexey Kuznetzov's patched libpcap): classic layout
+# with 8 extra per-record bytes (ifindex u32, protocol u16, pkt_type u8,
+# pad u8) after the standard 16-byte record header
+MAGIC_MODIFIED = 0xA1B2CD34
 LINKTYPE_ETHERNET = 1
 
 
@@ -34,20 +38,21 @@ def read_pcap(path: str | Path) -> list[tuple[int, int, bytes]]:
     if len(data) < 24:
         raise ValueError("truncated pcap: no global header")
     (magic,) = struct.unpack_from("<I", data, 0)
-    if magic in (MAGIC_US, MAGIC_NS):
+    if magic in (MAGIC_US, MAGIC_NS, MAGIC_MODIFIED):
         endian = "<"
-    elif magic in (struct.unpack(">I", struct.pack("<I", MAGIC_US))[0],
-                   struct.unpack(">I", struct.pack("<I", MAGIC_NS))[0]):
+    elif magic in (struct.unpack(">I", struct.pack("<I", m))[0]
+                   for m in (MAGIC_US, MAGIC_NS, MAGIC_MODIFIED)):
         endian = ">"
         (magic,) = struct.unpack_from(">I", data, 0)
     else:
         raise ValueError(f"bad pcap magic {magic:#x}")
     ns = magic == MAGIC_NS
+    extra = 8 if magic == MAGIC_MODIFIED else 0
     out = []
     off = 24
-    while off + 16 <= len(data):
+    while off + 16 + extra <= len(data):
         sec, frac, incl, _orig = struct.unpack_from(f"{endian}IIII", data, off)
-        off += 16
+        off += 16 + extra
         if off + incl > len(data):
             break  # truncated trailing record
         out.append((sec, frac // 1000 if ns else frac, data[off : off + incl]))

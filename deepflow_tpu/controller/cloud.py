@@ -122,11 +122,11 @@ class KubernetesGather:
                 owner = ref.get("name", "")
                 if ref.get("kind") == "ReplicaSet" and "-" in owner:
                     stem, _, tail = owner.rpartition("-")
-                    # pod-template hashes use the vowel-free alphabet
-                    # [0-9bcdfghjklmnpqrstvwxz] — checking it keeps
+                    # pod-template hashes use k8s' SafeEncodeString
+                    # alphabet (no vowels, no 0/1/3) — checking it keeps
                     # bare ReplicaSets like "redis-master" distinct
                     if 5 <= len(tail) <= 10 and all(
-                        ch in "0123456789bcdfghjklmnpqrstvwxz" for ch in tail
+                        ch in "bcdfghjklmnpqrstvwxz2456789" for ch in tail
                     ):
                         owner = stem
             if owner:

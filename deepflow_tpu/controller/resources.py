@@ -87,9 +87,11 @@ class ResourceDB:
         row = dict(cls._VIF_DEFAULTS)
         for k, val in v.items():
             k = cls._VIF_ALIASES.get(k, k)
-            if k not in cls._VIF_DEFAULTS:
-                raise KeyError(f"unknown vinterface field {k}")
-            row[k] = val
+            # unknown keys (operator doc extras, source-internal markers
+            # like _pod_uid) are dropped, not fatal — a reconcile must
+            # never abort half-applied over a stray field
+            if k in cls._VIF_DEFAULTS:
+                row[k] = val
         row["ips"] = list(row["ips"])
         return row
 

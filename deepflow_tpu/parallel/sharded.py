@@ -67,6 +67,10 @@ class ShardedConfig:
     # batches accumulated per device between sort+reduce folds
     # (same amortization as WindowConfig.accum_batches)
     accum_batches: int = 8
+    # per-device batch-local pre-reduce before fanout (PERF.md §7);
+    # None = off. Bounds each batch's unique raw keys; overflow is shed
+    # and counted in the device stash's overflow counter.
+    batch_unique_cap: int | None = None
 
 
 class ShardedPipeline:
@@ -114,7 +118,9 @@ class ShardedPipeline:
     # -- step -----------------------------------------------------------
     def _build_step(self):
         c = self.config
-        base_append, self._base_fold = make_ingest_step(c.fanout, c.interval)
+        base_append, self._base_fold = make_ingest_step(
+            c.fanout, c.interval, batch_unique_cap=c.batch_unique_cap
+        )
         t_idx = TAG_SCHEMA.index
         m_idx = FLOW_METER.index
 
@@ -355,7 +361,11 @@ class ShardedWindowManager:
                 self.sketches
             )
 
-        rows_per_device = FANOUT_LANES * (int(ts_np.shape[0]) // self.pipe.n_devices)
+        per_dev = int(ts_np.shape[0]) // self.pipe.n_devices
+        # with the pre-reduce on, every append writes a 4×cap_u block
+        # (groupby output capacity is static) regardless of batch size
+        cap_u = self.pipe.config.batch_unique_cap
+        rows_per_device = FANOUT_LANES * (cap_u if cap_u else per_dev)
         cap = int(self.acc.slot.shape[1]) if self.acc is not None else None
         plan = plan_append(self.fill, cap, rows_per_device)
         if plan == "init":

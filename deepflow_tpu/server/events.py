@@ -280,6 +280,44 @@ class EventIngester:
         6: (9, "info"), 7: (5, "debug"),
     }
 
+    @staticmethod
+    def _syslog_timestamp(line: str) -> tuple[int, str]:
+        """Extract an event timestamp from the line head: RFC 5424
+        ("1 2026-07-30T06:12:33.5Z host …") or RFC 3164
+        ("Jul 30 06:12:33 host …"). Returns (ts_us, remaining_line) —
+        (0, line) when no structured time leads the message, so buffered
+        lines re-shipped after an outage keep their event time instead
+        of the ingest time."""
+        import datetime as _dt
+        import re as _re
+
+        m = _re.match(r"1 (\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}(?:\.\d+)?)(Z|[+-]\d{2}:\d{2})?\s*", line)
+        if m:
+            try:
+                iso = m.group(1) + (m.group(2) or "+00:00").replace("Z", "+00:00")
+                dt = _dt.datetime.fromisoformat(iso)
+                return int(dt.timestamp() * 1_000_000), line[m.end():]
+            except ValueError:
+                return 0, line
+        m = _re.match(r"([A-Z][a-z]{2}) ([ \d]\d) (\d{2}):(\d{2}):(\d{2})\s*", line)
+        if m:
+            months = ("Jan", "Feb", "Mar", "Apr", "May", "Jun",
+                      "Jul", "Aug", "Sep", "Oct", "Nov", "Dec")
+            if m.group(1) in months:
+                now = _dt.datetime.now(_dt.timezone.utc)
+                try:
+                    dt = now.replace(
+                        month=months.index(m.group(1)) + 1, day=int(m.group(2)),
+                        hour=int(m.group(3)), minute=int(m.group(4)),
+                        second=int(m.group(5)), microsecond=0,
+                    )
+                except ValueError:
+                    return 0, line
+                if dt > now + _dt.timedelta(days=1):  # year rollover
+                    dt = dt.replace(year=dt.year - 1)
+                return int(dt.timestamp() * 1_000_000), line[m.end():]
+        return 0, line
+
     def _syslog(self, org: int, header: FlowHeader, msg: bytes, mt) -> None:
         """SYSLOG / AGENT_LOG frames → application_log rows.
 
@@ -300,7 +338,9 @@ class EventIngester:
                 line = line[end + 1 :]
         sev_num, sev_text = self._SYSLOG_SEV[syslog_sev]
         svc = "syslog" if mt == MessageType.SYSLOG else "deepflow-agent"
-        ts_us = int(_time.time() * 1_000_000)
+        ts_us, line = self._syslog_timestamp(line)
+        if ts_us == 0:  # no structured time in the payload
+            ts_us = int(_time.time() * 1_000_000)
         self._writer(org_db("application_log", org), APP_LOG_SCHEMA).put(
             {
                 "time": np.array([ts_us // 1_000_000], np.uint32),

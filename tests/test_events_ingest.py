@@ -169,3 +169,19 @@ def test_syslog_and_agent_log_to_application_log():
     finally:
         ing.stop()
         recv.stop()
+
+
+def test_syslog_event_time_preserved():
+    """Buffered/relayed lines keep their embedded event time (RFC 5424
+    and RFC 3164 heads); lines without one get ingest time."""
+    from deepflow_tpu.server.events import EventIngester
+
+    ts, rest = EventIngester._syslog_timestamp("1 2026-07-30T06:12:33.5Z host app: boom")
+    assert rest == "host app: boom"
+    assert ts == 1_785_391_953_500_000
+
+    ts2, rest2 = EventIngester._syslog_timestamp("Jul 30 06:12:33 host app: boom")
+    assert rest2 == "host app: boom" and ts2 > 0
+
+    ts3, rest3 = EventIngester._syslog_timestamp("no timestamp here")
+    assert ts3 == 0 and rest3 == "no timestamp here"

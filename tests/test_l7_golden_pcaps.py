@@ -113,3 +113,35 @@ def test_golden_tcp_dns_multi():
     regress to UNKNOWN."""
     _eng, protos, rows = _replay("dns/dns-tcp-multi.pcap")
     assert L7Protocol.DNS in protos
+
+
+def test_whole_fixture_corpus_replays_without_crashing():
+    """Every capture in the reference corpus — truncated handshakes,
+    ip fragments, out-of-order segments, port reuse, retransmissions —
+    must flow through the full agent graph (packet parse → FlowMap →
+    L7 engine → rollup) without raising; protocol misses are fine,
+    crashes are not."""
+    import glob
+
+    from deepflow_tpu.agent.main import Agent, AgentConfig
+
+    pcaps = sorted(glob.glob(os.path.join(BASE, "**", "*.pcap"), recursive=True))
+    assert len(pcaps) > 60  # the corpus is big; make sure we found it
+
+    class _Null:
+        def send(self, msgs):
+            pass
+
+    sink = _Null()
+    from deepflow_tpu.ingest.framing import MessageType
+
+    replayed = 0
+    for path in pcaps:
+        agent = Agent(
+            AgentConfig(batch_size=512),
+            senders={mt: sink for mt in MessageType},
+        )
+        stats = agent.run_pcap(path)
+        assert stats["packets"] >= 0
+        replayed += 1
+    assert replayed == len(pcaps)

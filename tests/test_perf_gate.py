@@ -74,3 +74,47 @@ def test_hot_path_compile_and_steady_state_bounds():
         f"hot-path steady state {per_batch_ms:.1f} ms/batch "
         f"(bound {STEADY_BOUND_MS} ms) — kernel regression"
     )
+
+
+def test_prereduce_hot_path_bounds():
+    """Same bounds for the production bench cadence: batch-local
+    pre-reduce (batch_unique_cap) before fanout (PERF.md §7). Guards the
+    path bench.py actually ships."""
+    gen = SyntheticFlowGen(num_tuples=500, seed=0)
+    fb = gen.flow_batch(BATCH, 1_700_000_000)
+    tags = {k: jnp.asarray(v) for k, v in fb.tags.items()}
+    meters, valid = jnp.asarray(fb.meters), jnp.asarray(fb.valid)
+
+    cap_u = 512
+    append_fn, fold_fn = make_ingest_step(
+        FanoutConfig(), interval=1, batch_unique_cap=cap_u
+    )
+    append = jax.jit(append_fn, donate_argnums=(0, 1))
+    fold = jax.jit(fold_fn, donate_argnums=(0, 1))
+
+    stride = FANOUT_LANES * cap_u
+    state = stash_init(CAPACITY, TAG_SCHEMA, FLOW_METER)
+    acc = accum_init(ACCUM_BATCHES * stride, TAG_SCHEMA, FLOW_METER)
+
+    t0 = time.perf_counter()
+    state, acc = append(state, acc, jnp.int32(0), tags, meters, valid)
+    state, acc = fold(state, acc)
+    jax.block_until_ready(acc.slot)
+    compile_s = time.perf_counter() - t0
+    assert compile_s < COMPILE_BOUND_S, (
+        f"pre-reduce compile+first-run took {compile_s:.1f}s "
+        f"(bound {COMPILE_BOUND_S}s) — compile-time regression"
+    )
+
+    cycles = 3
+    t0 = time.perf_counter()
+    for _ in range(cycles):
+        for k in range(ACCUM_BATCHES):
+            state, acc = append(state, acc, jnp.int32(k * stride), tags, meters, valid)
+        state, acc = fold(state, acc)
+    jax.block_until_ready(acc.slot)
+    per_batch_ms = (time.perf_counter() - t0) / (cycles * ACCUM_BATCHES) * 1e3
+    assert per_batch_ms < STEADY_BOUND_MS, (
+        f"pre-reduce steady state {per_batch_ms:.1f} ms/batch "
+        f"(bound {STEADY_BOUND_MS} ms) — kernel regression"
+    )

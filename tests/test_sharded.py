@@ -236,3 +236,44 @@ def test_hll_sharded_equals_single_device():
         part = hll_update(hll_init(1, 10), gid[sl], hi[sl], lo[sl], jnp.ones(512, bool))
         merged = np.maximum(merged, np.asarray(part))
     np.testing.assert_array_equal(merged, np.asarray(ref))
+
+
+def test_sharded_prereduce_matches_single_device_oracle():
+    """Same 8-device vs single-device equality with the batch-local
+    pre-reduce on (ShardedConfig.batch_unique_cap, PERF.md §7)."""
+    from deepflow_tpu.aggregator.pipeline import PipelineConfig, RollupPipeline
+    from deepflow_tpu.aggregator.window import WindowConfig
+    from deepflow_tpu.datamodel.batch import FlowBatch
+    from deepflow_tpu.datamodel.schema import FLOW_METER
+    from deepflow_tpu.parallel.sharded import ShardedWindowManager
+
+    mesh = make_mesh(8, n_hosts=2)
+    cfg = ShardedConfig(
+        capacity_per_device=1 << 11, num_services=16, hll_precision=8,
+        batch_unique_cap=256,  # 300 tuples / 8 devices → plenty of headroom
+    )
+    pipe = ShardedPipeline(mesh, cfg)
+    swm = ShardedWindowManager(pipe)
+
+    single = RollupPipeline(
+        PipelineConfig(window=WindowConfig(capacity=1 << 14), batch_size=512)
+    )
+
+    gen = SyntheticFlowGen(num_tuples=300, seed=11)
+    t0 = 5000
+    sharded_docs, single_docs = [], []
+    for t in (t0, t0, t0 + 1, t0 + 2, t0 + 8):
+        fb = gen.flow_batch(512, t)
+        sharded_docs += swm.ingest(fb.tags, fb.meters, fb.valid)
+        single_docs += single.ingest(
+            FlowBatch(tags=fb.tags, meters=fb.meters, valid=fb.valid)
+        )
+    sharded_docs += swm.drain()
+    single_docs += single.drain()
+
+    a = _groupby_docs(sharded_docs, FLOW_METER)
+    b = _groupby_docs(single_docs, FLOW_METER)
+    assert a.keys() == b.keys()
+    assert len(a) > 0
+    for k in a:
+        np.testing.assert_allclose(a[k], b[k], rtol=1e-5, atol=1e-5)
