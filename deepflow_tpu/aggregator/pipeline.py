@@ -26,6 +26,32 @@ from .window import FlushedWindow, WindowConfig, WindowManager
 _KEY_COLS = np.nonzero(TAG_SCHEMA.key_mask)[0].astype(np.int32)
 
 
+def batch_prereduce(tags, meters, valid, interval, cap, sum_cols, max_cols):
+    """Batch-local pre-reduce BEFORE fanout: group raw rows by their
+    full tag fingerprint (incl. timestamp) and reduce meters. Exact:
+    identical raw tag rows produce identical doc rows in every fanout
+    lane, and the lanes' meter transforms are column permutations/
+    copies, which commute with per-column sum/max (PERF.md §7c). This
+    collapses the dup factor (10k-tuple rollup workloads repeat keys
+    within a batch) so the fold sorts ~1 row/record instead of 4.
+    Returns (tags, meters [cap, M], valid, dropped) — rows beyond `cap`
+    unique keys are shed; callers count `dropped` (newest-shed
+    stance)."""
+    from ..ops.segment import groupby_reduce
+
+    names = sorted(tags)
+    tags_t = jnp.stack([jnp.asarray(tags[k], jnp.uint32) for k in names])
+    hi, lo = fingerprint64_t(tags_t)
+    slot = jnp.asarray(tags["timestamp"], jnp.uint32) // jnp.uint32(interval)
+    g = groupby_reduce(
+        slot, hi, lo, tags_t, jnp.transpose(meters), valid,
+        sum_cols, max_cols, out_capacity=cap,
+    )
+    r_tags = {k: g.tags[i] for i, k in enumerate(names)}
+    dropped = jnp.maximum(g.num_segments - cap, 0)
+    return r_tags, jnp.transpose(g.meters), g.seg_valid, dropped
+
+
 def make_ingest_step(fanout_config: FanoutConfig, interval: int = 1, app: bool = False,
                      batch_unique_cap: int | None = None):
     """Build the pure device step pair: FlowBatch columns → stash.
@@ -54,34 +80,14 @@ def make_ingest_step(fanout_config: FanoutConfig, interval: int = 1, app: bool =
     max_cols_np = np.asarray(max_cols, np.int32)
     key_cols = jnp.asarray(_KEY_COLS)
 
-    from ..ops.segment import groupby_reduce
     from .stash import _append_impl, _fold_impl
-
-    def _batch_reduce(tags, meters, valid):
-        """Batch-local pre-reduce BEFORE fanout: group raw rows by their
-        full tag fingerprint (incl. timestamp) and reduce meters. Exact:
-        identical raw tag rows produce identical doc rows in every
-        fanout lane, and the lanes' meter transforms are column
-        permutations/copies, which commute with per-column sum/max. This
-        collapses the dup factor (10k-tuple rollup workloads repeat keys
-        within a batch) so the fold sorts ~1 row/record instead of 4.
-        Rows beyond `batch_unique_cap` unique keys are shed and counted
-        in the stash overflow counter (same newest-shed stance)."""
-        names = sorted(tags)
-        tags_t = jnp.stack([jnp.asarray(tags[k], jnp.uint32) for k in names])
-        hi, lo = fingerprint64_t(tags_t)
-        slot = (jnp.asarray(tags["timestamp"], jnp.uint32) // jnp.uint32(interval))
-        g = groupby_reduce(
-            slot, hi, lo, tags_t, jnp.transpose(meters), valid,
-            sum_cols_np, max_cols_np, out_capacity=batch_unique_cap,
-        )
-        r_tags = {k: g.tags[i] for i, k in enumerate(names)}
-        dropped = jnp.maximum(g.num_segments - batch_unique_cap, 0)
-        return r_tags, jnp.transpose(g.meters), g.seg_valid, dropped
 
     def append(stash, acc, offset, tags, meters, valid):
         if batch_unique_cap is not None:
-            tags, meters, valid, dropped = _batch_reduce(tags, meters, valid)
+            tags, meters, valid, dropped = batch_prereduce(
+                tags, meters, valid, interval, batch_unique_cap,
+                sum_cols_np, max_cols_np,
+            )
             stash = dataclasses.replace(
                 stash, dropped_overflow=stash.dropped_overflow + dropped
             )
@@ -103,6 +109,8 @@ class PipelineConfig:
     fanout: FanoutConfig = FanoutConfig()
     window: WindowConfig = WindowConfig()
     batch_size: int = 4096  # static pad size for flow batches
+    # batch-local pre-reduce before fanout (batch_prereduce); None = off
+    batch_unique_cap: int | None = None
 
 
 # Back-compat alias (bench/entry scripts predate the L7 pipeline).
@@ -119,6 +127,9 @@ class RollupPipeline:
     def __init__(self, config: PipelineConfig = PipelineConfig()):
         self.config = config
         self.wm = WindowManager(config.window, TAG_SCHEMA, self.meter_schema)
+        # device-side running count — fetching it per batch would cost a
+        # host round trip; counters reads it on demand
+        self._prereduce_dropped = jnp.zeros((), jnp.int32)
 
     def ingest(self, batch: FlowBatch) -> list[DocBatch]:
         """Feed one decoded flow batch; returns any closed windows."""
@@ -126,6 +137,16 @@ class RollupPipeline:
         tags = {k: jnp.asarray(v) for k, v in batch.tags.items()}
         meters = jnp.asarray(batch.meters)
         valid = jnp.asarray(batch.valid)
+
+        if self.config.batch_unique_cap is not None:
+            m = self.meter_schema
+            tags, meters, valid, dropped = batch_prereduce(
+                tags, meters, valid, self.config.window.interval,
+                self.config.batch_unique_cap,
+                np.nonzero(m.sum_mask)[0].astype(np.int32),
+                np.nonzero(m.max_mask)[0].astype(np.int32),
+            )
+            self._prereduce_dropped = self._prereduce_dropped + dropped
 
         doc_tags, doc_meters, ts, doc_valid = self.fanout_fn(
             tags, meters, valid, self.config.fanout
@@ -156,7 +177,10 @@ class RollupPipeline:
 
     @property
     def counters(self) -> dict:
-        return self.wm.counters
+        out = dict(self.wm.counters)
+        if self.config.batch_unique_cap is not None:
+            out["prereduce_dropped"] = int(self._prereduce_dropped)
+        return out
 
     @property
     def flags(self) -> DocumentFlag:

@@ -90,7 +90,10 @@ def cmd_server(args):
     try:
         while True:
             time.sleep(10)
-            srv.tick()
+            try:
+                srv.tick()
+            except Exception as e:  # a tick must never take the server down
+                print(f"tick error: {e!r}", flush=True)
     except KeyboardInterrupt:
         srv.stop()
 

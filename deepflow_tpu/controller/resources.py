@@ -87,11 +87,14 @@ class ResourceDB:
         row = dict(cls._VIF_DEFAULTS)
         for k, val in v.items():
             k = cls._VIF_ALIASES.get(k, k)
-            # unknown keys (operator doc extras, source-internal markers
-            # like _pod_uid) are dropped, not fatal — a reconcile must
-            # never abort half-applied over a stray field
-            if k in cls._VIF_DEFAULTS:
-                row[k] = val
+            if k.startswith("_"):
+                continue  # source-internal markers (e.g. _pod_uid)
+            if k not in cls._VIF_DEFAULTS:
+                # misspelled operator fields must surface, not silently
+                # default; rows are all normalized BEFORE any mutation
+                # (replace_vinterfaces), so raising stays atomic
+                raise KeyError(f"unknown vinterface field {k!r}")
+            row[k] = val
         row["ips"] = list(row["ips"])
         return row
 

@@ -282,12 +282,11 @@ class EventIngester:
 
     @staticmethod
     def _syslog_timestamp(line: str) -> tuple[int, str]:
-        """Extract an event timestamp from the line head: RFC 5424
-        ("1 2026-07-30T06:12:33.5Z host …") or RFC 3164
-        ("Jul 30 06:12:33 host …"). Returns (ts_us, remaining_line) —
-        (0, line) when no structured time leads the message, so buffered
-        lines re-shipped after an outage keep their event time instead
-        of the ingest time."""
+        """Extract an event timestamp from an RFC 5424 line head
+        ("1 2026-07-30T06:12:33.5Z host …"). Returns (ts_us,
+        remaining_line) — (0, line) when no tz-qualified time leads the
+        message; buffered 5424 lines re-shipped after an outage keep
+        their event time instead of the ingest time."""
         import datetime as _dt
         import re as _re
 
@@ -299,23 +298,10 @@ class EventIngester:
                 return int(dt.timestamp() * 1_000_000), line[m.end():]
             except ValueError:
                 return 0, line
-        m = _re.match(r"([A-Z][a-z]{2}) ([ \d]\d) (\d{2}):(\d{2}):(\d{2})\s*", line)
-        if m:
-            months = ("Jan", "Feb", "Mar", "Apr", "May", "Jun",
-                      "Jul", "Aug", "Sep", "Oct", "Nov", "Dec")
-            if m.group(1) in months:
-                now = _dt.datetime.now(_dt.timezone.utc)
-                try:
-                    dt = now.replace(
-                        month=months.index(m.group(1)) + 1, day=int(m.group(2)),
-                        hour=int(m.group(3)), minute=int(m.group(4)),
-                        second=int(m.group(5)), microsecond=0,
-                    )
-                except ValueError:
-                    return 0, line
-                if dt > now + _dt.timedelta(days=1):  # year rollover
-                    dt = dt.replace(year=dt.year - 1)
-                return int(dt.timestamp() * 1_000_000), line[m.end():]
+        # RFC 3164 heads ("Jul 30 06:12:33") carry no timezone, so the
+        # instant is ambiguous by the sender's UTC offset — worse than
+        # ingest time. Leave them in the body and let the caller stamp
+        # ingest time; only tz-qualified 5424 timestamps are trusted.
         return 0, line
 
     def _syslog(self, org: int, header: FlowHeader, msg: bytes, mt) -> None:

@@ -15,7 +15,11 @@ from __future__ import annotations
 import os
 import time
 
+from ..controller.cloud import CloudTask
 from ..controller.election import LeaderElection
+from ..controller.genesis import GenesisStore
+from ..controller.rebalance import AnalyzerBalancer
+from ..controller.recorder import Recorder
 from ..controller.resources import ResourceDB
 from ..controller.prom_labels import PrometheusLabelRegistry
 from ..controller.rest import RestServer
@@ -57,7 +61,20 @@ class Server:
         self.resources = ResourceDB()
         self.translator = Translator(self.store)
         self.tagrecorder = TagRecorder(self.resources, self.store, translator=self.translator)
-        self.trisolaris = TrisolarisService(self.resources)
+        # resource plane: discovery sources → recorder → ResourceDB.
+        # Genesis fills from agent sync payloads; cloud sources attach
+        # via add_cloud_source(). Resource-change events ride the event
+        # plane once the event ingester is up (sink bound below).
+        self._resource_events: list = []
+        self.recorder = Recorder(self.resources, event_sink=self._resource_events.append)
+        self.genesis = GenesisStore()
+        self.balancer = AnalyzerBalancer()
+        self._analyzer_ip = cfg.receiver.host or "127.0.0.1"
+        self.balancer.register(self._analyzer_ip)
+        self.cloud_tasks: list[CloudTask] = []
+        self.trisolaris = TrisolarisService(
+            self.resources, genesis=self.genesis, balancer=self.balancer
+        )
         # holder must be unique ACROSS processes — heap addresses collide
         self.election = (
             LeaderElection(self.lease_path, holder=f"server-{os.getpid()}-{id(self):x}")
@@ -145,11 +162,65 @@ class Server:
             did["platform"] = True
         did["traces_closed"] = self.trace_builder.tick()
         did["monitor"] = self.monitor.check(now)
+        # this process IS the local analyzer — its liveness follows the
+        # tick, every node (remote analyzers heartbeat via their own sync)
+        self.balancer.heartbeat(self._analyzer_ip)
         if leader:
             did["tagrecorder"] = self.tagrecorder.sync()
             did["downsampled"] = self.downsampler.process(now)
+            # discovery: cloud sources + the genesis inventory reconcile
+            # into ResourceDB; change events land in the event table.
+            # Source errors are non-fatal (CloudTask._loop's stance) —
+            # one flaky apiserver must not take the server down.
+            for task in self.cloud_tasks:
+                try:
+                    task.poll()
+                except Exception as e:
+                    task.last_error = e
+                    task.counters["errors"] += 1
+                    # a stale ChangeSet must not keep counting as
+                    # fresh discovery activity while the source is down
+                    task.last_change = None
+            cs = self.recorder.reconcile(self.genesis.domain, self.genesis.snapshot())
+            did["resource_changes"] = cs.total + sum(
+                t.last_change.total for t in self.cloud_tasks if t.last_change
+            )
+            self._drain_resource_events()
+            self.balancer.rebalance()
         default_collector.tick()
         return did
+
+    def add_cloud_source(self, source) -> "CloudTask":
+        """Attach a cloud discovery source (KubernetesGather /
+        FileReaderPlatform); polled on the leader tick."""
+        task = CloudTask(source, self.recorder)
+        self.cloud_tasks.append(task)
+        return task
+
+    def _drain_resource_events(self) -> None:
+        """Resource-change events → the event table (the reference's
+        eventapi → event ingester path, in-process here)."""
+        import json as _json
+
+        from ..ingest.framing import FlowHeader, MessageType
+
+        # FIFO: a create+delete pair for a churned uid shares the same
+        # int-second timestamp, so write order is the only order
+        events, self._resource_events[:] = list(self._resource_events), []
+        for ev in events:
+            self.events._event(
+                1,
+                FlowHeader(msg_type=int(MessageType.K8S_EVENT)),
+                _json.dumps(
+                    {
+                        "time": ev["time"],
+                        "event_type": ev["type"],
+                        "resource_type": ev["resource_type"],
+                        "resource_name": ev["instance"],
+                    }
+                ).encode(),
+                MessageType.K8S_EVENT,
+            )
 
     def query_trace(self, trace_id: str, org: int = 1):
         from ..tracing.query import query_trace

@@ -180,8 +180,9 @@ def test_syslog_event_time_preserved():
     assert rest == "host app: boom"
     assert ts == 1_785_391_953_500_000
 
+    # RFC 3164 heads are tz-ambiguous → untouched, caller stamps ingest time
     ts2, rest2 = EventIngester._syslog_timestamp("Jul 30 06:12:33 host app: boom")
-    assert rest2 == "host app: boom" and ts2 > 0
+    assert ts2 == 0 and rest2 == "Jul 30 06:12:33 host app: boom"
 
     ts3, rest3 = EventIngester._syslog_timestamp("no timestamp here")
     assert ts3 == 0 and rest3 == "no timestamp here"

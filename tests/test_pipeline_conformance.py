@@ -188,3 +188,36 @@ def test_batch_unique_cap_prereduce_exact():
     # cap overflow is shed + counted, not silently merged
     capped = run(16)  # 37 uniques > 16
     assert int(capped.dropped_overflow) > 0
+
+
+def test_rollup_pipeline_with_prereduce_matches_plain():
+    """RollupPipeline with PipelineConfig.batch_unique_cap produces the
+    same flushed docs as the plain pipeline (production-path twin of the
+    step-level exactness test)."""
+    from deepflow_tpu.aggregator.pipeline import L4Pipeline, PipelineConfig
+    from deepflow_tpu.aggregator.window import WindowConfig
+    from deepflow_tpu.datamodel.batch import FlowBatch
+
+    gen = SyntheticFlowGen(num_tuples=64, seed=9)
+
+    gen_records = {t: gen.records(256, t) for t in (9000, 9001, 9004)}
+
+    def run(cap):
+        pipe = L4Pipeline(PipelineConfig(
+            window=WindowConfig(capacity=1 << 12), batch_size=512,
+            batch_unique_cap=cap,
+        ))
+        rows = {}
+        for t in (9000, 9000, 9001, 9004):
+            for db in pipe.ingest(FlowBatch.from_records(gen_records[t])):
+                rows.update(_docbatch_to_dict(db))
+        for db in pipe.drain():
+            rows.update(_docbatch_to_dict(db))
+        return rows, pipe.counters
+
+    a, _ = run(None)
+    b, counters = run(128)
+    assert a.keys() == b.keys() and len(a) > 0
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k])
+    assert counters["prereduce_dropped"] == 0

@@ -129,3 +129,46 @@ def test_cli_reads_store(tmp_path, capsys):
     dfctl(["metrics", "--store", str(tmp_path), "application_1s"])
     out = json.loads(capsys.readouterr().out)
     assert out["rrt_avg"] == "derived"
+
+
+def test_server_discovery_plane_tick(tmp_path):
+    """K8s cloud source + agent genesis reports reconcile into the
+    server's ResourceDB on the leader tick; resource-change events land
+    in the event table; agents get an analyzer assignment."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_controller_plane import _k8s_objects
+
+    from deepflow_tpu.controller.cloud import KubernetesGather
+
+    cfg, _ = load_config(
+        {
+            "receiver": {"tcp_port": 0, "udp_port": 0},
+            "ingester": {"n_decoders": 1, "prefer_native": False},
+            "storage": {"writer_flush_s": 0.05},
+        }
+    )
+    srv = Server(cfg, lease_path=tmp_path / "lease").start()
+    try:
+        assert _wait(lambda: srv.election.is_leader(), timeout=10)
+        srv.add_cloud_source(KubernetesGather(_k8s_objects(pods=2), epc_id=7))
+        resp = srv.trisolaris.handle_sync(
+            {
+                "agent_id": 9, "config_rev": 0, "platform_version": 0,
+                "genesis": {"hostname": "bare-1", "interfaces": [
+                    {"mac": 5, "ips": ["172.16.0.4"]}]},
+            }
+        )
+        assert resp["analyzer_ip"]
+        did = srv.tick(now=T0)
+        assert did["resource_changes"] > 0
+        assert [r.name for r in srv.resources.list("pod_ns")] == ["prod"]
+        assert [r.name for r in srv.resources.list("host")] == ["bare-1"]
+        # change events flowed into the event table
+        srv.events.flush()
+        cols = srv.store.scan("event", "event", columns=["resource_type", "event_type"])
+        assert "pod" in set(str(s) for s in cols["resource_type"])
+    finally:
+        srv.stop()
