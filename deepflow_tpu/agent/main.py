@@ -38,7 +38,13 @@ from ..ingest.sender import UniformSender
 from ..utils.stats import StatsCollector
 from .bridge import emissions_to_flow_batch
 from .flow_map import FlowMap, FlowTimeouts
-from .policy import ACTION_DROP, ACTION_PCAP, PolicyLabeler, pcap_frames
+from .policy import (
+    ACTION_DROP,
+    ACTION_PCAP,
+    PolicyLabeler,
+    PolicyMeterAggregator,
+    pcap_frames,
+)
 from .l7.engine import L7Engine
 from .packet import CaptureFilter, parse_packets
 
@@ -85,6 +91,7 @@ class Agent:
         self.flow_aggr = MinuteAggr(batch_size=4 * c.batch_size)
         self.l4_throttle = ThrottlingQueue(c.l4_log_throttle)
 
+        self._default_senders = senders is None
         if senders is not None:
             self.senders = senders  # test seam: {msg_type: sender-like}
         else:
@@ -105,6 +112,9 @@ class Agent:
                 + ((MessageType.RAW_PCAP,) if c.acls else ())
             }
         self.policy = PolicyLabeler(list(c.acls)) if c.acls else None
+        self.policy_meters = (
+            PolicyMeterAggregator(agent_id=c.agent_id) if c.acls else None
+        )
         self.counters = {
             "batches": 0, "packets": 0, "docs_sent": 0, "logs_sent": 0,
             "packets_filtered": 0, "packets_dropped_policy": 0, "pcap_sent": 0,
@@ -125,11 +135,12 @@ class Agent:
                 buf, p = _compact(buf, p, ~filtered)
         if self.policy is not None:
             acl_id, action = self.policy.match(p)
+            self.policy_meters.update(p, acl_id, action, self.policy.last_forward)
             pcap_idx = np.nonzero(action == ACTION_PCAP)[0]
             if pcap_idx.size:
                 frames = pcap_frames(buf, p, pcap_idx, acl_id)
-                self._send(MessageType.RAW_PCAP, frames)
-                self.counters["pcap_sent"] += len(frames)
+                if self._send(MessageType.RAW_PCAP, frames):
+                    self.counters["pcap_sent"] += len(frames)
             dropped = action == ACTION_DROP
             if dropped.any():
                 self.counters["packets_dropped_policy"] += int(dropped.sum())
@@ -149,6 +160,11 @@ class Agent:
 
         # L4 tick at the batch's max second: emissions feed metrics + logs
         now = int(np.max(np.asarray(ts_s))) if len(np.asarray(ts_s)) else 0
+        if self.policy_meters is not None:
+            usage = self.policy_meters.flush(now)
+            if usage is not None:
+                # traffic_policy docs are minute-granularity
+                self._send_docs(usage, self.metrics.minute.flags)
         emissions = self.flow_map.tick(now)
         if emissions.size:
             self._ingest_l4(emissions)
@@ -177,10 +193,40 @@ class Agent:
         self._send(MessageType.METRICS, msgs)
         self.counters["docs_sent"] += db.size
 
-    def _send(self, mt: MessageType, msgs: list[bytes]) -> None:
+    def _send(self, mt: MessageType, msgs: list[bytes]) -> bool:
         s = self.senders.get(mt)
         if s is not None and msgs:
             s.send(msgs)
+            return True
+        return False
+
+    def apply_dynamic_config(self, cfg: dict) -> None:
+        """Apply a trisolaris-pushed dynamic config overlay. Today the
+        live-reloadable knobs are the ACL table ("acls": FlowAcl dicts —
+        the reference's flow_acls push) and the l4 log throttle."""
+        from .policy import acls_from_config
+
+        if "acls" in cfg:
+            acls = acls_from_config(cfg["acls"])
+            self.policy = PolicyLabeler(list(acls)) if acls else None
+            if acls and self.policy_meters is None:
+                self.policy_meters = PolicyMeterAggregator(agent_id=self.config.agent_id)
+            # a pushed PCAP ACL needs the RAW_PCAP lane even though the
+            # static config had none (default sender set is acl-gated)
+            if (
+                acls
+                and self._default_senders
+                and MessageType.RAW_PCAP not in self.senders
+            ):
+                c = self.config
+                self.senders[MessageType.RAW_PCAP] = UniformSender(
+                    list(c.servers), MessageType.RAW_PCAP,
+                    agent_id=c.agent_id, organization_id=c.organization_id,
+                    compression=c.compression,
+                )
+            self.counters["config_reloads"] = self.counters.get("config_reloads", 0) + 1
+        if "l4_log_throttle" in cfg:
+            self.l4_throttle.throttle = int(cfg["l4_log_throttle"])
 
     def ship_log(self, line: str, severity: int = 6) -> None:
         """Forward one agent log line to the server's AGENT_LOG lane
@@ -206,6 +252,10 @@ class Agent:
         emissions = self.flow_map.tick(1 << 31)
         if emissions.size:
             self._ingest_l4(emissions)
+        if self.policy_meters is not None:
+            usage = self.policy_meters.flush(1 << 31)
+            if usage is not None:
+                self._send_docs(usage, self.metrics.minute.flags)
         for flags, db in self.metrics.drain():
             self._send_docs(db, flags)
         for db in self.l7_metrics.drain():

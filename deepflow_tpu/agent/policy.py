@@ -136,6 +136,8 @@ class PolicyLabeler:
         first = np.argmax(hits, axis=0)  # lowest ACL index = priority
         acl_id = np.where(any_hit, self._ids[first], 0).astype(np.uint32)
         action = np.where(any_hit, self._actions[first], 0).astype(np.uint32)
+        # orientation of the winning ACL for the usage-doc tx/rx split
+        self.last_forward = (proto_ok & fwd)[first, np.arange(p.size)] & any_hit
 
         self.counters["matched"] += int(any_hit.sum())
         self.counters["dropped"] += int((action == ACTION_DROP).sum())
@@ -158,3 +160,98 @@ def pcap_frames(buf: np.ndarray, p: PacketBatch, idx: np.ndarray,
         pkt = buf[i, :ln].tobytes()
         out.append(struct.pack(">QQI", int(acl_id[i]), ts, len(pkt)) + pkt)
     return out
+
+
+class PolicyMeterAggregator:
+    """ACL usage docs — the policy doc path (collector.rs:440-487).
+
+    Packets matching an ACL accumulate per-(minute, acl_gid) UsageMeter
+    lanes; `flush()` emits traffic_policy-shaped documents (CodeId.ACL,
+    MeterId.USAGE) as a DocBatch carried in the FLOW_METER matrix (its
+    packet/byte lanes — USAGE_METER maps 1:1 onto Traffic columns,
+    datamodel/schema.py). tx = the ACL's forward orientation."""
+
+    INTERVAL = 60
+
+    def __init__(self, *, agent_id: int = 1):
+        self.agent_id = agent_id
+        self._acc: dict[tuple[int, int], np.ndarray] = {}  # (minute, acl) → [4]
+
+    def update(self, p: PacketBatch, acl_id: np.ndarray, action: np.ndarray,
+               forward: np.ndarray) -> None:
+        sel = (acl_id > 0) & (action != ACTION_DROP) & p.valid
+        if not sel.any():
+            return
+        minutes = (p.timestamp_s[sel] // self.INTERVAL).astype(np.int64)
+        acls = acl_id[sel].astype(np.int64)
+        fwd = forward[sel]
+        nbytes = p.packet_len[sel].astype(np.int64)
+        for key in np.unique(np.stack([minutes, acls], axis=1), axis=0):
+            m = (minutes == key[0]) & (acls == key[1])
+            row = self._acc.setdefault((int(key[0]), int(key[1])), np.zeros(4, np.int64))
+            row[0] += int((m & fwd).sum())           # packet_tx
+            row[1] += int((m & ~fwd).sum())          # packet_rx
+            row[2] += int(nbytes[m & fwd].sum())     # byte_tx
+            row[3] += int(nbytes[m & ~fwd].sum())    # byte_rx
+
+    def flush(self, now_s: int):
+        """Emit closed minutes (< current one) as a DocBatch, or None."""
+        from ..datamodel.code import CodeId, MeterId
+        from ..datamodel.schema import FLOW_METER, TAG_SCHEMA
+
+        cur_min = now_s // self.INTERVAL
+        done = [k for k in self._acc if k[0] < cur_min]
+        if not done:
+            return None
+        n = len(done)
+        tags = np.zeros((n, TAG_SCHEMA.num_fields), np.uint32)
+        meters = np.zeros((n, FLOW_METER.num_fields), np.float32)
+        ts = np.zeros((n,), np.uint32)
+        mi = FLOW_METER.index
+        for r, key in enumerate(sorted(done)):
+            minute, acl = key
+            row = self._acc.pop(key)
+            ts[r] = minute * self.INTERVAL
+            tags[r, TAG_SCHEMA.index("code_id")] = CodeId.ACL
+            tags[r, TAG_SCHEMA.index("meter_id")] = MeterId.USAGE
+            tags[r, TAG_SCHEMA.index("agent_id")] = self.agent_id
+            tags[r, TAG_SCHEMA.index("acl_gid")] = acl
+            meters[r, mi("packet_tx")] = row[0]
+            meters[r, mi("packet_rx")] = row[1]
+            meters[r, mi("byte_tx")] = row[2]
+            meters[r, mi("byte_rx")] = row[3]
+        from ..datamodel.batch import DocBatch
+
+        return DocBatch(
+            tags=tags, meters=meters, timestamp=ts,
+            valid=np.ones((n,), bool),
+            tag_schema=TAG_SCHEMA, meter_schema=FLOW_METER,
+        )
+
+
+_ACTION_NAMES = {
+    "none": ACTION_NONE, "npb": ACTION_NPB, "pcap": ACTION_PCAP,
+    "drop": ACTION_DROP,
+}
+
+
+def acls_from_config(spec: list[dict]) -> tuple[Acl, ...]:
+    """Trisolaris-pushed FlowAcl payload → Acl tuple. Each entry:
+    {"id": int, "action": "npb"|"pcap"|"drop"|"none", "src": cidr,
+     "dst": cidr, "src_ports": [lo, hi], "dst_ports": [lo, hi],
+     "protocol": int, "symmetric": bool} — all but id optional."""
+    out = []
+    for e in spec:
+        out.append(
+            Acl(
+                id=int(e["id"]),
+                action=_ACTION_NAMES.get(str(e.get("action", "none")).lower(), ACTION_NONE),
+                src=e.get("src", "0.0.0.0/0"),
+                dst=e.get("dst", "0.0.0.0/0"),
+                src_ports=tuple(e["src_ports"]) if e.get("src_ports") else None,
+                dst_ports=tuple(e["dst_ports"]) if e.get("dst_ports") else None,
+                protocol=int(e.get("protocol", 0)),
+                symmetric=bool(e.get("symmetric", True)),
+            )
+        )
+    return tuple(out)

@@ -33,9 +33,9 @@ L4 vs L7 is one code path (`_make_lanes(app=...)`) differing only in:
   * L7 docs carry l7_protocol / endpoint_hash / biz_type / time_span
     key columns.
 
-Omitted here: the ACL/UsageMeter policy docs (collector.rs:440-487) —
-they depend on the minute-granularity policy id_maps and are emitted by
-the policy module, not the per-flow fanout.
+Not emitted here: the ACL/UsageMeter policy docs (collector.rs:440-487)
+come from the policy module's own minute rollup
+(agent/policy.py PolicyMeterAggregator), not the per-flow fanout.
 """
 
 from __future__ import annotations

@@ -184,6 +184,18 @@ class CloudTask:
         self.last_error: Exception | None = None
         self.counters = {"polls": 0, "errors": 0}
 
+    def safe_poll(self):
+        """poll() with the loop's error stance: failures are recorded
+        (last_error, errors counter) and invalidate last_change so a
+        stale ChangeSet never counts as fresh discovery activity."""
+        try:
+            return self.poll()
+        except Exception as e:
+            self.last_error = e
+            self.last_change = None
+            self.counters["errors"] += 1
+            return None
+
     def poll(self):
         snap = self.source.snapshot()
         domain = self.source.domain
@@ -205,11 +217,7 @@ class CloudTask:
 
     def _loop(self):
         while not self._stop.is_set():
-            try:
-                self.poll()
-            except Exception as e:  # keep polling, but leave a trail
-                self.last_error = e
-                self.counters["errors"] += 1
+            self.safe_poll()
             self._stop.wait(self.interval_s)
 
     def stop(self):

@@ -74,6 +74,12 @@ class Recorder:
         deleted (recorder cache diff, recorder/cache/)."""
         cs = ChangeSet()
         desired = snapshot.get("resources", {})
+        # validate vinterface rows BEFORE touching anything: a malformed
+        # row (misspelled field) must reject the whole snapshot up
+        # front, never leave resources applied with the vif table stale
+        vifs = [
+            self.db._normalize_vif_row(v) for v in snapshot.get("vinterfaces", [])
+        ]
         with self._lock:
             owned = self._owned.setdefault(domain, {})
             for kind in KINDS:
@@ -104,9 +110,8 @@ class Recorder:
                     self.db.delete(kind, have.pop(uid))
                     cs.deleted.append((kind, uid))
 
-            vifs = snapshot.get("vinterfaces", [])
             if vifs != self._vifs.get(domain, []):
-                self._vifs[domain] = [dict(v) for v in vifs]
+                self._vifs[domain] = vifs
                 self._rebuild_vifs()
                 cs.vifs_changed = True
 

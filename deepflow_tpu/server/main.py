@@ -173,14 +173,7 @@ class Server:
             # Source errors are non-fatal (CloudTask._loop's stance) —
             # one flaky apiserver must not take the server down.
             for task in self.cloud_tasks:
-                try:
-                    task.poll()
-                except Exception as e:
-                    task.last_error = e
-                    task.counters["errors"] += 1
-                    # a stale ChangeSet must not keep counting as
-                    # fresh discovery activity while the source is down
-                    task.last_change = None
+                task.safe_poll()
             cs = self.recorder.reconcile(self.genesis.domain, self.genesis.snapshot())
             did["resource_changes"] = cs.total + sum(
                 t.last_change.total for t in self.cloud_tasks if t.last_change

@@ -167,3 +167,89 @@ def test_pcap_frames_roundtrip_through_real_ingester():
     assert int(cols["packet_len"][0]) == len(pkt)
     assert pkt[:6] == b"\x02\x00\x00\x00\x00\x01"
     ing.stop()
+
+
+def test_policy_usage_docs_traffic_policy():
+    """NPB-matched packets roll up into per-minute ACL usage docs
+    (collector.rs:440-487 policy doc path) that the server's metrics
+    table router places in traffic_policy.1m."""
+    from deepflow_tpu.datamodel.code import CodeId, MeterId
+    from deepflow_tpu.datamodel.schema import TAG_SCHEMA, USAGE_METER
+    from deepflow_tpu.server.metrics_tables import MetricsTableID, route_table_ids
+
+    sink = _Capture()
+    agent = Agent(
+        AgentConfig(acls=(Acl(id=12, action=ACTION_NPB, dst_ports=(443, 443)),)),
+        senders={MessageType.METRICS: sink},
+    )
+    t0 = 1_700_000_000 - (1_700_000_000 % 60)
+    pkts, ts = [], []
+    for i in range(6):
+        pkts.append(craft_tcp(A1, B1, 40000 + i, 443, payload=b"x" * 10))
+        ts.append(t0 + i)
+    for i in range(2):  # response direction
+        pkts.append(craft_tcp(B1, A1, 443, 40000 + i, payload=b"y" * 20))
+        ts.append(t0 + 10 + i)
+    buf, lengths, ts_s, ts_us = to_batch(pkts, ts, [0] * len(pkts), snap=256)
+    agent.step(buf, lengths, ts_s, ts_us)
+    # next minute's packet closes the window and flushes the usage doc
+    buf, lengths, ts_s, ts_us = to_batch(
+        [craft_tcp(A1, B1, 50000, 443, payload=b"z")], [t0 + 65], [0], snap=256
+    )
+    agent.step(buf, lengths, ts_s, ts_us)
+
+    assert agent.counters["docs_sent"] >= 1
+    # decode through the REAL server-side document decoder
+    from deepflow_tpu.ingest.codec import DocumentDecoder
+
+    decoded = DocumentDecoder().decode(sink.msgs)
+    assert int(MeterId.USAGE) in decoded, f"meters seen: {list(decoded)}"
+    batch = decoded[int(MeterId.USAGE)]
+    i = 0
+    assert int(batch.tags[i, TAG_SCHEMA.index("acl_gid")]) == 12
+    mi = USAGE_METER.index
+    assert batch.meters[i, mi("packet_tx")] == 6
+    assert batch.meters[i, mi("packet_rx")] == 2
+    assert batch.meters[i, mi("byte_tx")] > 0 and batch.meters[i, mi("byte_rx")] > 0
+    # the server-side router maps usage docs to traffic_policy.1m
+    tids = route_table_ids(
+        int(MeterId.USAGE),
+        batch.tags[:, TAG_SCHEMA.index("code_id")].astype(np.int64),
+        batch.flags,
+    )
+    assert int(tids[i]) == int(MetricsTableID.TRAFFIC_POLICY_1M)
+    agent.close()
+
+
+def test_acl_push_through_trisolaris():
+    """FlowAcl dicts pushed via a live TrisolarisService group config
+    reach the agent's labeler through AgentSyncClient (the reference's
+    flow_acls push path)."""
+    from deepflow_tpu.controller.resources import ResourceDB
+    from deepflow_tpu.controller.trisolaris import AgentSyncClient, TrisolarisService
+
+    db = ResourceDB()
+    svc = TrisolarisService(db)
+    try:
+        svc.set_group_config("default", {
+            "acls": [
+                {"id": 31, "action": "drop", "dst_ports": [23, 23]},
+                {"id": 32, "action": "npb", "src": "10.0.0.0/8"},
+            ],
+            "l4_log_throttle": 77,
+        })
+        client = AgentSyncClient([("127.0.0.1", svc.port)], 4)
+        assert client.sync_once()
+
+        agent = Agent(AgentConfig(), senders={})
+        assert agent.policy is None
+        agent.apply_dynamic_config(client.config)
+        assert agent.policy is not None and len(agent.policy.acls) == 2
+        assert agent.l4_throttle.throttle == 77
+
+        _, p = _batch([(A1, B1, 40000, 23, PROTO_TCP)])
+        acl_id, action = agent.policy.match(p)
+        assert list(acl_id) == [31] and list(action) == [ACTION_DROP]
+        agent.close()
+    finally:
+        svc.stop()
