@@ -33,6 +33,10 @@ def main():
     p.add_argument("--cpu", action="store_true")
     p.add_argument("--docs", type=int, default=200_000)
     p.add_argument("--frame-docs", type=int, default=256)
+    p.add_argument("--workers", type=int, default=4)
+    # frames spread across N agent ids — the receiver hash-fans by
+    # agent, so one lone agent would serialize onto one decode queue
+    p.add_argument("--agents", type=int, default=8)
     args = p.parse_args()
 
     from deepflow_tpu.aggregator.pipeline import L4Pipeline, PipelineConfig
@@ -61,7 +65,11 @@ def main():
     msgs = msgs[: args.docs]
     frames = []
     for i in range(0, len(msgs), args.frame_docs):
-        h = FlowHeader(msg_type=int(MessageType.METRICS), agent_id=1, organization_id=1)
+        h = FlowHeader(
+            msg_type=int(MessageType.METRICS),
+            agent_id=1 + (i // args.frame_docs) % args.agents,
+            organization_id=1,
+        )
         frames.append(encode_frame(h, msgs[i : i + args.frame_docs]))
     payload = b"".join(frames)
     print(f"prepared {len(msgs)} docs in {len(frames)} frames "
@@ -82,7 +90,7 @@ def main():
     writer = CountWriter()
     platform = ResourceDB().build_platform_table(1).build()
     ing = FlowMetricsIngester(
-        recv, writer, platform_state=platform, n_workers=1,
+        recv, writer, platform_state=platform, n_workers=args.workers,
         queue_capacity=1 << 15, prefer_native=not args.cpu,
     )
 

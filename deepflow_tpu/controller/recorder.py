@@ -54,6 +54,8 @@ class Recorder:
         self._next_id: dict[str, int] = {k: id_base for k in KINDS}
         # per-domain vinterface cache for cheap change detection
         self._vifs: dict[str, list] = {}
+        # persistence bookkeeping: save only when the id maps changed
+        self.dirty = False
         self.counters = {"reconciles": 0, "creates": 0, "updates": 0, "deletes": 0}
 
     # -- id pool --------------------------------------------------------
@@ -99,9 +101,13 @@ class Recorder:
                         cs.created.append((kind, uid))
                     else:
                         cur = self.db.get(kind, rid)
-                        if (
-                            cur is None
-                            or cur.name != spec.get("name", uid)
+                        if cur is None:
+                            # known uid, empty DB: the post-restart
+                            # re-materialization (ids loaded, rows not
+                            # persisted) — rebuild silently, no event
+                            self.db.put(kind, rid, spec.get("name", uid), **attrs)
+                        elif (
+                            cur.name != spec.get("name", uid)
                             or cur.attrs != attrs
                         ):
                             self.db.put(kind, rid, spec.get("name", uid), **attrs)
@@ -119,6 +125,8 @@ class Recorder:
             self.counters["creates"] += len(cs.created)
             self.counters["updates"] += len(cs.updated)
             self.counters["deletes"] += len(cs.deleted)
+            if cs.created or cs.deleted:
+                self.dirty = True  # the (uid → id) maps changed
 
         if self.event_sink is not None:
             now = int(time.time())
@@ -138,6 +146,48 @@ class Recorder:
                         }
                     )
         return cs
+
+    # -- persistence ----------------------------------------------------
+    # The reference's recorder writes to MySQL, so (domain, uid) → id
+    # survives restarts; tag dictionaries persisted by tagrecorder would
+    # alias onto re-allocated ids otherwise. Same guarantee here via a
+    # JSON snapshot the server saves on tick and loads on boot.
+    def save(self, path) -> None:
+        import json
+        import os
+
+        with self._lock:
+            doc = {
+                "next_id": dict(self._next_id),
+                "owned": {
+                    dom: {k: dict(uids) for k, uids in kinds.items()}
+                    for dom, kinds in self._owned.items()
+                },
+            }
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+        self.dirty = False
+
+    def load(self, path) -> bool:
+        import json
+        import os
+
+        if not os.path.exists(path):
+            return False
+        with open(path) as f:
+            doc = json.load(f)
+        with self._lock:
+            # never move an allocator backwards: a load racing local
+            # allocations (leader failover) must not re-issue live ids
+            for k, v in doc["next_id"].items():
+                self._next_id[k] = max(self._next_id.get(k, 0), int(v))
+            self._owned = {
+                dom: {k: {u: int(i) for u, i in uids.items()} for k, uids in kinds.items()}
+                for dom, kinds in doc["owned"].items()
+            }
+        return True
 
     def _rebuild_vifs(self) -> None:
         """Vinterfaces have no per-row identity in ResourceDB, so the

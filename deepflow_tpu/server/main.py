@@ -67,6 +67,17 @@ class Server:
         # plane once the event ingester is up (sink bound below).
         self._resource_events: list = []
         self.recorder = Recorder(self.resources, event_sink=self._resource_events.append)
+        # id stability across restarts (MySQL seat): without this, a
+        # rebooted recorder re-allocates ids and the persisted tag
+        # dictionaries alias onto the wrong resources
+        self._recorder_state_path = (
+            os.path.join(cfg.storage.root, "recorder_ids.json")
+            if cfg.storage.root
+            else None
+        )
+        if self._recorder_state_path:
+            self.recorder.load(self._recorder_state_path)
+        self._was_leader = False
         self.genesis = GenesisStore()
         self.balancer = AnalyzerBalancer()
         self._analyzer_ip = cfg.receiver.host or "127.0.0.1"
@@ -154,6 +165,11 @@ class Server:
     def tick(self, now: int | None = None) -> dict:
         now = int(time.time()) if now is None else now
         leader = self.election.is_leader() if self.election else True
+        if leader and not self._was_leader and self._recorder_state_path:
+            # promoted follower: re-read the id maps the previous leader
+            # saved, or the first reconcile would re-allocate live ids
+            self.recorder.load(self._recorder_state_path)
+        self._was_leader = leader
         did = {"leader": leader, "tagrecorder": False, "downsampled": 0, "platform": False}
         # enrichment follows resources, every node (the periodic
         # PlatformInfoTable refresh — not leader-gated in the reference)
@@ -180,6 +196,8 @@ class Server:
             )
             self._drain_resource_events()
             self.balancer.rebalance()
+            if self._recorder_state_path and self.recorder.dirty:
+                self.recorder.save(self._recorder_state_path)
         default_collector.tick()
         return did
 

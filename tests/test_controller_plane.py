@@ -198,3 +198,58 @@ def test_trisolaris_carries_genesis_and_analyzer():
         assert snap["vinterfaces"][0]["ips"] == ["172.16.0.9"]
     finally:
         svc.stop()
+
+
+def test_recorder_ids_stable_across_restart(tmp_path):
+    """(domain, uid) → id survives a save/load cycle, so tag
+    dictionaries persisted before a restart never alias onto
+    re-allocated ids (the reference's MySQL durability seat)."""
+    path = tmp_path / "recorder_ids.json"
+    db = ResourceDB()
+    rec = Recorder(db)
+    rec.reconcile("k8s", {"resources": {"pod": [
+        {"uid": "p/a", "name": "a"}, {"uid": "p/b", "name": "b"}]}})
+    ida = rec.id_of("k8s", "pod", "p/a")
+    rec.save(path)
+
+    # fresh process: load → same uid keeps its id; new uid gets a NEW id
+    db2 = ResourceDB()
+    rec2 = Recorder(db2)
+    assert rec2.load(path)
+    cs = rec2.reconcile("k8s", {"resources": {"pod": [
+        {"uid": "p/a", "name": "a"}, {"uid": "p/c", "name": "c"}]}})
+    assert rec2.id_of("k8s", "pod", "p/a") == ida
+    idc = rec2.id_of("k8s", "pod", "p/c")
+    assert idc not in (ida, rec.id_of("k8s", "pod", "p/b"))
+    # p/b was in the loaded state but absent from the snapshot → deleted
+    assert ("pod", "p/b") in cs.deleted
+
+
+def test_recorder_restart_no_update_storm_and_monotonic_ids(tmp_path):
+    """After a restart (ids loaded, DB empty) the first reconcile
+    silently re-materializes rows — no spurious update events — and a
+    late load can never move the allocator backwards."""
+    path = tmp_path / "ids.json"
+    db = ResourceDB()
+    rec = Recorder(db)
+    rec.reconcile("d", {"resources": {"pod": [{"uid": "u1", "name": "n1"}]}})
+    assert rec.dirty
+    rec.save(path)
+    assert not rec.dirty
+
+    events = []
+    db2 = ResourceDB()
+    rec2 = Recorder(db2, event_sink=events.append)
+    rec2.load(path)
+    cs = rec2.reconcile("d", {"resources": {"pod": [{"uid": "u1", "name": "n1"}]}})
+    assert cs.total == 0 and events == []  # no restart storm
+    assert db2.get("pod", rec2.id_of("d", "pod", "u1")).name == "n1"
+
+    # allocate past the snapshot, then load the OLD file: ids stay ahead
+    rec2.reconcile("d", {"resources": {"pod": [
+        {"uid": "u1", "name": "n1"}, {"uid": "u2", "name": "n2"}]}})
+    id2 = rec2.id_of("d", "pod", "u2")
+    rec2.load(path)
+    cs = rec2.reconcile("d", {"resources": {"pod": [
+        {"uid": "u1", "name": "n1"}, {"uid": "u3", "name": "n3"}]}})
+    assert rec2.id_of("d", "pod", "u3") > id2  # no duplicate ids
