@@ -235,6 +235,22 @@ class Agent:
         self._send(MessageType.AGENT_LOG, [f"<{8 + severity}>{line}".encode()])
 
     # -- drivers ---------------------------------------------------------
+    def run_live(self, interface: str = "lo", *, duration_s: float | None = None,
+                 snap: int = 192) -> dict:
+        """Live AF_PACKET capture → the same graph as replay (the
+        dispatcher seat when the container grants CAP_NET_RAW)."""
+        from .capture import AfPacketCapture
+
+        cap = AfPacketCapture(
+            interface, snap=snap, batch_size=self.config.batch_size
+        )
+        try:
+            for buf, lengths, ts_s, ts_us in cap.batches(duration_s=duration_s):
+                self.step(buf, lengths, ts_s, ts_us)
+        finally:
+            cap.close()
+        return dict(self.counters, capture=dict(cap.counters))
+
     def run_pcap(self, path, *, batch_size: int | None = None) -> dict:
         """Replay a capture file through the graph (the dispatcher seat —
         this container has no live AF_PACKET/XDP; replay is the source)."""

@@ -183,10 +183,16 @@ class Recorder:
             # allocations (leader failover) must not re-issue live ids
             for k, v in doc["next_id"].items():
                 self._next_id[k] = max(self._next_id.get(k, 0), int(v))
-            self._owned = {
-                dom: {k: {u: int(i) for u, i in uids.items()} for k, uids in kinds.items()}
-                for dom, kinds in doc["owned"].items()
-            }
+            # merge, don't replace: a locally-allocated (uid → id) that
+            # the snapshot predates must keep its id — replacing would
+            # re-issue a fresh id for a live uid (the aliasing this
+            # whole file exists to prevent). Local wins on conflict.
+            for dom, kinds in doc["owned"].items():
+                owned = self._owned.setdefault(dom, {})
+                for kind, uids in kinds.items():
+                    have = owned.setdefault(kind, {})
+                    for uid, rid in uids.items():
+                        have.setdefault(uid, int(rid))
         return True
 
     def _rebuild_vifs(self) -> None:

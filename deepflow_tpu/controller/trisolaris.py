@@ -49,6 +49,9 @@ class TrisolarisService:
         self.genesis = genesis
         self.balancer = balancer
         self._groups: dict[str, AgentGroupConfig] = {"default": AgentGroupConfig()}
+        # operator-visible trail of what the config migrator renamed on
+        # the most recent push (read via the debug server / CLI)
+        self.migration_notes: list[str] = []
         self._agent_group: dict[int, str] = {}
         self.agents: dict[int, dict] = {}  # liveness registry
         self._lock = threading.Lock()
@@ -67,6 +70,12 @@ class TrisolarisService:
 
     # -- config management (REST/agent-group seat) ----------------------
     def set_group_config(self, group: str, config: dict) -> int:
+        # normalize any supported config generation on the way in
+        # (agent_config migrator seat) so agents always see the flat
+        # canonical schema regardless of what the operator wrote
+        from ..utils.agent_config import migrate_agent_config
+
+        config, self.migration_notes = migrate_agent_config(config)
         with self._lock:
             g = self._groups.setdefault(group, AgentGroupConfig())
             g.config = dict(config)

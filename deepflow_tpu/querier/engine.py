@@ -25,7 +25,7 @@ from .metrics import expand, list_metrics
 from .sqlparse import BinOp, Func, Ident, InList, Literal, Query, SQLError, UnaryOp, parse
 from .translation import Translator
 
-_AGG_FUNCS = {"sum", "max", "min", "avg", "count", "uniq"}
+_AGG_FUNCS = {"sum", "max", "min", "avg", "count", "uniq", "percentile"}
 
 
 @dataclasses.dataclass
@@ -299,6 +299,24 @@ class _AggCtx:
             return np.asarray(
                 jax.ops.segment_sum(np.ones(len(self.gid), np.float32), self.gid, self.ngroups)
             )
+        if e.name == "percentile":
+            # Percentile(col, p) — CK quantile analog, per group
+            if len(e.args) != 2:
+                raise SQLError("percentile() takes (column, p)")
+            v = np.asarray(self.row.eval(e.args[0])).astype(np.float64)
+            p = float(np.asarray(self.row.eval(e.args[1])).reshape(-1)[0])
+            if not 0 <= p <= 100:
+                raise SQLError(f"percentile p out of range: {p}")
+            out = np.zeros(self.ngroups, np.float64)
+            order = np.argsort(self.gid, kind="stable")
+            sg = self.gid[order]
+            sv = v[order]
+            starts = np.searchsorted(sg, np.arange(self.ngroups))
+            ends = np.searchsorted(sg, np.arange(self.ngroups) + 1)
+            for g in range(self.ngroups):
+                if ends[g] > starts[g]:
+                    out[g] = np.percentile(sv[starts[g]:ends[g]], p)
+            return out
         if len(e.args) != 1:
             raise SQLError(f"{e.name}() takes one argument")
         v = np.asarray(self.row.eval(e.args[0]))

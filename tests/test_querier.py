@@ -232,3 +232,20 @@ def test_not_precedence(store, raw):
     eng = QueryEngine(store)
     r = eng.execute("SELECT Count() AS c FROM application.1s WHERE NOT tap_side = 1")
     assert r.values["c"][0] == (raw["tap_side"] != 1).sum()
+
+
+def test_percentile_aggregate(store, raw):
+    """Percentile(col, p) — the CK quantile seat the reference's
+    latency panels use — checked against numpy per group."""
+    eng = QueryEngine(store)
+    r = eng.execute(
+        "SELECT app_service, Percentile(rrt_sum, 50) AS p50, "
+        "Percentile(rrt_sum, 95) AS p95 "
+        "FROM application.1s GROUP BY app_service ORDER BY app_service"
+    )
+    assert r.rows >= 1
+    for i, svc in enumerate(r.values["app_service"]):
+        sel = raw["app_service"] == svc
+        assert r.values["p50"][i] == pytest.approx(np.percentile(raw["rrt_sum"][sel], 50), rel=1e-6)
+        assert r.values["p95"][i] == pytest.approx(np.percentile(raw["rrt_sum"][sel], 95), rel=1e-6)
+        assert r.values["p95"][i] >= r.values["p50"][i]

@@ -78,3 +78,65 @@ def test_config_null_keeps_default():
     cfg, unknown = load_config({"receiver": {"tcp_port": None}, "storage": {"root": None}})
     assert cfg.receiver.tcp_port == 20033
     assert cfg.storage.root == ""
+
+
+def test_agent_config_migrator_generations():
+    """Old flat trident keys and current nested sections both normalize
+    to the canonical flat schema (agent_config/migrator.go seat), with
+    every rename reported."""
+    from deepflow_tpu.utils.agent_config import migrate_agent_config
+
+    old_gen = {
+        "vtap_id": 7,
+        "tap_interface_regex": "eth.*",
+        "l4_log_collect_nps_threshold": 5000,
+        "flow_count_limit": 65536,
+        "custom_knob": 3,  # unknown keys survive
+    }
+    cfg, notes = migrate_agent_config(old_gen)
+    assert cfg["agent_id"] == 7
+    assert cfg["capture_interface_regex"] == "eth.*"
+    assert cfg["l4_log_throttle"] == 5000
+    assert cfg["flow_capacity"] == 65536
+    assert cfg["custom_knob"] == 3
+    assert any("upgraded" in n for n in notes)
+
+    new_gen = {
+        "inputs": {"cbpf": {"af_packet": {"interface_regex": "ens.*"}}},
+        "processors": {"flow_log": {"throttles": {"l4_throttle": 900}}},
+        "flow_acls": [{"id": 1, "action": "drop"}],
+    }
+    cfg2, _ = migrate_agent_config(new_gen)
+    assert cfg2["capture_interface_regex"] == "ens.*"
+    assert cfg2["l4_log_throttle"] == 900
+    assert cfg2["acls"] == [{"id": 1, "action": "drop"}]
+
+
+def test_trisolaris_migrates_group_config():
+    """Group-config pushes normalize through the migrator, so an
+    old-generation YAML pushed by an operator reaches agents in the
+    canonical flat schema."""
+    from deepflow_tpu.controller.resources import ResourceDB
+    from deepflow_tpu.controller.trisolaris import TrisolarisService
+
+    svc = TrisolarisService(ResourceDB())
+    try:
+        svc.set_group_config("default", {"l4_log_collect_nps_threshold": 1234})
+        resp = svc.handle_sync({"agent_id": 1, "config_rev": 0, "platform_version": 0})
+        assert resp["config"]["l4_log_throttle"] == 1234
+    finally:
+        svc.stop()
+
+
+def test_agent_config_migrator_canonical_wins():
+    """An explicit canonical key beats a leftover legacy alias no
+    matter the dict order."""
+    from deepflow_tpu.utils.agent_config import migrate_agent_config
+
+    for doc in (
+        {"l4_log_throttle": 700, "l4_log_collect_nps_threshold": 5000},
+        {"l4_log_collect_nps_threshold": 5000, "l4_log_throttle": 700},
+    ):
+        cfg, notes = migrate_agent_config(doc)
+        assert cfg["l4_log_throttle"] == 700, doc
+        assert any("overrides" in n for n in notes)
