@@ -290,12 +290,15 @@ def config5(quick: bool):
     batch = per_dev * n_dev  # "64-agent firehose" sharded over the mesh
     gen = SyntheticFlowGen(num_tuples=10_000, seed=4)
     t0s = 1_700_000_000
-    fb = gen.flow_batch(batch, t0s)
-    wm.ingest(fb.tags, fb.meters, fb.valid)  # warm compiles
+    # warm ALL the compile paths (step, window_close, fold, flush) —
+    # the first advancing window pays them; timing must not
+    for wt in (t0s, t0s + 60, t0s + 61, t0s + 65):
+        fb = gen.flow_batch(batch, wt)
+        wm.ingest(fb.tags, fb.meters, fb.valid)
     iters = 4 if quick else 12
     # pre-generate outside the timed loop — synthetic data creation is
     # not part of the pipeline under test
-    batches = [gen.flow_batch(batch, t0s + 60 + i) for i in range(iters)]
+    batches = [gen.flow_batch(batch, t0s + 70 + i) for i in range(iters)]
     _ = np.asarray(wm.sketches.hll.ravel()[:1])  # true sync (PERF.md §6)
     t0 = time.perf_counter(); _ = np.asarray(wm.sketches.hll.ravel()[:1])
     fetch_base = time.perf_counter() - t0

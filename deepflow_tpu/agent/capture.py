@@ -61,7 +61,14 @@ class AfPacketCapture:
                     self.counters["truncated"] += 1
                 # keep the ORIGINAL length: packet_len feeds flow byte
                 # meters; the snap only bounds parse bytes (to_batch
-                # makes the same distinction for replay)
+                # makes the same distinction for replay — not reused
+                # here because it needs full frames retained, and a live
+                # source must bound buffered bytes at snap per frame)
+                if not frames:
+                    # arm the deadline from the FIRST frame of a batch,
+                    # or an idle gap longer than flush_ms would flush
+                    # every subsequent packet as its own 1-frame batch
+                    flush_at = now + self.flush_ms / 1e3
                 frames.append((data[: self.snap], len(data)))
                 stamps.append(now)
             except socket.timeout:
@@ -71,7 +78,6 @@ class AfPacketCapture:
             if frames and (len(frames) >= self.batch_size or time.time() >= flush_at):
                 yield self._pack(frames, stamps)
                 frames, stamps = [], []
-                flush_at = time.time() + self.flush_ms / 1e3
         if frames:
             yield self._pack(frames, stamps)
 

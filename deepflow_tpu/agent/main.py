@@ -249,7 +249,10 @@ class Agent:
                 self.step(buf, lengths, ts_s, ts_us)
         finally:
             cap.close()
-        return dict(self.counters, capture=dict(cap.counters))
+        # drain like run_pcap: open flows + buffered windows must flush
+        # when a bounded capture ends, or the session tail is lost
+        stats = self.drain()
+        return dict(stats, capture=dict(cap.counters))
 
     def run_pcap(self, path, *, batch_size: int | None = None) -> dict:
         """Replay a capture file through the graph (the dispatcher seat —

@@ -186,13 +186,25 @@ class Recorder:
             # merge, don't replace: a locally-allocated (uid → id) that
             # the snapshot predates must keep its id — replacing would
             # re-issue a fresh id for a live uid (the aliasing this
-            # whole file exists to prevent). Local wins on conflict.
+            # whole file exists to prevent). Local wins on conflict,
+            # and a loaded id already bound to a DIFFERENT local uid of
+            # the same kind is skipped — that uid re-allocates fresh on
+            # the next reconcile instead of two uids sharing one id.
+            used: dict[str, set] = {}
+            for kinds in self._owned.values():
+                for kind, uids in kinds.items():
+                    used.setdefault(kind, set()).update(uids.values())
             for dom, kinds in doc["owned"].items():
                 owned = self._owned.setdefault(dom, {})
                 for kind, uids in kinds.items():
                     have = owned.setdefault(kind, {})
+                    taken = used.setdefault(kind, set())
                     for uid, rid in uids.items():
-                        have.setdefault(uid, int(rid))
+                        rid = int(rid)
+                        if uid in have or rid in taken:
+                            continue
+                        have[uid] = rid
+                        taken.add(rid)
         return True
 
     def _rebuild_vifs(self) -> None:

@@ -81,6 +81,7 @@ class ShardedPipeline:
         self.config = config
         self.n_devices = mesh.devices.size
         self.axes = tuple(mesh.axis_names)  # ("host", "chip")
+        self._tag_names: tuple | None = None  # fixed on first step()
         self._step = self._build_step()
         self._fold = self._build_fold()
         self._close = self._build_window_close()
@@ -124,11 +125,15 @@ class ShardedPipeline:
         t_idx = TAG_SCHEMA.index
         m_idx = FLOW_METER.index
 
-        def device_step(stash, acc, offset, sk, tags, meters, valid):
-            # block shapes: stash [1, S, ...], tags {f: [1, n]}, ...
+        def device_step(stash, acc, offset, sk, tag_mat, meters, valid):
+            # block shapes: stash [1, S, ...], tag_mat [1, T, n] — one
+            # packed matrix, not a dict of columns: every pytree leaf is
+            # a separate host→device upload through the accelerator
+            # tunnel (~tens of ms latency EACH), so ~25 tag columns per
+            # step cost seconds; packed, the step ships 3 arrays total
             stash1 = jax.tree.map(lambda x: x[0], stash)
             acc1 = jax.tree.map(lambda x: x[0], acc)
-            tags1 = {k: v[0] for k, v in tags.items()}
+            tags1 = {k: tag_mat[0, i] for i, k in enumerate(self._tag_names)}
             meters1, valid1 = meters[0], valid[0]
 
             new_stash, new_acc = base_append(stash1, acc1, offset, tags1, meters1, valid1)
@@ -197,10 +202,19 @@ class ShardedPipeline:
         def shard_batch(x):
             return x.reshape((d, -1) + x.shape[1:])
 
-        tags = {k: shard_batch(jnp.asarray(v)) for k, v in tags.items()}
+        if self._tag_names is None:
+            self._tag_names = tuple(sorted(tags))
+        # pack the ~25 tag columns into ONE upload (see device_step)
+        mat = np.stack(
+            [np.asarray(tags[k], dtype=np.uint32) for k in self._tag_names]
+        )  # [T, D*n]
+        t, total = mat.shape
+        tag_mat = jnp.asarray(
+            np.ascontiguousarray(mat.reshape(t, d, total // d).transpose(1, 0, 2))
+        )  # [D, T, n]
         meters = shard_batch(jnp.asarray(meters))
         valid = shard_batch(jnp.asarray(valid))
-        return self._step(stash, acc, jnp.int32(offset), sketches, tags, meters, valid)
+        return self._step(stash, acc, jnp.int32(offset), sketches, tag_mat, meters, valid)
 
     def fold(self, stash, acc):
         """Amortized per-device sort+reduce of accumulated rows into the

@@ -62,15 +62,19 @@ def migrate_agent_config(doc: dict) -> tuple[dict, list[str]]:
     flat = _flatten(doc or {})
     out: dict = {}
     notes: list[str] = []
-    # pass 1: renamed legacy/nested aliases
-    for path, value in flat.items():
-        if path in _RENAMES:
-            target = _RENAMES[path]
-            if target in out and out[target] != value:
-                notes.append(f"conflict on {target!r}: keeping {path!r}")
-            out[target] = value
-            if target != path:
-                notes.append(f"{path!r} upgraded to {target!r}")
+    # pass 1: renamed aliases, walked in _RENAMES declaration order —
+    # within one canonical target the older-generation key is declared
+    # first, so when BOTH generations appear the newer alias wins
+    # deterministically (never YAML key order)
+    for path, target in _RENAMES.items():
+        if path not in flat:
+            continue
+        value = flat[path]
+        if target in out and out[target] != value:
+            notes.append(f"conflict on {target!r}: newer alias {path!r} wins")
+        out[target] = value
+        if target != path:
+            notes.append(f"{path!r} upgraded to {target!r}")
     # pass 2: canonical / unknown keys — an explicit canonical key
     # deterministically WINS over any leftover alias (dict order must
     # never decide which value an agent receives)

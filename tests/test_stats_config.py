@@ -140,3 +140,18 @@ def test_agent_config_migrator_canonical_wins():
         cfg, notes = migrate_agent_config(doc)
         assert cfg["l4_log_throttle"] == 700, doc
         assert any("overrides" in n for n in notes)
+
+
+def test_agent_config_migrator_alias_precedence_deterministic():
+    """When both generations of an alias appear, the newer one wins
+    regardless of YAML key order."""
+    from deepflow_tpu.utils.agent_config import migrate_agent_config
+
+    for doc in (
+        {"flow_count_limit": 1000,
+         "processors": {"flow_log": {"tunning": {"concurrent_flow_limit": 2000}}}},
+        {"processors": {"flow_log": {"tunning": {"concurrent_flow_limit": 2000}}},
+         "flow_count_limit": 1000},
+    ):
+        cfg, notes = migrate_agent_config(doc)
+        assert cfg["flow_capacity"] == 2000, doc
