@@ -120,23 +120,39 @@ def groupby_reduce(
     # One row-gather moves all M meter lanes of a row at once.
     meters_rows = jnp.take(meters_t.T, perm, axis=0)  # [N, M]
 
-    reduced_cols: list = [None] * m
-    if sum_cols.size:
-        part = jax.ops.segment_sum(
-            meters_rows[:, sum_cols], seg_id, num_segments=cap, indices_are_sorted=True
-        )
-        for j, c in enumerate(sum_cols):
-            reduced_cols[int(c)] = part[:, j]
-    if max_cols.size:
-        part = jax.ops.segment_max(
-            meters_rows[:, max_cols], seg_id, num_segments=cap, indices_are_sorted=True
-        )
+    # Full-width segment ops + per-column select, NOT subset-indexed
+    # ops: `meters_rows[:, sum_cols]` materializes a strided copy of
+    # [N, |subset|] before each op, which costs more than running the
+    # op over all M lanes and discarding the unwanted half (measured
+    # ~16% off the whole fold at 588k rows — PERF.md §7b follow-up).
+    if m:
         # (segment_max yields -inf for empty segments; the seg_valid mask
         # below zeroes those columns, so no isfinite rewrite — it would
         # also mask NaNs from genuinely corrupt meters.)
-        for j, c in enumerate(max_cols):
-            reduced_cols[int(c)] = part[:, j]
-    out_meters = jnp.stack(reduced_cols) if m else jnp.zeros((0, cap), meters_t.dtype)
+        ps = (
+            jax.ops.segment_sum(
+                meters_rows, seg_id, num_segments=cap, indices_are_sorted=True
+            )
+            if sum_cols.size
+            else None
+        )
+        pm = (
+            jax.ops.segment_max(
+                meters_rows, seg_id, num_segments=cap, indices_are_sorted=True
+            )
+            if max_cols.size
+            else None
+        )
+        if pm is None:
+            out_meters = ps.T
+        elif ps is None:
+            out_meters = pm.T
+        else:
+            is_sum = np.zeros((m,), bool)
+            is_sum[sum_cols] = True
+            out_meters = jnp.where(jnp.asarray(is_sum)[None, :], ps, pm).T  # [M, cap]
+    else:
+        out_meters = jnp.zeros((0, cap), meters_t.dtype)
 
     # First sorted position of each kept segment (head positions), via a
     # segment_min instead of a second full sort.

@@ -22,8 +22,10 @@ from __future__ import annotations
 _RENAMES = {
     # identity / control plane
     "vtap_id": "agent_id",
-    "global.communication.controller_ip": "servers",
+    # declaration order matters: within one target the OLDER generation
+    # comes first so the newer alias wins conflicts (pass-1 invariant)
     "controller_ips": "servers",
+    "global.communication.controller_ip": "servers",
     # resource shape
     "flow_count_limit": "flow_capacity",
     "processors.flow_log.tunning.concurrent_flow_limit": "flow_capacity",

@@ -155,3 +155,13 @@ def test_agent_config_migrator_alias_precedence_deterministic():
     ):
         cfg, notes = migrate_agent_config(doc)
         assert cfg["flow_capacity"] == 2000, doc
+
+
+def test_agent_config_servers_alias_precedence():
+    from deepflow_tpu.utils.agent_config import migrate_agent_config
+
+    cfg, _ = migrate_agent_config({
+        "controller_ips": ["10.0.0.1"],
+        "global": {"communication": {"controller_ip": ["10.0.0.2"]}},
+    })
+    assert cfg["servers"] == ["10.0.0.2"]  # newer generation wins
