@@ -43,12 +43,12 @@ from deepflow_tpu.ingest.replay import SyntheticFlowGen
 TARGET = 50e6  # records/sec/chip north star
 
 # Measured-safe shapes (PERF.md §7, 2026-07-30 on-chip): compile+first
-# ~100 s at these sizes, steady 14.8 M rec/s at 512k / 16.8 M at 1M.
+# ~105 s at these sizes, steady 21.3 M rec/s at the 2M batch.
 # The fold sorts CAPACITY + ACCUM_BATCHES×4×UNIQUE_CAP rows (262k here);
 # the appends sort BATCH raw rows. UNIQUE_CAP bounds per-batch unique
 # keys (3x headroom over the 10k-tuple workload); overflow is shed and
 # counted, never silent.
-BATCH = int(os.environ.get("BENCH_BATCH", 1 << 20))  # flows per step
+BATCH = int(os.environ.get("BENCH_BATCH", 1 << 21))  # flows per step
 CAPACITY = int(os.environ.get("BENCH_CAPACITY", 1 << 16))  # stash segments
 ACCUM_BATCHES = int(os.environ.get("BENCH_ACCUM_BATCHES", 2))
 UNIQUE_CAP = int(os.environ.get("BENCH_UNIQUE_CAP", 1 << 15))
