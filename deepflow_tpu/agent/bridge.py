@@ -22,8 +22,14 @@ from .flow_map import CLOSE_NONE, CLOSE_TIMEOUT
 _M = FLOW_METER.index
 
 
-def emissions_to_flow_batch(b: FlowLogBatch, *, epc0: int = 0, epc1: int = 0) -> FlowBatch:
-    """L4_FLOW_LOG emission rows → metrics-path FlowBatch."""
+def emissions_to_flow_batch(b: FlowLogBatch, *, epc0: int = 0, epc1: int = 0,
+                            possible=None) -> FlowBatch:
+    """L4_FLOW_LOG emission rows → metrics-path FlowBatch.
+
+    `possible`: optional PossibleHostTable (agent/possible.py). When
+    given, is_active_host0/1 come from observed-traffic activity
+    instead of the all-active default (the quadruple generator's
+    possible_host consult, quadruple_generator.rs:342)."""
     assert b.schema is L4_FLOW_LOG
     s = b.schema
     n = b.size
@@ -46,8 +52,28 @@ def emissions_to_flow_batch(b: FlowLogBatch, *, epc0: int = 0, epc1: int = 0) ->
     tags["l7_protocol"] = ic("l7_protocol").astype(np.uint32)
     tags["direction0"][:] = int(Direction.CLIENT_TO_SERVER)
     tags["direction1"][:] = int(Direction.SERVER_TO_CLIENT)
-    tags["is_active_host0"][:] = 1
-    tags["is_active_host1"][:] = 1
+    if possible is None:
+        tags["is_active_host0"][:] = 1
+        tags["is_active_host1"][:] = 1
+    else:
+        from .possible import _hash_ips
+
+        valid_rows = np.asarray(b.valid, bool)
+        ts_valid = tags["timestamp"][valid_rows]
+        now = int(ts_valid.max()) if ts_valid.size else 0
+        ip0 = np.stack([tags[f"ip0_w{w}"] for w in range(4)], axis=1)
+        ip1 = np.stack([tags[f"ip1_w{w}"] for w in range(4)], axis=1)
+        k0, k1 = _hash_ips(ip0), _hash_ips(ip1)  # hash once per side
+        # an endpoint that transmitted in this flow is active by
+        # observation; the table remembers it across flows/windows.
+        # Invalid padding rows must neither stamp the table nor move
+        # the clock.
+        sent0 = valid_rows & (ic("packet_tx").astype(np.int64) > 0)
+        sent1 = valid_rows & (ic("packet_rx").astype(np.int64) > 0)
+        possible.add_keys(k0[sent0], now)
+        possible.add_keys(k1[sent1], now)
+        tags["is_active_host0"] = possible.check_keys(k0, now).astype(np.uint32)
+        tags["is_active_host1"] = possible.check_keys(k1, now).astype(np.uint32)
 
     meters = np.zeros((n, FLOW_METER.num_fields), np.float32)
     for src, dst in (

@@ -45,6 +45,7 @@ from .policy import (
     PolicyMeterAggregator,
     pcap_frames,
 )
+from .possible import PossibleHostTable
 from .l7.engine import L7Engine
 from .packet import CaptureFilter, parse_packets
 
@@ -64,6 +65,10 @@ class AgentConfig:
     # policy plane (labeler.rs seat): ACLs in priority order; DROP
     # removes packets pre-FlowMap, PCAP ships RAW_PCAP frames
     acls: tuple = ()
+    # possible-host activity tracking (utils/possible_host.rs seat):
+    # when on, is_active_host comes from observed traffic instead of
+    # the all-active default, enabling inactive-IP aggregation
+    track_host_activity: bool = False
 
 
 def _compact(buf: np.ndarray, p, retain: np.ndarray):
@@ -115,6 +120,7 @@ class Agent:
         self.policy_meters = (
             PolicyMeterAggregator(agent_id=c.agent_id) if c.acls else None
         )
+        self.possible_hosts = PossibleHostTable() if c.track_host_activity else None
         self.counters = {
             "batches": 0, "packets": 0, "docs_sent": 0, "logs_sent": 0,
             "packets_filtered": 0, "packets_dropped_policy": 0, "pcap_sent": 0,
@@ -175,7 +181,7 @@ class Agent:
         """Emission rows → dual-granularity metric docs + minute flow
         logs. Chunked: a drain tick can emit more rows than one pipeline
         batch (the stash flushes whole windows at once)."""
-        fb = emissions_to_flow_batch(emissions)
+        fb = emissions_to_flow_batch(emissions, possible=self.possible_hosts)
         bs = self.config.batch_size
         for off in range(0, fb.size, bs):
             chunk = FlowBatch(

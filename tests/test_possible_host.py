@@ -1,0 +1,60 @@
+"""Possible-host activity map (utils/possible_host.rs seat): batch
+add/check with lease aging, wired through the bridge's is_active_host
+columns."""
+
+import numpy as np
+
+from deepflow_tpu.agent.possible import PossibleHostTable
+
+
+def _ips(*last_words):
+    return np.array([[0, 0, 0, w] for w in last_words], np.uint32)
+
+
+def test_add_check_and_lease_aging():
+    t = PossibleHostTable(capacity_pow=10, lease_s=100)
+    t.add(_ips(1, 2, 3), now_s=1000)
+    assert list(t.check(_ips(1, 2, 3, 4), now_s=1010)) == [True, True, True, False]
+    # within lease at 1099, expired at 1101
+    assert list(t.check(_ips(1), now_s=1099)) == [True]
+    assert list(t.check(_ips(1), now_s=1101)) == [False]
+    # refresh renews the lease
+    t.add(_ips(1), now_s=1101)
+    assert list(t.check(_ips(1), now_s=1200)) == [True]
+
+
+def test_collisions_only_false_activate():
+    """A full table may falsely mark hosts active (shared slots), never
+    falsely INACTIVE for a recently-added host."""
+    t = PossibleHostTable(capacity_pow=4, probes=2, lease_s=1000)
+    rng = np.random.default_rng(0)
+    ips = rng.integers(0, 1 << 30, (200, 4)).astype(np.uint32)
+    t.add(ips, now_s=50)
+    # the LAST added batch's newest-wins slots must check positive for
+    # at least the most recent inserts (probe-0 overwrite)
+    recent = ips[-8:]
+    t.add(recent, now_s=60)
+    assert t.check(recent, now_s=60).sum() >= 6
+
+
+def test_bridge_uses_activity_table():
+    from deepflow_tpu.agent.flow_map import FlowMap
+    from deepflow_tpu.agent.bridge import emissions_to_flow_batch
+    from deepflow_tpu.agent.packet import craft_tcp, parse_packets, to_batch, TCP_SYN, TCP_ACK, TCP_PSH
+
+    fm = FlowMap(capacity=1 << 8, batch_size=256)
+    pkts = [
+        craft_tcp(0x0A000001, 0x0A000002, 40000, 80, flags=TCP_ACK | TCP_PSH, payload=b"x"),
+        craft_tcp(0x0A000002, 0x0A000001, 80, 40000, flags=TCP_ACK | TCP_PSH, payload=b"y"),
+    ]
+    buf, lengths, ts_s, ts_us = to_batch(pkts, [100, 100], [0, 1000], snap=256)
+    fm.inject(parse_packets(buf, lengths, ts_s, ts_us))
+    em = fm.tick(1 << 30)
+    assert em.size
+
+    table = PossibleHostTable()
+    fb = emissions_to_flow_batch(em, possible=table)
+    # both endpoints transmitted → both observed-active
+    assert fb.tags["is_active_host0"][: em.size].all()
+    assert fb.tags["is_active_host1"][: em.size].all()
+    assert table.counters["added"] >= 2
