@@ -223,6 +223,9 @@ class AgentSyncClient:
         self.last_success: float | None = None
         self.escaped = False
         self.agent_version = ""
+        # ingester this agent ships to (balancer assignment in the sync
+        # response; sticky server-side, kept across escapes here)
+        self.analyzer_ip: str | None = None
         # NTP diff vs controller clock (µs; trident's NTP-over-session)
         self.ntp_offset_us = 0
         self.pending_upgrade: dict | None = None
@@ -271,6 +274,8 @@ class AgentSyncClient:
             mid_us = (t_send + t_recv) / 2 * 1_000_000
             self.ntp_offset_us = int(resp["server_time_us"] - mid_us)
         self.pending_upgrade = resp.get("upgrade")
+        if resp.get("analyzer_ip"):
+            self.analyzer_ip = resp["analyzer_ip"]
         self.config_rev = resp["config_rev"]
         self.platform_version = resp["platform_version"]
         self.last_success = now

@@ -9,14 +9,17 @@ from deepflow_tpu.agent.l7.parsers import _PARSERS, infer_protocol, parse_payloa
 from deepflow_tpu.ingest.codec import DocumentDecoder
 from deepflow_tpu.ingest.framing import FrameReassembler
 
-RNG = np.random.default_rng(0xDF)
+def _rng():
+    # per-test RNG: a failure reproduces identically whether the test
+    # runs alone or in the full file
+    return np.random.default_rng(0xDF)
 
 
-def _blobs(n, max_len=512):
+def _blobs(rng, n, max_len=512):
     out = []
     for _ in range(n):
-        ln = int(RNG.integers(0, max_len))
-        out.append(RNG.integers(0, 256, ln, dtype=np.uint8).tobytes())
+        ln = int(rng.integers(0, max_len))
+        out.append(rng.integers(0, 256, ln, dtype=np.uint8).tobytes())
     return out
 
 
@@ -24,7 +27,7 @@ def test_l7_parsers_never_raise_on_random_bytes():
     """Every registered parser's check AND parse must tolerate
     arbitrary payloads — a raise aborts the engine's whole capture
     batch (engine._one_packet has no per-parser try)."""
-    blobs = _blobs(300)
+    blobs = _blobs(_rng(), 300)
     for proto, check, parse in list(_PARSERS):
         for payload in blobs:
             try:
@@ -59,6 +62,7 @@ def test_l7_parsers_never_raise_on_mutated_real_payloads():
         _bolt_request(), _brpc_request(), _tars_request(), _someip(0x00),
         _pulsar(6), b"GET /x HTTP/1.1\r\nHost: a\r\n\r\n",
     ]
+    RNG = _rng()
     for seed in seeds:
         arr = np.frombuffer(seed, np.uint8).copy()
         for _ in range(60):
@@ -72,15 +76,15 @@ def test_l7_parsers_never_raise_on_mutated_real_payloads():
 
 def test_document_decoder_counts_garbage():
     dec = DocumentDecoder()
-    out = dec.decode(_blobs(200, max_len=256))
-    # everything is junk → no batches, errors counted, no raise
+    out = dec.decode(_blobs(_rng(), 200, max_len=256))
+    # everything is junk → errors counted, nothing decoded, no raise
     assert dec.decode_errors > 0
-    assert all(b.tags.shape[0] >= 0 for b in out.values())
+    assert not out
 
 
 def test_frame_reassembler_resyncs_on_noise():
     asm = FrameReassembler()
-    for blob in _blobs(50, max_len=2048):
+    for blob in _blobs(_rng(), 50, max_len=2048):
         for _h, _b in asm.feed(blob):
             pass
     # noise produces bad-frame counts, never exceptions or runaway buffer

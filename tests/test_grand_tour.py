@@ -1,0 +1,97 @@
+"""The grand tour: a REAL agent daemon replays the reference's own
+captures, ships over live TCP to a fully composed server, and every
+query plane answers — the 'switch from the reference and find
+everything' test."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from deepflow_tpu.agent.main import Agent, AgentConfig
+from deepflow_tpu.server.main import Server
+from deepflow_tpu.utils.config import load_config
+
+REF = "/root/reference/agent/resources/test/flow_generator"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REF), reason="reference fixtures not present"
+)
+
+
+def _wait(cond, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_grand_tour(tmp_path):
+    cfg, _ = load_config(
+        {
+            "receiver": {"tcp_port": 0, "udp_port": 0},
+            "ingester": {"n_decoders": 1, "prefer_native": False},
+            "storage": {"root": str(tmp_path / "store"), "writer_flush_s": 0.05},
+        }
+    )
+    srv = Server(cfg, lease_path=tmp_path / "lease").start()
+    agent = None
+    try:
+        agent = Agent(
+            AgentConfig(
+                agent_id=3,
+                servers=(("127.0.0.1", srv.receiver.tcp_port),),
+                batch_size=512,
+                compression=0,
+            )
+        )
+        # replay real captures spanning HTTP, DNS, MySQL, Redis traffic
+        for rel in ("http/httpv1.pcap", "dns/dns.pcap", "mysql/mysql.pcap",
+                    "redis/redis.pcap"):
+            agent.run_pcap(os.path.join(REF, rel))
+
+        assert _wait(lambda: srv.flow_metrics.counters["docs_written"] > 0)
+        srv.doc_writer.flush()
+        srv.flow_log.flush()
+
+        # 1. metrics plane answers SQL
+        total = 0
+        for table in ("network.1s", "network_map.1s", "network.1m", "network_map.1m"):
+            try:
+                total += int(srv.query.execute(
+                    f"SELECT Count() AS c FROM {table}").values["c"][0])
+            except Exception:
+                pass
+        assert total > 0
+
+        # 2. L7 request logs landed with protocol fidelity
+        r = srv.query.execute(
+            "SELECT request_type, request_domain FROM l7_flow_log LIMIT 500")
+        doms = set(str(d) for d in r.values["request_domain"])
+        assert "rq.cct.cloud.duba.net" in doms  # from httpv1.pcap
+        assert any("guoyongxin" in d or "yunshan" in d for d in doms)  # dns.pcap
+
+        # 3. L4 flow logs (minute aggregation + throttle) landed
+        r = srv.query.execute("SELECT Count() AS c FROM l4_flow_log")
+        assert int(r.values["c"][0]) > 0
+
+        # 4. the agent syncs config/platform over the live trisolaris
+        from deepflow_tpu.controller.trisolaris import AgentSyncClient
+
+        srv.trisolaris.set_group_config("default", {"l4_log_collect_nps_threshold": 555})
+        client = AgentSyncClient([("127.0.0.1", srv.trisolaris.port)], 3)
+        assert client.sync_once()
+        agent.apply_dynamic_config(client.config)
+        assert agent.l4_throttle.throttle == 555
+        assert client.analyzer_ip  # balancer assignment rode along
+
+        # 5. self-telemetry flowed
+        did = srv.tick()
+        assert "leader" in did
+    finally:
+        if agent is not None:
+            agent.close()
+        srv.stop()
