@@ -53,9 +53,26 @@ def test_grand_tour(tmp_path):
                     "redis/redis.pcap"):
             agent.run_pcap(os.path.join(REF, rel))
 
+        # l7 session count the agent actually shipped — wait until the
+        # server has WRITTEN that many rows (sender flush + TCP + decode
+        # are all async; querying earlier races the pipeline)
+        l7_sent = agent.counters["logs_sent"]
+        assert l7_sent > 0
         assert _wait(lambda: srv.flow_metrics.counters["docs_written"] > 0)
         srv.doc_writer.flush()
-        srv.flow_log.flush()
+
+        def _table_rows(table):
+            srv.flow_log.flush()
+            try:
+                return int(srv.query.execute(
+                    f"SELECT Count() AS c FROM {table}").values["c"][0])
+            except Exception:
+                return 0
+
+        # sender flush + TCP + decode + writer are all async — wait on
+        # the QUERYABLE row counts, not on intermediate counters
+        assert _wait(lambda: _table_rows("l7_flow_log") >= l7_sent)
+        assert _wait(lambda: _table_rows("l4_flow_log") > 0)
 
         # 1. metrics plane answers SQL
         total = 0
@@ -74,9 +91,8 @@ def test_grand_tour(tmp_path):
         assert "rq.cct.cloud.duba.net" in doms  # from httpv1.pcap
         assert any("guoyongxin" in d or "yunshan" in d for d in doms)  # dns.pcap
 
-        # 3. L4 flow logs (minute aggregation + throttle) landed
-        r = srv.query.execute("SELECT Count() AS c FROM l4_flow_log")
-        assert int(r.values["c"][0]) > 0
+        # 3. L4 flow logs (minute aggregation + throttle) landed — count
+        # pinned above by the queryable-rows wait
 
         # 4. the agent syncs config/platform over the live trisolaris
         from deepflow_tpu.controller.trisolaris import AgentSyncClient
