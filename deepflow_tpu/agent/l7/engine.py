@@ -153,6 +153,16 @@ class L7Engine:
 
             d = 0 if (key[0] == ((tuple(int(w) for w in p.ip_src[i]), sport))) else 1
             ctx = fl.parser_ctx.setdefault(d, Hpack())
+        elif fl.protocol == L7Protocol.KAFKA:
+            # correlation-id bookkeeping: responses are only
+            # recognizable against outstanding requests (kafka.rs
+            # keeps the same per-flow session state). The packet's
+            # flow-relative direction rides along so a request whose
+            # api words alias a pending corr can't be taken for a
+            # response.
+            d = 0 if (key[0] == ((tuple(int(w) for w in p.ip_src[i]), sport))) else 1
+            ctx = fl.parser_ctx.setdefault("kafka", {})
+            ctx["dir"] = d
         msg = parse_payload(fl.protocol, payload, ctx)
         if msg is None:
             self.counters["parse_miss"] += 1

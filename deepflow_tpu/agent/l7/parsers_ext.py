@@ -130,28 +130,50 @@ def check_kafka(payload: bytes, port: int = 0) -> bool:
     return (port == 9092 and size > 0) or req_ok
 
 
-def parse_kafka(payload: bytes) -> L7Message | None:
+def parse_kafka(payload: bytes, ctx: dict | None = None) -> L7Message | None:
+    """`ctx` is the flow's parser state (kafka.rs keeps the same): a
+    response frame is just [size][correlation_id][body], so matching it
+    to an outstanding request's correlation id is the only reliable
+    request/response discriminator."""
     try:
         if len(payload) < 8:
             return None
+        # response-first: a correlation id matching an outstanding
+        # request beats the loose api_key heuristic — but only for
+        # packets NOT traveling in the request direction (low api
+        # words alias low sequential corr ids otherwise)
+        corr = int.from_bytes(payload[4:8], "big")
+        if ctx is not None and corr in ctx.get("pending", {}):
+            req_dir = ctx.get("req_dir")
+            if req_dir is None or ctx.get("dir") != req_dir:
+                ctx["pending"].pop(corr, None)
+                return L7Message(
+                    protocol=L7Protocol.KAFKA,
+                    msg_type=MSG_RESPONSE,
+                    request_id=corr,
+                )
         api_key = int.from_bytes(payload[4:6], "big")
         api_ver = int.from_bytes(payload[6:8], "big")
         entry = _KAFKA_APIS.get(api_key)
-        if entry is not None and api_ver <= entry[1]:
+        if entry is not None and api_ver <= entry[1] and len(payload) >= 12:
             corr = int.from_bytes(payload[8:12], "big")
-            topic = ""
+            if ctx is not None:
+                ctx["req_dir"] = ctx.get("dir")
+                pending = ctx.setdefault("pending", {})
+                pending[corr] = None
+                while len(pending) > 64:  # engine's _MAX_PENDING stance
+                    pending.pop(next(iter(pending)))
             name = entry[0]
             return L7Message(
                 protocol=L7Protocol.KAFKA,
                 msg_type=MSG_REQUEST,
                 version=str(api_ver),
                 request_type=name,
-                request_resource=topic,
+                request_resource="",
                 endpoint=name,
                 request_id=corr,
             )
-        # response: [size][correlation_id] and nothing request-like
-        corr = int.from_bytes(payload[4:8], "big")
+        # stateless fallback: [size][correlation_id], nothing request-like
         return L7Message(
             protocol=L7Protocol.KAFKA,
             msg_type=MSG_RESPONSE,

@@ -46,7 +46,7 @@ CLASSIFY_CASES = [
     ("redis/redis.pcap", L7Protocol.REDIS, 1),
     ("postgre/simple_query.pcap", L7Protocol.POSTGRESQL, 1),
     ("mongo/mongo.pcap", L7Protocol.MONGODB, 1),
-    ("kafka/kafka.pcap", L7Protocol.KAFKA, 0),
+    ("kafka/kafka.pcap", L7Protocol.KAFKA, 1),
     ("mqtt/mqtt_connect.pcap", L7Protocol.MQTT, 1),
     ("memcached/memcached.pcap", L7Protocol.MEMCACHED, 1),
     ("nats/nats-headers.pcap", L7Protocol.NATS, 1),

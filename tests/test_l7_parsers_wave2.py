@@ -365,3 +365,37 @@ def test_existing_protocols_still_win_inference():
     assert infer_protocol(b"GET / HTTP/1.1\r\nHost: x\r\n\r\n") == L7Protocol.HTTP1
     resp = b"*1\r\n$4\r\nPING\r\n"
     assert infer_protocol(resp, server_port=6379) == L7Protocol.REDIS
+
+
+def test_kafka_direction_gated_pairing():
+    """A request whose low api words alias an outstanding correlation id
+    must NOT be taken for a response when it travels in the request
+    direction; real responses (other direction) pair and evict."""
+    import struct
+
+    from deepflow_tpu.agent.l7.parsers_ext import parse_kafka
+    from deepflow_tpu.agent.l7.parsers import MSG_REQUEST, MSG_RESPONSE
+
+    def produce_req(corr, ver=3):
+        return struct.pack(">IHHI", 30, 0, ver, corr) + b"\x00" * 20
+
+    ctx = {"dir": 0}
+    # pipeline corrs 0..3 from direction 0
+    for corr in range(4):
+        m = parse_kafka(produce_req(corr), ctx)
+        assert m.msg_type == MSG_REQUEST and m.request_id == corr
+    # next request: payload[4:8] == (api_key=0, ver=3) == corr 3 alias;
+    # same direction → still a REQUEST
+    m = parse_kafka(produce_req(99, ver=3), ctx)
+    assert m.msg_type == MSG_REQUEST and m.request_id == 99
+    # genuine response from the other direction pairs corr 2
+    ctx["dir"] = 1
+    resp = struct.pack(">II", 40, 2) + b"\x00" * 8
+    m = parse_kafka(resp, ctx)
+    assert m.msg_type == MSG_RESPONSE and m.request_id == 2
+    assert 2 not in ctx["pending"]
+    # pending is bounded
+    ctx["dir"] = 0
+    for corr in range(200, 400):
+        parse_kafka(produce_req(corr), ctx)
+    assert len(ctx["pending"]) <= 64
