@@ -148,10 +148,12 @@ class L7Engine:
             self.counters["inferred"] += 1
 
         ctx = None
+        # flow-relative direction: which canonical endpoint sent this
+        # packet (shared by every stateful parser ctx below)
+        d = 0 if (key[0] == ((tuple(int(w) for w in p.ip_src[i]), sport))) else 1
         if fl.protocol in (L7Protocol.HTTP2, L7Protocol.GRPC):
             from .http2 import Hpack
 
-            d = 0 if (key[0] == ((tuple(int(w) for w in p.ip_src[i]), sport))) else 1
             ctx = fl.parser_ctx.setdefault(d, Hpack())
         elif fl.protocol == L7Protocol.KAFKA:
             # correlation-id bookkeeping: responses are only
@@ -160,7 +162,6 @@ class L7Engine:
             # flow-relative direction rides along so a request whose
             # api words alias a pending corr can't be taken for a
             # response.
-            d = 0 if (key[0] == ((tuple(int(w) for w in p.ip_src[i]), sport))) else 1
             ctx = fl.parser_ctx.setdefault("kafka", {})
             ctx["dir"] = d
         msg = parse_payload(fl.protocol, payload, ctx)
@@ -297,6 +298,11 @@ class L7Engine:
                 strs["request_domain"][r] = req.request_domain
                 strs["request_resource"][r] = req.request_resource
                 strs["endpoint"][r] = req.endpoint
+                # header-carried trace context (traceparent/B3/sw8):
+                # packet spans join instrumented traces through the
+                # same l7_flow_log columns the OTel lane fills
+                strs["trace_id"][r] = req.trace_id
+                strs["span_id"][r] = req.span_id
             if resp and resp.request_resource and resp.status in (
                 STATUS_CLIENT_ERROR,
                 STATUS_SERVER_ERROR,

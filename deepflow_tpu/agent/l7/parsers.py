@@ -43,6 +43,11 @@ class L7Message:
     request_id: int | None = None
     status: int = STATUS_OK
     status_code: int = 0
+    # distributed-tracing context carried in protocol headers
+    # (traceparent / B3 / sw8 — http.rs ON_HEADER trace extraction);
+    # lets packet-observed spans join instrumented traces
+    trace_id: str = ""
+    span_id: str = ""
 
 
 # ---------------------------------------------------------------------------
@@ -57,6 +62,45 @@ _N_PATH_SEGMENTS = 2  # endpoint = first two path segments (http.rs endpoint tri
 
 def check_http(payload: bytes) -> bool:
     return payload.startswith(_HTTP_METHODS) or payload.startswith(b"HTTP/1.")
+
+
+def trace_context_from_header(name: str, value: str) -> tuple[str, str]:
+    """One trace header → (trace_id, span_id); empty strings when the
+    header carries no usable context. Supported generations mirror
+    http.rs: W3C `traceparent`, Zipkin B3 (`x-b3-traceid` /
+    `x-b3-spanid`), SkyWalking `sw8` (base64 segments)."""
+    name = name.lower()
+    if name == "traceparent":
+        parts = value.split("-")
+        if (
+            len(parts) >= 3
+            and len(parts[1]) == 32
+            and len(parts[2]) == 16
+            and set(parts[1]) != {"0"}  # W3C-invalid all-zero trace id
+            and all(c in "0123456789abcdef" for c in parts[1] + parts[2])
+        ):
+            return parts[1], parts[2]
+    elif name == "x-b3-traceid":
+        return value.strip(), ""
+    elif name == "x-b3-spanid":
+        return "", value.strip()
+    elif name == "sw8":
+        # 1-<b64(trace id)>-<b64(segment id)>-<span idx>-…
+        import base64
+
+        parts = value.split("-")
+        if len(parts) >= 4:
+            try:
+                tid = base64.b64decode(parts[1] + "=" * (-len(parts[1]) % 4)).decode()
+                seg = base64.b64decode(parts[2] + "=" * (-len(parts[2]) % 4)).decode()
+                return tid, f"{seg}-{parts[3]}"
+            except Exception:
+                return "", ""
+    return "", ""
+
+
+def _merge_trace(trace: tuple[str, str], new: tuple[str, str]) -> tuple[str, str]:
+    return (trace[0] or new[0], trace[1] or new[1])
 
 
 def parse_http(payload: bytes) -> L7Message | None:
@@ -88,10 +132,16 @@ def parse_http(payload: bytes) -> L7Message | None:
                     parts[2][5:8].decode(errors="replace") if len(parts) > 2 else ""
                 )
                 host = ""
+                trace = ("", "")
                 for ln in lines[1:]:
-                    if ln[:5].lower() == b"host:":
-                        host = ln[5:].strip().decode(errors="replace")
-                        break
+                    k, _, v = ln.partition(b":")
+                    key = k.strip().lower()
+                    if key == b"host":
+                        host = v.strip().decode(errors="replace")
+                    elif key in (b"traceparent", b"x-b3-traceid",
+                                 b"x-b3-spanid", b"sw8"):
+                        trace = _merge_trace(trace, trace_context_from_header(
+                            key.decode(), v.strip().decode(errors="replace")))
                 path = uri.split("?", 1)[0]
                 endpoint = endpoint_from_path(path, _N_PATH_SEGMENTS)
                 return L7Message(
@@ -102,6 +152,8 @@ def parse_http(payload: bytes) -> L7Message | None:
                     request_domain=host,
                     request_resource=path,
                     endpoint=endpoint,
+                    trace_id=trace[0],
+                    span_id=trace[1],
                 )
         return None
     except Exception:

@@ -155,10 +155,25 @@ def parse_kafka(payload: bytes, ctx: dict | None = None) -> L7Message | None:
         api_key = int.from_bytes(payload[4:6], "big")
         api_ver = int.from_bytes(payload[6:8], "big")
         entry = _KAFKA_APIS.get(api_key)
-        if entry is not None and api_ver <= entry[1] and len(payload) >= 12:
+        known_req_dir = None if ctx is None else ctx.get("req_dir")
+        if (
+            entry is not None
+            and api_ver <= entry[1]
+            and len(payload) >= 12
+            # a request-looking frame traveling in the RESPONSE
+            # direction is a response whose corr words alias an api
+            # header (retransmit/evicted/duplicate) — it must neither
+            # register pending nor flip req_dir
+            and not (
+                known_req_dir is not None
+                and ctx.get("dir") is not None
+                and ctx["dir"] != known_req_dir
+            )
+        ):
             corr = int.from_bytes(payload[8:12], "big")
             if ctx is not None:
-                ctx["req_dir"] = ctx.get("dir")
+                if known_req_dir is None:
+                    ctx["req_dir"] = ctx.get("dir")
                 pending = ctx.setdefault("pending", {})
                 pending[corr] = None
                 while len(pending) > 64:  # engine's _MAX_PENDING stance

@@ -399,3 +399,43 @@ def test_kafka_direction_gated_pairing():
     for corr in range(200, 400):
         parse_kafka(produce_req(corr), ctx)
     assert len(ctx["pending"]) <= 64
+
+
+def test_kafka_response_retransmit_cannot_poison_req_dir():
+    """A response whose corr words alias a valid api header, arriving in
+    the response direction, must not flip req_dir or register pending."""
+    import struct
+
+    from deepflow_tpu.agent.l7.parsers import MSG_REQUEST, MSG_RESPONSE
+    from deepflow_tpu.agent.l7.parsers_ext import parse_kafka
+
+    def produce_req(corr, ver=3):
+        return struct.pack(">IHHI", 30, 0, ver, corr) + b"\x00" * 20
+
+    ctx = {"dir": 0}
+    for corr in range(4):
+        parse_kafka(produce_req(corr), ctx)
+    # paired response for corr 2 arrives and is popped
+    ctx["dir"] = 1
+    parse_kafka(struct.pack(">II", 40, 2) + b"\x00" * 8, ctx)
+    # its retransmit: corr 2 not pending; payload[4:8]=2 aliases
+    # (api=0, ver=2). Response direction → must NOT become a request.
+    m = parse_kafka(struct.pack(">II", 40, 2) + b"\x00" * 8, ctx)
+    assert m.msg_type == MSG_RESPONSE
+    assert ctx["req_dir"] == 0  # gate stays armed
+    # and pipelined alias requests still parse as requests
+    ctx["dir"] = 0
+    m = parse_kafka(produce_req(99, ver=3), ctx)
+    assert m.msg_type == MSG_REQUEST and m.request_id == 99
+
+
+def test_traceparent_rejects_invalid():
+    from deepflow_tpu.agent.l7.parsers import trace_context_from_header
+
+    assert trace_context_from_header(
+        "traceparent", "00-00000000000000000000000000000000-0000000000000000-01"
+    ) == ("", "")
+    assert trace_context_from_header("traceparent", "00-" + "a" * 32 + "-x") == ("", "")
+    assert trace_context_from_header(
+        "traceparent", "00-" + "a" * 32 + "-" + "b" * 16 + "-01"
+    ) == ("a" * 32, "b" * 16)

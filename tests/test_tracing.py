@@ -344,3 +344,36 @@ def test_tempo_trace_shape():
     assert int(span["endTimeUnixNano"]) - int(span["startTimeUnixNano"]) == 500_000
     assert tempo_trace(store, "nope") is None
     w.stop()
+
+
+def test_packet_spans_join_traces_via_headers():
+    """Zero-instrumentation tracing: an HTTP request observed on the
+    wire with a traceparent header lands in l7_flow_log with the trace
+    context, so trace assembly includes the packet span alongside
+    instrumented (OTel) spans of the same trace."""
+    from deepflow_tpu.agent.l7.engine import L7Engine
+    from deepflow_tpu.agent.packet import TCP_ACK, TCP_PSH, craft_tcp, parse_packets, to_batch
+
+    tid = "4bf92f3577b34da6a3ce929d0e0e4736"
+    req = (
+        b"GET /api/cart HTTP/1.1\r\nHost: shop\r\n"
+        b"traceparent: 00-" + tid.encode() + b"-00f067aa0ba902b7-01\r\n\r\n"
+    )
+    resp = b"HTTP/1.1 200 OK\r\nContent-Length: 0\r\n\r\n"
+    pkts = [
+        craft_tcp(0x0A000001, 0x0A000002, 40000, 80, flags=TCP_ACK | TCP_PSH, seq=1, payload=req),
+        craft_tcp(0x0A000002, 0x0A000001, 80, 40000, flags=TCP_ACK | TCP_PSH, seq=1, payload=resp),
+    ]
+    buf, lengths, ts_s, ts_us = to_batch(pkts, [T0, T0], [0, 900], snap=512)
+    eng = L7Engine()
+    logs, _ = eng.process(buf, parse_packets(buf, lengths, ts_s, ts_us))
+    rows = logs.to_rows()
+    assert len(rows) == 1
+    assert rows[0]["trace_id"] == tid
+    assert rows[0]["span_id"] == "00f067aa0ba902b7"
+
+    # sw8 generation decodes its base64 segments
+    from deepflow_tpu.agent.l7.parsers import trace_context_from_header
+
+    t, s = trace_context_from_header("sw8", "1-dHJhY2UxMjM=-c2VnNDU2-3-more")
+    assert t == "trace123" and s == "seg456-3"
