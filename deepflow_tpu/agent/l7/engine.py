@@ -108,11 +108,15 @@ class L7Engine:
             self._sweep_timeouts(now_us, sessions)
         return self._emit(sessions)
 
-    def _flow_key(self, p: PacketBatch, i: int) -> tuple:
+    def _flow_key(self, p: PacketBatch, i: int) -> tuple[tuple, int]:
+        """→ (canonical flow key, flow-relative direction of packet i):
+        direction 0 = the packet's source is the key's low endpoint.
+        Derived here because the src tuple is already in hand — callers
+        must not rebuild it per protocol."""
         a = (tuple(int(w) for w in p.ip_src[i]), int(p.port_src[i]))
         b = (tuple(int(w) for w in p.ip_dst[i]), int(p.port_dst[i]))
         lo, hi = (a, b) if a <= b else (b, a)
-        return (lo, hi, int(p.protocol[i]))
+        return (lo, hi, int(p.protocol[i])), 0 if a == lo else 1
 
     def _one_packet(self, buf, p: PacketBatch, i: int, sessions: list) -> None:
         self.counters["payloads_in"] += 1
@@ -121,7 +125,7 @@ class L7Engine:
         payload = buf[i, off:end].tobytes()
         if not payload:
             return
-        key = self._flow_key(p, i)
+        key, d = self._flow_key(p, i)
         fl = self._flows.get(key)
         if fl is None:
             fl = self._flows[key] = _FlowL7()
@@ -148,9 +152,6 @@ class L7Engine:
             self.counters["inferred"] += 1
 
         ctx = None
-        # flow-relative direction: which canonical endpoint sent this
-        # packet (shared by every stateful parser ctx below)
-        d = 0 if (key[0] == ((tuple(int(w) for w in p.ip_src[i]), sport))) else 1
         if fl.protocol in (L7Protocol.HTTP2, L7Protocol.GRPC):
             from .http2 import Hpack
 

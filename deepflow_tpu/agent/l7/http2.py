@@ -236,19 +236,14 @@ def _parse_http2_inner(payload: bytes, hp: Hpack) -> L7Message | None:
         is_grpc = headers.get("content-type", "").startswith("application/grpc")
         proto = L7Protocol.GRPC if is_grpc else L7Protocol.HTTP2
         if ":method" in headers:  # request
-            from .parsers import _merge_trace, endpoint_from_path, trace_context_from_header
+            from .parsers import endpoint_from_path, trace_from_headers
 
             path = headers.get(":path", "")
             bare = path.split("?", 1)[0]
             # gRPC paths are exactly /package.Service/Method — the
             # 2-segment trim keeps them whole
             endpoint = endpoint_from_path(bare, _N_PATH_SEGMENTS)
-            trace = ("", "")
-            for hname in ("traceparent", "x-b3-traceid", "x-b3-spanid", "sw8"):
-                if hname in headers:
-                    trace = _merge_trace(
-                        trace, trace_context_from_header(hname, headers[hname])
-                    )
+            trace = trace_from_headers(headers.get)
             return L7Message(
                 protocol=proto,
                 msg_type=MSG_REQUEST,

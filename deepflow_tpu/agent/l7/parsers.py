@@ -76,14 +76,20 @@ def trace_context_from_header(name: str, value: str) -> tuple[str, str]:
             len(parts) >= 3
             and len(parts[1]) == 32
             and len(parts[2]) == 16
-            and set(parts[1]) != {"0"}  # W3C-invalid all-zero trace id
+            # W3C-invalid all-zero trace and parent ids
+            and set(parts[1]) != {"0"}
+            and set(parts[2]) != {"0"}
             and all(c in "0123456789abcdef" for c in parts[1] + parts[2])
         ):
             return parts[1], parts[2]
     elif name == "x-b3-traceid":
-        return value.strip(), ""
+        v = value.strip().lower()
+        if len(v) in (16, 32) and all(c in "0123456789abcdef" for c in v):
+            return v, ""
     elif name == "x-b3-spanid":
-        return "", value.strip()
+        v = value.strip().lower()
+        if len(v) == 16 and all(c in "0123456789abcdef" for c in v):
+            return "", v
     elif name == "sw8":
         # 1-<b64(trace id)>-<b64(segment id)>-<span idx>-…
         import base64
@@ -101,6 +107,23 @@ def trace_context_from_header(name: str, value: str) -> tuple[str, str]:
 
 def _merge_trace(trace: tuple[str, str], new: tuple[str, str]) -> tuple[str, str]:
     return (trace[0] or new[0], trace[1] or new[1])
+
+
+TRACE_HEADERS = ("traceparent", "x-b3-traceid", "x-b3-spanid", "sw8")
+_TRACE_HEADERS_B = tuple(h.encode() for h in TRACE_HEADERS)
+
+
+def trace_from_headers(get) -> tuple[str, str]:
+    """(trace_id, span_id) from a header lookup callable `get(name) ->
+    value | None` — the one shared walk over every supported trace
+    generation (HTTP/1 lines, HTTP/2 hpack maps, and Dubbo attachments
+    all feed this)."""
+    trace = ("", "")
+    for name in TRACE_HEADERS:
+        v = get(name)
+        if v:
+            trace = _merge_trace(trace, trace_context_from_header(name, v))
+    return trace
 
 
 def parse_http(payload: bytes) -> L7Message | None:
@@ -132,16 +155,17 @@ def parse_http(payload: bytes) -> L7Message | None:
                     parts[2][5:8].decode(errors="replace") if len(parts) > 2 else ""
                 )
                 host = ""
-                trace = ("", "")
+                hdrs: dict[bytes, bytes] = {}
                 for ln in lines[1:]:
                     k, _, v = ln.partition(b":")
                     key = k.strip().lower()
                     if key == b"host":
                         host = v.strip().decode(errors="replace")
-                    elif key in (b"traceparent", b"x-b3-traceid",
-                                 b"x-b3-spanid", b"sw8"):
-                        trace = _merge_trace(trace, trace_context_from_header(
-                            key.decode(), v.strip().decode(errors="replace")))
+                    elif key in _TRACE_HEADERS_B:
+                        hdrs.setdefault(key, v.strip())
+                trace = trace_from_headers(
+                    lambda n: (hdrs.get(n.encode()) or b"").decode(errors="replace")
+                )
                 path = uri.split("?", 1)[0]
                 endpoint = endpoint_from_path(path, _N_PATH_SEGMENTS)
                 return L7Message(

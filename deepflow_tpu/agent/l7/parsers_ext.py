@@ -155,24 +155,32 @@ def parse_kafka(payload: bytes, ctx: dict | None = None) -> L7Message | None:
         api_key = int.from_bytes(payload[4:6], "big")
         api_ver = int.from_bytes(payload[6:8], "big")
         entry = _KAFKA_APIS.get(api_key)
+        looks_req = entry is not None and api_ver <= entry[1] and len(payload) >= 12
         known_req_dir = None if ctx is None else ctx.get("req_dir")
-        if (
-            entry is not None
-            and api_ver <= entry[1]
-            and len(payload) >= 12
+        blocked = (
+            looks_req
+            and known_req_dir is not None
+            and ctx.get("dir") is not None
+            and ctx["dir"] != known_req_dir
+        )
+        if blocked:
             # a request-looking frame traveling in the RESPONSE
-            # direction is a response whose corr words alias an api
-            # header (retransmit/evicted/duplicate) — it must neither
-            # register pending nor flip req_dir
-            and not (
-                known_req_dir is not None
-                and ctx.get("dir") is not None
-                and ctx["dir"] != known_req_dir
-            )
-        ):
+            # direction is usually a response whose corr words alias an
+            # api header (retransmit/evicted/duplicate) — but repeated
+            # contradictions mean req_dir itself was seeded wrong
+            # (capture started mid-stream on an aliasing response), so
+            # two strikes flip it and the frame registers as a request
+            ctx["contra"] = ctx.get("contra", 0) + 1
+            if ctx["contra"] >= 2:
+                ctx["req_dir"] = ctx["dir"]
+                ctx["contra"] = 0
+                ctx.get("pending", {}).clear()
+                blocked = False
+        if looks_req and not blocked:
             corr = int.from_bytes(payload[8:12], "big")
             if ctx is not None:
-                if known_req_dir is None:
+                ctx["contra"] = 0
+                if ctx.get("req_dir") is None:
                     ctx["req_dir"] = ctx.get("dir")
                 pending = ctx.setdefault("pending", {})
                 pending[corr] = None
@@ -414,6 +422,33 @@ def check_dubbo(payload: bytes, port: int = 0) -> bool:
     return len(payload) >= 16 and payload[:2] == _DUBBO_MAGIC
 
 
+def _hessian_attachment(body: bytes, key: str) -> str:
+    """Value of a string-keyed attachment in a Dubbo request body: the
+    attachments map encodes keys as hessian2 short strings (1-byte
+    length), so the exact byte pattern [len][key] locates it; the value
+    is read with the same short/medium string rules _hessian_strings
+    handles. Used for the trace-context attachments (sw8/traceparent —
+    dubbo.rs pulls the same keys)."""
+    marker = bytes([len(key)]) + key.encode()
+    i = body.find(marker)
+    if i < 0:
+        return ""
+    off = i + len(marker)
+    if off >= len(body):
+        return ""
+    ln = body[off]
+    if 0x30 <= ln <= 0x33 and off + 1 < len(body):  # medium string
+        ln = ((ln - 0x30) << 8) + body[off + 1]
+        off += 2
+    elif ln < 0x20:
+        off += 1
+    else:
+        return ""
+    if off + ln > len(body):
+        return ""
+    return body[off : off + ln].decode(errors="replace")
+
+
 def _hessian_strings(body: bytes, limit: int = 4) -> list[str]:
     """Leading hessian2-encoded short strings ("2.0.2", service, version,
     method). Short strings are length-prefixed with 0x00-0x1f."""
@@ -455,6 +490,9 @@ def parse_dubbo(payload: bytes) -> L7Message | None:
             # [dubbo version, service, service version, method]
             service = strs[1] if len(strs) > 1 else ""
             method = strs[3] if len(strs) > 3 else ""
+            from .parsers import trace_from_headers
+
+            trace = trace_from_headers(lambda n: _hessian_attachment(body, n))
             return L7Message(
                 protocol=L7Protocol.DUBBO,
                 msg_type=MSG_REQUEST,
@@ -464,6 +502,8 @@ def parse_dubbo(payload: bytes) -> L7Message | None:
                 request_resource=f"{service}.{method}" if service else method,
                 endpoint=service,
                 request_id=req_id,
+                trace_id=trace[0],
+                span_id=trace[1],
             )
         # Dubbo status registry: 20 OK; client-side faults: 30
         # CLIENT_TIMEOUT, 40 BAD_REQUEST, 90 CLIENT_ERROR; server-side:

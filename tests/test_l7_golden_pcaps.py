@@ -145,3 +145,24 @@ def test_whole_fixture_corpus_replays_without_crashing():
         assert stats["packets"] >= 0
         replayed += 1
     assert replayed == len(pcaps)
+
+
+def test_golden_dubbo_sw8_trace_context():
+    """dubbo-sw8.pcap: the SkyWalking sw8 attachment in the hessian
+    body surfaces as the span's trace context (dubbo.rs trace seat)."""
+    eng, protos, rows = _replay("dubbo/dubbo-sw8.pcap")
+    assert L7Protocol.DUBBO in protos
+    # the capture carries requests only — advance the engine clock so
+    # the pending requests emit as timeout sessions
+    from deepflow_tpu.agent.packet import craft_tcp, parse_packets, to_batch
+
+    buf, lengths, ts_s, ts_us = to_batch(
+        [craft_tcp(1, 2, 3, 4, payload=b"x")], [(1 << 31) - 1], [0], snap=64
+    )
+    logs, _ = eng.process(buf, parse_packets(buf, lengths, ts_s, ts_us))
+    rows += logs.to_rows()
+    traced = [r for r in rows if r["trace_id"]]
+    assert traced, [r["request_type"] for r in rows]
+    # sw8 trace ids are dotted skywalking ids once base64-decoded
+    assert "." in traced[0]["trace_id"]
+    assert traced[0]["span_id"]

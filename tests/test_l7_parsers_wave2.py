@@ -439,3 +439,39 @@ def test_traceparent_rejects_invalid():
     assert trace_context_from_header(
         "traceparent", "00-" + "a" * 32 + "-" + "b" * 16 + "-01"
     ) == ("a" * 32, "b" * 16)
+
+
+def test_kafka_req_dir_self_corrects_after_midstream_seed():
+    """A capture starting on an aliasing response seeds req_dir wrong;
+    two contradicting real requests flip it back and pairing resumes."""
+    import struct
+
+    from deepflow_tpu.agent.l7.parsers import MSG_REQUEST
+    from deepflow_tpu.agent.l7.parsers_ext import parse_kafka
+
+    def produce_req(corr, ver=3):
+        return struct.pack(">IHHI", 30, 0, ver, corr) + b"\x00" * 20
+
+    # first frame: server response corr=2 → aliases (api 0, ver 2) and
+    # wrongly seeds req_dir = 1
+    ctx = {"dir": 1}
+    parse_kafka(struct.pack(">IHHI", 40, 0, 2, 7) + b"\x00" * 8, ctx)
+    assert ctx["req_dir"] == 1
+    # real client requests from dir 0: first is gated, second flips
+    ctx["dir"] = 0
+    parse_kafka(produce_req(10), ctx)
+    m = parse_kafka(produce_req(11), ctx)
+    assert ctx["req_dir"] == 0
+    assert m.msg_type == MSG_REQUEST and m.request_id == 11
+
+
+def test_b3_header_validation():
+    from deepflow_tpu.agent.l7.parsers import trace_context_from_header
+
+    assert trace_context_from_header("x-b3-traceid", "not hex at all!!") == ("", "")
+    assert trace_context_from_header("x-b3-traceid", "a" * 32) == ("a" * 32, "")
+    assert trace_context_from_header("x-b3-spanid", "b" * 16) == ("", "b" * 16)
+    assert trace_context_from_header("x-b3-spanid", "b" * 8) == ("", "")
+    assert trace_context_from_header(
+        "traceparent", "00-" + "a" * 32 + "-" + "0" * 16 + "-01"
+    ) == ("", "")
