@@ -166,3 +166,21 @@ def test_golden_dubbo_sw8_trace_context():
     # sw8 trace ids are dotted skywalking ids once base64-decoded
     assert "." in traced[0]["trace_id"]
     assert traced[0]["span_id"]
+
+
+def test_golden_grpc_service_method():
+    """http/grpc-unary.result: gRPC endpoint is the full
+    /package.Service/Method path (no 2-segment trim), status 200."""
+    _eng, _protos, rows = _replay("http/grpc-unary.pcap")
+    r = rows[0]
+    assert r["request_type"] == "POST"
+    assert r["endpoint"] == "/agent.Synchronizer/Sync"
+    assert r["status_code"] == 200
+
+
+def test_golden_redis_commands():
+    """redis/redis.pcap: command verbs and full statements survive."""
+    _eng, _protos, rows = _replay("redis/redis.pcap")
+    verbs = {r["request_type"] for r in rows}
+    assert {"GET", "EXISTS"} <= verbs
+    assert any(r["request_resource"].startswith("GET user_conf") for r in rows)
