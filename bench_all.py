@@ -135,10 +135,14 @@ def config2(quick: bool):
     from deepflow_tpu.ingest.replay import SyntheticAppGen
 
     gen = SyntheticAppGen(num_services=64, endpoints_per_service=16, seed=1)
-    fb = gen.app_batch(BATCH, 1_700_000_000)
+    draw = gen._draw(BATCH)
+    fb = gen.app_batch(BATCH, 1_700_000_000, draw=draw)
     tags = {k: jnp.asarray(v) for k, v in fb.tags.items()}
     meters = jnp.asarray(fb.meters)
     valid = jnp.asarray(fb.valid)
+    # the generator's true service id — NOT a port residue (a port-mod
+    # binning can leave bins empty and record 0.0 percentiles)
+    svc_id = jnp.asarray(draw[0].astype(np.int32))
 
     append_fn, fold_fn = make_ingest_step(
         FanoutConfig(), interval=1, app=True, batch_unique_cap=CAPU
@@ -154,15 +158,14 @@ def config2(quick: bool):
     hist = jnp.zeros((64, spec.bins), jnp.int32)
 
     @jax.jit
-    def upd_hist(hist, tags, meters, valid):
-        svc = (tags["server_port"] % jnp.uint32(64)).astype(jnp.int32)
+    def upd_hist(hist, svc, meters, valid):
         rrt = meters[:, m_idx("rrt_sum")] / jnp.maximum(meters[:, m_idx("rrt_count")], 1.0)
         return loghist_update(hist, svc, rrt, valid & (meters[:, m_idx("rrt_count")] > 0), spec)
 
     # warm, then one true host-fetch sync (PERF.md §6)
     state, acc = append(state, acc, jnp.int32(0), tags, meters, valid)
     state, acc = fold(state, acc)
-    hist = upd_hist(hist, tags, meters, valid)
+    hist = upd_hist(hist, svc_id, meters, valid)
     _ = np.asarray(state.slot[:1])
     t0 = time.perf_counter(); _ = np.asarray(state.slot[:1])
     fetch_base = time.perf_counter() - t0
@@ -172,7 +175,7 @@ def config2(quick: bool):
     k = 0
     for i in range(iters):
         state, acc = append(state, acc, jnp.int32(k * doc_rows), tags, meters, valid)
-        hist = upd_hist(hist, tags, meters, valid)
+        hist = upd_hist(hist, svc_id, meters, valid)
         k += 1
         if k == K:
             state, acc = fold(state, acc)
@@ -180,12 +183,23 @@ def config2(quick: bool):
     _ = np.asarray(state.slot[:1])
     rate = BATCH * iters / (time.perf_counter() - t0 - fetch_base)
 
-    means, weights = tdigest_from_loghist(hist[:1], spec)
+    # pooled distribution over ALL services (merge = histogram sum),
+    # plus one per-service row as a spot check
+    pooled = hist.sum(axis=0, keepdims=True)
+    means, weights = tdigest_from_loghist(pooled, spec)
     p50, p99 = np.asarray(
         tdigest_quantile(means[0], weights[0], jnp.asarray([0.5, 0.99]))
     )
+    svc0 = tdigest_from_loghist(hist[:1], spec)
+    s_p50, s_p99 = np.asarray(
+        tdigest_quantile(svc0[0][0], svc0[1][0], jnp.asarray([0.5, 0.99]))
+    )
+    # an empty-sketch regression must never be recordable again
+    assert float(p99) > 0.0, "c2 pooled histogram is empty"
+    assert float(s_p99) > 0.0, "c2 service-0 histogram is empty"
     emit("c2_l7_red_tdigest", rate, "requests/s", rate / NORTH_STAR,
-         p50_us=float(p50), p99_us=float(p99))
+         p50_us=float(p50), p99_us=float(p99),
+         svc0_p50_us=float(s_p50), svc0_p99_us=float(s_p99))
 
 
 def config3(quick: bool):

@@ -54,6 +54,11 @@ def _bolt_header(payload: bytes):
     p = off + 9  # past ver2, reqid, codec
     if proto == 2:
         p += 1  # switch byte
+    # exact per-variant minimum: truncated tail slices would silently
+    # decode as 0 through int.from_bytes, misparsing len fields
+    body_off = p + (2 if typ == _BOLT_TYPE_RESP else 4) + 8
+    if len(payload) < body_off:
+        return None
     resp_status = 0
     if typ == _BOLT_TYPE_RESP:
         resp_status = int.from_bytes(payload[p : p + 2], "big")
@@ -416,8 +421,10 @@ def check_pulsar(payload: bytes, port: int = 0) -> bool:
     cmd_size = int.from_bytes(payload[4:8], "big")
     if cmd_size + 4 > total or total > (1 << 26):
         return False
+    # field 1 (type) may legally appear after other BaseCommand fields
     for field, wt, val in _pb_fields(payload[8 : 8 + cmd_size]):
-        return field == 1 and wt == 0 and val in _PULSAR_CMDS
+        if field == 1 and wt == 0:
+            return val in _PULSAR_CMDS
     return False
 
 
@@ -429,7 +436,7 @@ def parse_pulsar(payload: bytes) -> L7Message | None:
     for field, wt, val in _pb_fields(payload[8 : 8 + cmd_size]):
         if field == 1 and wt == 0:
             cmd_type = val
-        break
+            break
     name = _PULSAR_CMDS.get(cmd_type)
     if name is None:
         return None

@@ -22,6 +22,7 @@ ACL order is priority order: the first matching ACL wins
 from __future__ import annotations
 
 import dataclasses
+import logging
 import struct
 
 import numpy as np
@@ -56,6 +57,12 @@ class Acl:
     dst_ports: tuple | None = None
     protocol: int = 0  # 0 = any IP protocol
     symmetric: bool = True  # match the reverse direction too
+
+    def __post_init__(self):
+        # 0 is the no-match sentinel in match() output; an id-0 ACL's
+        # hits would be silently dropped from usage metering
+        if self.id < 1:
+            raise ValueError(f"ACL id must be >= 1, got {self.id}")
 
 
 class PolicyLabeler:
@@ -242,6 +249,11 @@ def acls_from_config(spec: list[dict]) -> tuple[Acl, ...]:
      "protocol": int, "symmetric": bool} — all but id optional."""
     out = []
     for e in spec:
+        if int(e.get("id", 0)) < 1:
+            # a remotely pushed bad entry must not abort the whole
+            # dynamic-config apply — skip it, keep the rest
+            logging.warning("dropping ACL with invalid id %r", e.get("id"))
+            continue
         out.append(
             Acl(
                 id=int(e["id"]),

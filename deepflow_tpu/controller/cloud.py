@@ -201,11 +201,19 @@ class CloudTask:
         domain = self.source.domain
         # second pass: resolve _pod_uid → pod_id (ids exist after the
         # first reconcile; fresh pods resolve on the next poll, which
-        # reconcile's vif change-detection triggers)
-        for v in snap.get("vinterfaces", []):
-            uid = v.pop("_pod_uid", None)
-            if uid is not None:
-                v["pod_id"] = self.recorder.id_of(domain, "pod", uid) or 0
+        # reconcile's vif change-detection triggers). Rebuild rows
+        # instead of popping in place: snapshot() may alias the
+        # source's own documents (e.g. FileReaderPlatform's dicts).
+        vifs = snap.get("vinterfaces")
+        if vifs:
+            resolved = []
+            for v in vifs:
+                uid = v.get("_pod_uid")
+                if uid is not None:
+                    v = {k: x for k, x in v.items() if k != "_pod_uid"}
+                    v["pod_id"] = self.recorder.id_of(domain, "pod", uid) or 0
+                resolved.append(v)
+            snap = dict(snap, vinterfaces=resolved)
         self.last_change = self.recorder.reconcile(domain, snap)
         self.counters["polls"] += 1
         return self.last_change
