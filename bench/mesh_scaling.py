@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Mesh-scaling rows for BASELINE config 5 — the r4 verdict's demand
+that c5 be a *mesh* statement, not a tunnel-latency measurement.
+
+Runs the sharded pipeline on a virtual CPU mesh at 1/2/4/8 devices
+(XLA_FLAGS=--xla_force_host_platform_device_count=8, the same
+environment dryrun_multichip validates), at a FIXED per-device batch
+(weak scaling, the pod-firehose shape), timing:
+
+  * steady ingest cycles (step + amortized fold) — chained, no host
+    round trip inside the loop;
+  * the collective window close (psum/pmax sketch merges over
+    chip/host axes) separately, since that is the mesh-specific cost.
+
+Prints one JSON line: {"rows": [{n_devices, ingest_rec_s,
+close_ms, ...}, ...]}. bench_all.py config5 shells out to this and
+embeds the rows in PERF_ALL's c5 detail.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # this tool measures the CPU mesh only
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from deepflow_tpu.ingest.replay import SyntheticFlowGen  # noqa: E402
+from deepflow_tpu.ops.histogram import LogHistSpec  # noqa: E402
+from deepflow_tpu.parallel.mesh import make_mesh  # noqa: E402
+from deepflow_tpu.parallel.sharded import (  # noqa: E402
+    ShardedConfig,
+    ShardedPipeline,
+    ShardedWindowManager,
+)
+
+
+def run(n_dev: int, per_dev: int, iters: int) -> dict:
+    mesh = make_mesh(n_dev, n_hosts=2 if n_dev >= 2 else 1)
+    cfg = ShardedConfig(
+        capacity_per_device=1 << 12,
+        num_services=256,
+        hll_precision=10,
+        hist=LogHistSpec(bins=256, vmin=1.0, gamma=1.08),
+        batch_unique_cap=1 << 13,
+    )
+    pipe = ShardedPipeline(mesh, cfg)
+    wm = ShardedWindowManager(pipe)
+    batch = per_dev * n_dev
+    gen = SyntheticFlowGen(num_tuples=10_000, seed=4)
+    t0s = 1_700_000_000
+
+    # warm every compile path (step, fold, window_close, flush)
+    for wt in (t0s, t0s + 60, t0s + 61, t0s + 65):
+        fb = gen.flow_batch(batch, wt)
+        wm.ingest(fb.tags, fb.meters, fb.valid)
+
+    # steady ingest (one window, no closes inside the timed loop)
+    batches = [gen.flow_batch(batch, t0s + 70) for _ in range(iters)]
+    _ = np.asarray(wm.sketches.hll.ravel()[0])
+    t0 = time.perf_counter()
+    for fb in batches:
+        wm.ingest(fb.tags, fb.meters, fb.valid)
+    _ = np.asarray(wm.sketches.hll.ravel()[0])
+    ingest_s = time.perf_counter() - t0
+    ingest_rate = batch * iters / ingest_s
+
+    # collective close alone: psum/pmax merges over the mesh axes
+    t0 = time.perf_counter()
+    closes = 4
+    for _ in range(closes):
+        wm.sketches, _gv, _pod = pipe.window_close(wm.sketches)
+    _ = np.asarray(wm.sketches.hll.ravel()[0])
+    close_ms = (time.perf_counter() - t0) / closes * 1e3
+
+    return {
+        "n_devices": n_dev,
+        "per_device_batch": per_dev,
+        "ingest_rec_s": round(ingest_rate, 1),
+        "close_ms": round(close_ms, 3),
+    }
+
+
+def main():
+    per_dev = int(os.environ.get("MESH_PER_DEV", 1 << 13))
+    iters = int(os.environ.get("MESH_ITERS", 8))
+    rows = [run(n, per_dev, iters) for n in (1, 2, 4, 8)]
+    print(json.dumps({"rows": rows}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

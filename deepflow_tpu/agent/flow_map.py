@@ -26,9 +26,14 @@ pin these semantics):
     pure ACK from the SYN side) − t(first SYN+ACK). TcpPerf's
     continuous per-ACK srt/art tracking is approximated by the
     handshake estimate.
-  * Retransmissions count within-batch duplicate sequence ranges
-    (segmented prefix-max over the sorted batch); cross-batch
-    duplicates are missed.
+  * Retransmissions: within a batch, exact duplicate (flow, dir, seq,
+    len) data segments; across batches, a host-side per-flow
+    high-water mark (seq_end per direction, the TcpPerf SeqSegment
+    seat) flags data segments ending at or below bytes already seen in
+    an earlier batch (tcp.rs retrans detection on seq < expected).
+    Partial overlaps straddling the mark are missed; reordering within
+    one batch is never false-flagged (golden-pinned against the
+    reference's xiangdao-retrans.result at batch_size 1 and whole-pcap).
 """
 
 from __future__ import annotations
@@ -134,7 +139,9 @@ class FlowTimeouts:
 # packet batch → flow-row updates (pure function of PacketBatch columns)
 
 
-def packets_to_flow_rows(p: PacketBatch) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+def packets_to_flow_rows(
+    p: PacketBatch, seq_tracker: dict | None = None
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """PacketBatch → (ints [N, Ki], nums [N, Kn], valid) FLOW_STATE rows.
 
     Endpoint canonicalization: ep0 is the lexicographically smaller
@@ -222,10 +229,66 @@ def packets_to_flow_rows(p: PacketBatch) -> tuple[np.ndarray, np.ndarray, np.nda
         same[1:] = eq
     retrans = np.zeros(n, bool)
     retrans[order] = same & is_data[order]
+
+    if seq_tracker is not None and n:
+        # the seq-list pass processes packets in arrival order, so it
+        # subsumes the within-batch duplicate rule above
+        retrans = _seq_list_retrans(
+            seq_tracker, hi, lo, d1, p.seq, p.payload_len, is_data
+        )
     nums[:, _NI("retrans_d0")] = retrans & ~d1
     nums[:, _NI("retrans_d1")] = retrans & d1
 
     return ints, nums, p.valid.copy()
+
+
+SEQ_LIST_MAX_LEN = 16  # perf/tcp.rs:80
+
+
+def _seq_list_retrans(tracker: dict, hi, lo, d1, seq, plen, is_data):
+    """Per-(flow, dir) seen-byte interval lists — the TcpPerf seq_list
+    (perf/tcp.rs:84, is_retrans_segment:266): a data segment whose whole
+    range was already transmitted is a retransmission. Sequential in
+    arrival order (duplicates inside one batch count too), carried
+    across batches via `tracker`. Sequence wrap is handled by storing
+    intervals as signed offsets from the flow's first-seen seq; at 16
+    intervals the two oldest merge (the reference merges at the tail,
+    tcp.rs:330). Partial overlaps are NOT flagged (the reference splits
+    and counts only fully-seen ranges the same way)."""
+    n = hi.shape[0]
+    out = np.zeros(n, bool)
+    idx = np.nonzero(is_data)[0]
+    for i in idx:
+        key = (int(hi[i]), int(lo[i]), int(d1[i]))
+        s32 = int(seq[i])
+        ln = int(plen[i])
+        ent = tracker.get(key)
+        if ent is None:
+            anchor = s32
+            ivals: list[list[int]] = []
+            tracker[key] = (anchor, ivals)
+        else:
+            anchor, ivals = ent
+        # wrap-tolerant signed offset from the anchor
+        s = ((s32 - anchor + (1 << 31)) & 0xFFFFFFFF) - (1 << 31)
+        e = s + ln
+        covered = any(a <= s and e <= b for a, b in ivals)
+        if covered:
+            out[i] = True
+            continue
+        # insert + merge (list stays sorted and disjoint; adjacency
+        # merges so contiguous transmissions form one range)
+        before = [iv for iv in ivals if iv[1] < s]
+        after = [iv for iv in ivals if iv[0] > e]
+        for a, b in ivals:
+            if not (b < s or a > e):
+                s, e = min(a, s), max(b, e)
+        merged = before + [(s, e)] + after
+        if len(merged) > SEQ_LIST_MAX_LEN:
+            merged[0] = (merged[0][0], merged[1][1])
+            del merged[1]
+        tracker[key] = (anchor, merged)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -389,6 +452,10 @@ class FlowMap:
         self.timeouts = timeouts
         self.agent_id = agent_id
         self.state = log_stash_init(capacity, FLOW_STATE)
+        # host-side per-(flow, dir) seq high-water marks for cross-batch
+        # retrans detection; bounded, oldest-quarter evicted on overflow
+        self.seq_tracker: dict = {}
+        self.seq_tracker_cap = max(1024, 4 * capacity)
         self.counters = {"packets_in": 0, "invalid_packets": 0, "flows_emitted": 0, "flows_closed": 0}
         register_countable("flow_map", self)
 
@@ -399,7 +466,13 @@ class FlowMap:
         return c
 
     def inject(self, p: PacketBatch) -> None:
-        ints, nums, valid = packets_to_flow_rows(p)
+        ints, nums, valid = packets_to_flow_rows(p, self.seq_tracker)
+        if len(self.seq_tracker) > self.seq_tracker_cap:
+            import itertools
+
+            for k in list(itertools.islice(iter(self.seq_tracker),
+                                           self.seq_tracker_cap // 4)):
+                del self.seq_tracker[k]
         n = ints.shape[0]
         if n > self.batch_size:
             raise ValueError(f"packet batch {n} > batch_size {self.batch_size}")
@@ -432,6 +505,17 @@ class FlowMap:
         self.state, raw = _flow_tick(self.state, np.uint32(now), cfg)
         n = int(raw["count"])
         self.counters["flows_emitted"] += n
+        # closed flows release their seq-tracker entries — without this,
+        # churn would evict still-active flows' marks (FIFO backstop)
+        # while dead keys lingered
+        if n and self.seq_tracker:
+            closed = np.asarray(raw["close"][:n]).astype(bool)
+            if closed.any():
+                fi = np.asarray(raw["ints"][:n])[closed]
+                hi, lo = fingerprint64(fi[:, FLOW_STATE.key_cols], xp=np)
+                for h, l in zip(hi, lo):
+                    for d in (0, 1):
+                        self.seq_tracker.pop((int(h), int(l), d), None)
         emitted = _emission_to_l4_rows(
             {k: np.asarray(v[:n]) for k, v in raw.items() if k != "count"},
             n,

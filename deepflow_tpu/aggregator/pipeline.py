@@ -37,11 +37,17 @@ def batch_prereduce(tags, meters, valid, interval, cap, sum_cols, max_cols):
     Returns (tags, meters [cap, M], valid, dropped) — rows beyond `cap`
     unique keys are shed; callers count `dropped` (newest-shed
     stance)."""
+    from ..ops.hashing import SEED_HI, SEED_LO, _fold
     from ..ops.segment import groupby_reduce
 
     names = sorted(tags)
-    tags_t = jnp.stack([jnp.asarray(tags[k], jnp.uint32) for k in names])
-    hi, lo = fingerprint64_t(tags_t)
+    cols = [jnp.asarray(tags[k], jnp.uint32) for k in names]
+    tags_t = jnp.stack(cols)
+    # fold the columns directly — hashing through the [T, N] stack costs
+    # an extra materialization (~4 ms at 2M rows, r5 bisect V2); the
+    # stack itself is still needed as the groupby payload
+    hi = _fold(cols, SEED_HI, jnp)
+    lo = _fold(cols, SEED_LO, jnp)
     slot = jnp.asarray(tags["timestamp"], jnp.uint32) // jnp.uint32(interval)
     g = groupby_reduce(
         slot, hi, lo, tags_t, jnp.transpose(meters), valid,

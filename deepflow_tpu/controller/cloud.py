@@ -137,6 +137,13 @@ class KubernetesGather:
                 )
             pod_uid = f"{cluster_uid}/pod/{ns}/{md['name']}"
             pod_ip = pod.get("status", {}).get("podIP", "")
+            # container env vars (first container wins per key) — feeds
+            # the ch_pod_k8s_env* dictionary seat
+            envs: dict[str, str] = {}
+            for c in pod.get("spec", {}).get("containers", []):
+                for ev in c.get("env", []) or []:
+                    if "name" in ev and "value" in ev:
+                        envs.setdefault(ev["name"], str(ev["value"]))
             res["pod"].append(
                 {
                     "uid": pod_uid,
@@ -145,6 +152,9 @@ class KubernetesGather:
                     "node": pod.get("spec", {}).get("nodeName", ""),
                     "group": owner,
                     "ip": pod_ip,
+                    "labels": dict(md.get("labels", {})),
+                    "annotations": dict(md.get("annotations", {})),
+                    "envs": envs,
                 }
             )
             if pod_ip:
@@ -199,19 +209,25 @@ class CloudTask:
     def poll(self):
         snap = self.source.snapshot()
         domain = self.source.domain
-        # second pass: resolve _pod_uid → pod_id (ids exist after the
-        # first reconcile; fresh pods resolve on the next poll, which
-        # reconcile's vif change-detection triggers). Rebuild rows
-        # instead of popping in place: snapshot() may alias the
+        # second pass: resolve uid markers → recorder ids (ids exist
+        # after the first reconcile; fresh resources resolve on the next
+        # poll, which reconcile's vif change-detection triggers).
+        # `_pod_uid: uid` is the K8s shorthand; `_refs: [(field, kind,
+        # uid), ...]` is the general form cloud adapters emit. Rebuild
+        # rows instead of popping in place: snapshot() may alias the
         # source's own documents (e.g. FileReaderPlatform's dicts).
         vifs = snap.get("vinterfaces")
         if vifs:
             resolved = []
             for v in vifs:
                 uid = v.get("_pod_uid")
+                refs = list(v.get("_refs") or ())
                 if uid is not None:
-                    v = {k: x for k, x in v.items() if k != "_pod_uid"}
-                    v["pod_id"] = self.recorder.id_of(domain, "pod", uid) or 0
+                    refs.append(("pod_id", "pod", uid))
+                if refs:
+                    v = {k: x for k, x in v.items() if k not in ("_pod_uid", "_refs")}
+                    for field, kind, ruid in refs:
+                        v[field] = self.recorder.id_of(domain, kind, ruid) or 0
                 resolved.append(v)
             snap = dict(snap, vinterfaces=resolved)
         self.last_change = self.recorder.reconcile(domain, snap)

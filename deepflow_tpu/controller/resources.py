@@ -130,6 +130,11 @@ class ResourceDB:
         yield from snapshot.items()
 
     # -- consumers ------------------------------------------------------
+    def vinterfaces(self) -> list[dict]:
+        """Normalized vinterface rows (copies)."""
+        with self._lock:
+            return [dict(v) for v in self._vifs]
+
     def build_platform_table(self, my_region_id: int = 0) -> PlatformInfoTable:
         """The grpc_platformdata refresh path: resources → the enrichment
         kernel's host-side builder."""

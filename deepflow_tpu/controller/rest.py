@@ -187,6 +187,11 @@ class RestServer:
                     for p in default_collector.tick()
                 ]
             )
+        elif u.path == "/v1/query/catalog":
+            # db_descriptions seat: tag + metric catalogs per table
+            h._json(df.query.catalogs(q.get("table", "network")))
+        elif u.path == "/v1/query/tables":
+            h._json({db: sorted(df.store.tables(db)) for db in df.store.databases()})
         elif u.path == "/v1/prom":
             from ..querier.promql import query_instant
 

@@ -1,14 +1,22 @@
 """tagrecorder — materializes resources into flow_tag dictionaries.
 
-The reference runs ~50 `ch_*.go` updaters that diff MySQL resource
+The reference runs ~66 `ch_*.go` updaters that diff MySQL resource
 tables into ClickHouse `flow_tag.*_map` dictionaries consumed by the
 querier's dictGet translation (controller/tagrecorder/; SURVEY §3.5).
 Here one updater serves every kind: on a resource-version change it
 rewrites the `<kind>_map` tables in the flow_tag db (id, name + the
 attrs the querier surfaces) and invalidates the translator cache.
+
+K8s metadata dictionaries (ch_pod_k8s_label.go / _labels / _annotation
+/ _annotations / _env / _envs): pods discovered with labels/annotations
+/envs attrs materialize both the singular per-key map (id, key, value —
+the `k8s.label.<key>` custom-tag lookup) and the plural one-row-per-pod
+map (id, the whole dict JSON-encoded — the `k8s.labels` column seat).
 """
 
 from __future__ import annotations
+
+import json
 
 import numpy as np
 
@@ -16,6 +24,13 @@ from ..storage.store import ColumnarStore, ColumnSpec, TableSchema
 from .resources import KINDS, ResourceDB
 
 FLOW_TAG_DB = "flow_tag"
+
+# pod attr → (singular table stem, plural table stem)
+_K8S_META = {
+    "labels": ("pod_k8s_label_map", "pod_k8s_labels_map"),
+    "annotations": ("pod_k8s_annotation_map", "pod_k8s_annotations_map"),
+    "envs": ("pod_k8s_env_map", "pod_k8s_envs_map"),
+}
 
 
 def _map_schema(kind: str) -> TableSchema:
@@ -25,6 +40,31 @@ def _map_schema(kind: str) -> TableSchema:
             ColumnSpec("time", "u4"),
             ColumnSpec("id", "u4"),
             ColumnSpec("name", "U256"),
+        ),
+        partition_s=1 << 30,
+    )
+
+
+def _kv_schema(name: str) -> TableSchema:
+    return TableSchema(
+        name,
+        (
+            ColumnSpec("time", "u4"),
+            ColumnSpec("id", "u4"),
+            ColumnSpec("key", "U128"),
+            ColumnSpec("value", "U256"),
+        ),
+        partition_s=1 << 30,
+    )
+
+
+def _plural_schema(name: str) -> TableSchema:
+    return TableSchema(
+        name,
+        (
+            ColumnSpec("time", "u4"),
+            ColumnSpec("id", "u4"),
+            ColumnSpec("value", "U1024"),
         ),
         partition_s=1 << 30,
     )
@@ -62,8 +102,52 @@ class TagRecorder:
                     },
                 )
                 self.counters["rows"] += len(resources)
+        self._sync_k8s_meta()
         self._synced_version = version
         self.counters["syncs"] += 1
         if self.translator is not None:
             self.translator.invalidate()
         return True
+
+    def _sync_k8s_meta(self) -> None:
+        """Materialize pod label/annotation/env dictionaries, singular
+        (per key) and plural (whole dict) forms."""
+        pods = self.db.list("pod")
+        for attr, (singular, plural) in _K8S_META.items():
+            ids, keys, values = [], [], []
+            p_ids, p_values = [], []
+            for r in pods:
+                kv = r.attrs.get(attr) or {}
+                if not isinstance(kv, dict):
+                    continue
+                for k, v in sorted(kv.items()):
+                    ids.append(r.id)
+                    keys.append(str(k))
+                    values.append(str(v))
+                if kv:
+                    p_ids.append(r.id)
+                    p_values.append(json.dumps(kv, sort_keys=True))
+            for name, schema in ((singular, _kv_schema(singular)),
+                                 (plural, _plural_schema(plural))):
+                self.store.create_table(FLOW_TAG_DB, schema)
+                for pid in self.store.partitions(FLOW_TAG_DB, name):
+                    self.store.drop_partition(FLOW_TAG_DB, name, pid)
+            if ids:
+                self.store.insert(
+                    FLOW_TAG_DB, singular,
+                    {
+                        "time": np.zeros(len(ids), np.uint32),
+                        "id": np.asarray(ids, np.uint32),
+                        "key": np.asarray(keys),
+                        "value": np.asarray(values),
+                    },
+                )
+                self.store.insert(
+                    FLOW_TAG_DB, plural,
+                    {
+                        "time": np.zeros(len(p_ids), np.uint32),
+                        "id": np.asarray(p_ids, np.uint32),
+                        "value": np.asarray(p_values),
+                    },
+                )
+                self.counters["rows"] += len(ids) + len(p_ids)

@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import dataclasses
 
-from ..ingest.codec import _get_varint, _iter_fields
+from ..ingest.codec import _get_varint, _iter_fields, _put_varint
 
 # ---------------------------------------------------------------------------
 # InfluxDB line protocol
@@ -373,3 +373,223 @@ def parse_folded(text: str) -> tuple[list[ProfileSample], int]:
         except ValueError:
             errors += 1
     return out, errors
+
+
+# ---------------------------------------------------------------------------
+# OTLP encoders — the export half of the subsets parsed above
+# (exporters/otlp_exporter/otlp_exporter.go builds the same messages via
+# the generated SDK; here the encoder is the byte-level inverse of
+# parse_otlp_traces / parse_otlp_metrics so round-trips are testable).
+
+
+def _pb_str(out: bytearray, field: int, s: str) -> None:
+    b = s.encode()
+    _put_varint(out, field << 3 | 2)
+    _put_varint(out, len(b))
+    out += b
+
+
+def _pb_bytes(out: bytearray, field: int, b: bytes) -> None:
+    _put_varint(out, field << 3 | 2)
+    _put_varint(out, len(b))
+    out += b
+
+
+def _pb_varint(out: bytearray, field: int, v: int) -> None:
+    _put_varint(out, field << 3 | 0)
+    _put_varint(out, int(v) & ((1 << 64) - 1))
+
+
+def _pb_fixed64(out: bytearray, field: int, v: int) -> None:
+    # OTLP declares *_time_unix_nano as fixed64 — emitting varint here
+    # would make spec-conformant decoders drop every timestamp
+    _put_varint(out, field << 3 | 1)
+    out += (int(v) & ((1 << 64) - 1)).to_bytes(8, "little")
+
+
+def _kv_str(key: str, value: str) -> bytes:
+    av = bytearray()
+    _pb_str(av, 1, value)  # AnyValue.string_value
+    kv = bytearray()
+    _pb_str(kv, 1, key)
+    _pb_bytes(kv, 2, bytes(av))
+    return bytes(kv)
+
+
+def _resource_block(service: str) -> bytes:
+    res = bytearray()
+    _pb_bytes(res, 1, _kv_str("service.name", service))  # Resource.attributes
+    return bytes(res)
+
+
+def _hex_bytes(s: str) -> bytes:
+    s = (s or "").strip()
+    if len(s) % 2:
+        s = "0" + s
+    try:
+        return bytes.fromhex(s)
+    except ValueError:
+        return b""
+
+
+def encode_otlp_traces(spans: list[OtelSpan]) -> bytes:
+    """OtelSpan rows → ExportTraceServiceRequest bytes (grouped by
+    service into one ResourceSpans each)."""
+    by_service: dict[str, list[OtelSpan]] = {}
+    for s in spans:
+        by_service.setdefault(s.service, []).append(s)
+    out = bytearray()
+    for service, group in by_service.items():
+        ss = bytearray()  # ScopeSpans
+        for s in group:
+            sp = bytearray()
+            _pb_bytes(sp, 1, _hex_bytes(s.trace_id))
+            _pb_bytes(sp, 2, _hex_bytes(s.span_id))
+            if s.parent_span_id:
+                _pb_bytes(sp, 4, _hex_bytes(s.parent_span_id))
+            _pb_str(sp, 5, s.name)
+            if s.kind:
+                _pb_varint(sp, 6, s.kind)
+            _pb_fixed64(sp, 7, s.start_us * 1000)
+            _pb_fixed64(sp, 8, s.end_us * 1000)
+            for k, v in s.attributes.items():
+                _pb_bytes(sp, 9, _kv_str(k, str(v)))
+            if s.status_code:
+                st = bytearray()
+                _pb_varint(st, 3, s.status_code)
+                _pb_bytes(sp, 15, bytes(st))
+            _pb_bytes(ss, 2, bytes(sp))  # ScopeSpans.spans
+        rs = bytearray()
+        _pb_bytes(rs, 1, _resource_block(service))
+        _pb_bytes(rs, 2, bytes(ss))  # ResourceSpans.scope_spans
+        out2 = bytearray()
+        _pb_bytes(out2, 1, bytes(rs))
+        out += out2
+    return bytes(out)
+
+
+@dataclasses.dataclass
+class OtlpMetricPoint:
+    attributes: dict[str, str]
+    time_ns: int
+    value: float
+
+
+@dataclasses.dataclass
+class OtlpMetric:
+    service: str
+    name: str
+    unit: str
+    monotonic: bool  # True → Sum (cumulative counter), False → Gauge
+    points: list[OtlpMetricPoint]
+
+
+def encode_otlp_metrics(metrics: list[OtlpMetric]) -> bytes:
+    """OtlpMetric rows → ExportMetricsServiceRequest bytes
+    (opentelemetry.proto.metrics.v1: ResourceMetrics{resource,
+    scope_metrics{metrics{name, unit, sum|gauge{data_points}}}})."""
+    import struct
+
+    by_service: dict[str, list[OtlpMetric]] = {}
+    for m in metrics:
+        by_service.setdefault(m.service, []).append(m)
+    out = bytearray()
+    for service, group in by_service.items():
+        sm = bytearray()  # ScopeMetrics
+        for m in group:
+            mb = bytearray()
+            _pb_str(mb, 1, m.name)
+            if m.unit:
+                _pb_str(mb, 3, m.unit)
+            dps = bytearray()
+            for p in m.points:
+                dp = bytearray()
+                for k, v in p.attributes.items():
+                    _pb_bytes(dp, 7, _kv_str(k, str(v)))  # NumberDataPoint.attributes
+                _pb_fixed64(dp, 3, p.time_ns)  # time_unix_nano
+                _put_varint(dp, 4 << 3 | 1)  # as_double fixed64
+                dp += struct.pack("<d", p.value)
+                _pb_bytes(dps, 1, bytes(dp))
+            if m.monotonic:
+                _pb_varint(dps, 2, 2)  # AGGREGATION_TEMPORALITY_CUMULATIVE
+                _pb_varint(dps, 3, 1)  # is_monotonic
+                _pb_bytes(mb, 7, bytes(dps))  # Metric.sum
+            else:
+                _pb_bytes(mb, 5, bytes(dps))  # Metric.gauge
+            _pb_bytes(sm, 2, bytes(mb))  # ScopeMetrics.metrics
+        rm = bytearray()
+        _pb_bytes(rm, 1, _resource_block(service))
+        _pb_bytes(rm, 2, bytes(sm))
+        out2 = bytearray()
+        _pb_bytes(out2, 1, bytes(rm))
+        out += out2
+    return bytes(out)
+
+
+def parse_otlp_metrics(body: bytes) -> list[OtlpMetric]:
+    """Inverse subset of encode_otlp_metrics (round-trip pin + any
+    future OTLP-metrics intake)."""
+    import struct
+
+    out: list[OtlpMetric] = []
+    try:
+        rms = [bytes(v) for f, v in _iter_fields(body) if f == 1]
+    except Exception:
+        return out
+    for rm in rms:
+        service = ""
+        sms = []
+        try:
+            for f2, v2 in _iter_fields(rm):
+                if f2 == 1:
+                    attrs = [bytes(v3) for f3, v3 in _iter_fields(bytes(v2)) if f3 == 1]
+                    service = _attributes(attrs).get("service.name", "")
+                elif f2 == 2:
+                    sms.append(bytes(v2))
+        except Exception:
+            continue
+        for sm in sms:
+            try:
+                metric_bufs = [bytes(v) for f, v in _iter_fields(sm) if f == 2]
+            except Exception:
+                continue
+            for mb in metric_bufs:
+                name = unit = ""
+                monotonic = False
+                dp_parent = None
+                try:
+                    for f3, v3 in _iter_fields(mb):
+                        if f3 == 1:
+                            name = bytes(v3).decode(errors="replace")
+                        elif f3 == 3:
+                            unit = bytes(v3).decode(errors="replace")
+                        elif f3 == 5:
+                            dp_parent = bytes(v3)
+                        elif f3 == 7:
+                            dp_parent = bytes(v3)
+                            monotonic = True
+                except Exception:
+                    continue
+                points = []
+                if dp_parent is not None:
+                    try:
+                        for f4, v4 in _iter_fields(dp_parent):
+                            if f4 != 1:
+                                continue
+                            attrs, t_ns, val = [], 0, 0.0
+                            for f5, v5 in _iter_fields(bytes(v4)):
+                                if f5 == 7:
+                                    attrs.append(bytes(v5))
+                                elif f5 == 3:
+                                    t_ns = int(v5)
+                                elif f5 == 4:
+                                    val = struct.unpack(
+                                        "<d", int(v5).to_bytes(8, "little")
+                                    )[0]
+                            points.append(
+                                OtlpMetricPoint(_attributes(attrs), t_ns, val)
+                            )
+                    except Exception:
+                        pass
+                out.append(OtlpMetric(service, name, unit, monotonic, points))
+    return out

@@ -154,11 +154,11 @@ def groupby_reduce(
     else:
         out_meters = jnp.zeros((0, cap), meters_t.dtype)
 
-    # First sorted position of each kept segment (head positions), via a
-    # segment_min instead of a second full sort.
-    first_pos = jax.ops.segment_min(
-        iota, seg_id, num_segments=cap, indices_are_sorted=True
-    )
+    # First sorted position of each kept segment: seg_id is ascending by
+    # construction, so first occurrence = binary search. A segment_min
+    # here measured ~24 ms at 2M rows (r5 bisect, stage G−F) because
+    # TPU scatter reductions cost per ROW; searchsorted is O(cap·log N).
+    first_pos = jnp.searchsorted(seg_id, jnp.arange(cap, dtype=jnp.int32))
 
     k = jnp.arange(cap, dtype=jnp.int32)
     seg_valid = k < jnp.minimum(num_seg, cap)

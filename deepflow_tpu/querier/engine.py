@@ -21,11 +21,25 @@ from typing import Any
 import jax
 import numpy as np
 
-from .metrics import expand, list_metrics
+from .metrics import (
+    datasource_interval,
+    expand,
+    expand_row,
+    is_delay,
+    list_metrics,
+    metric_type,
+)
 from .sqlparse import BinOp, Func, Ident, InList, Literal, Query, SQLError, UnaryOp, parse
 from .translation import Translator
 
-_AGG_FUNCS = {"sum", "max", "min", "avg", "count", "uniq", "percentile"}
+# row→group reducers (view/function.go FUNCTION_*)
+_AGG_FUNCS = {
+    "sum", "max", "min", "avg", "aavg", "count", "uniq", "uniqexact",
+    "countdistinct", "percentile", "percentileexact", "stddev", "spread",
+    "rspread", "apdex", "last", "any", "topk", "histogram",
+}
+# group-level math wrappers that force aggregation even over bare columns
+_AGG_WRAPPERS = {"persecond", "percentage", "derivative", "nonnegativederivative"}
 
 
 @dataclasses.dataclass
@@ -72,6 +86,28 @@ class QueryEngine:
             # ORDER BY keeps the pre-expansion expr: resolution first
             # matches select-output names, then expands for evaluation
             order_by=tuple(q.order_by),
+            having=self._expand(table, q.having) if q.having is not None else None,
+        )
+
+        # GROUP BY / HAVING may name a select alias ("group by time_120",
+        # "having cnt > 5", clickhouse_test.go:60) — substitute the
+        # aliased expression
+        alias_map = {it.alias: it.expr for it in q.select
+                     if it.alias and it.alias not in colnames}
+        q = dataclasses.replace(
+            q,
+            group_by=tuple(
+                alias_map[e.name]
+                if isinstance(e, Ident) and e.name in alias_map
+                else self._expand(table, e)
+                for e in q.group_by
+            ),
+            having=(_subst_aliases(q.having, alias_map)
+                    if q.having is not None else None),
+        )
+
+        has_agg = bool(q.group_by) or q.having is not None or any(
+            _has_aggregate(it.expr) for it in q.select
         )
 
         aliases = {it.alias for it in q.select if it.alias}
@@ -84,6 +120,11 @@ class QueryEngine:
             _collect_idents(self._expand(table, e), needed)
         if q.where is not None:
             _collect_idents(q.where, needed)
+        if q.having is not None:
+            _collect_idents(q.having, needed)
+        if has_agg:
+            # Last/Derivative/Counter_Avg need the time axis
+            needed.add(schema.time_column)
         star = "*" in needed
         needed.discard("*")
         # ORDER BY may reference select output names; real columns stay
@@ -108,11 +149,8 @@ class QueryEngine:
             mask = np.asarray(ctx.eval(q.where), bool)
             ctx = ctx.masked(mask)
 
-        has_agg = bool(q.group_by) or any(
-            _has_aggregate(it.expr) for it in q.select
-        )
         if has_agg:
-            return self._run_aggregate(q, ctx, table)
+            return self._run_aggregate(q, ctx, table, schema, trange)
         return self._run_plain(q, ctx, schema)
 
     # -- helpers --------------------------------------------------------
@@ -129,17 +167,26 @@ class QueryEngine:
                 return db, cand
         raise SQLError(f"no such table {name!r}")
 
-    def _expand(self, table: str, expr):
+    def _expand(self, table: str, expr, in_agg: bool = False):
         if isinstance(expr, Ident):
-            sub = expand(table, expr.name)
+            if not in_agg:
+                sub = expand(table, expr.name)
+                if sub is not None:
+                    return sub
+            # row-level derived (Sum(byte) → SUM(byte_tx + byte_rx), and
+            # bare `byte` on log tables)
+            sub = expand_row(table, expr.name)
             if sub is not None:
                 return sub
         elif isinstance(expr, BinOp):
-            return BinOp(expr.op, self._expand(table, expr.left), self._expand(table, expr.right))
+            return BinOp(expr.op, self._expand(table, expr.left, in_agg),
+                         self._expand(table, expr.right, in_agg))
         elif isinstance(expr, UnaryOp):
-            return UnaryOp(expr.op, self._expand(table, expr.operand))
-        elif isinstance(expr, Func) and expr.name not in _AGG_FUNCS:
-            return Func(expr.name, tuple(self._expand(table, a) for a in expr.args))
+            return UnaryOp(expr.op, self._expand(table, expr.operand, in_agg))
+        elif isinstance(expr, Func) and expr.name in _AGG_FUNCS:
+            return Func(expr.name, tuple(self._expand(table, a, True) for a in expr.args))
+        elif isinstance(expr, Func):
+            return Func(expr.name, tuple(self._expand(table, a, in_agg) for a in expr.args))
         return expr
 
     def _run_plain(self, q: Query, ctx: "_EvalCtx", schema) -> Result:
@@ -160,7 +207,8 @@ class QueryEngine:
         idx = idx[q.offset : None if q.limit is None else q.offset + q.limit]
         return Result([n for n, _ in items], {k: v[idx] for k, v in values.items()})
 
-    def _run_aggregate(self, q: Query, ctx: "_EvalCtx", table: str) -> Result:
+    def _run_aggregate(self, q: Query, ctx: "_EvalCtx", table: str,
+                       schema=None, trange=None) -> Result:
         # group keys → factorized codes
         key_names = [_expr_name(e) for e in q.group_by]
         key_arrays = [np.asarray(ctx.eval(e)) for e in q.group_by]
@@ -176,7 +224,35 @@ class QueryEngine:
             gid = np.zeros(ctx.n, np.int64)
             ngroups = 1
             key_values = {}
-        agg_ctx = _AggCtx(ctx, gid, ngroups)
+
+        # time axis for Derivative/PerSecond/Counter_Avg: the group key
+        # built from interval(time, N) (or bare time), plus the partition
+        # id formed by every OTHER group key
+        group_interval = None
+        time_key = None
+        for e in q.group_by:
+            nm = _expr_name(e)
+            if isinstance(e, Func) and e.name == "interval" and len(e.args) == 2:
+                group_interval = int(e.args[1].value)
+                time_key = nm
+            elif isinstance(e, Ident) and e.name == (schema.time_column if schema else "time"):
+                time_key = nm
+        if time_key is not None and len(key_names) > 1:
+            others = [j for j, nm in enumerate(key_names) if nm != time_key]
+            partition = np.unique(uniq_rows[:, others], axis=0, return_inverse=True)[1]
+        else:
+            partition = np.zeros(ngroups, np.int64)
+        env = _AggEnv(
+            table=table,
+            ds_interval=datasource_interval(table),
+            trange=trange,
+            group_interval=group_interval,
+            time_column=schema.time_column if schema else "time",
+            group_times=(None if time_key is None
+                         else np.asarray(key_values[time_key], np.int64)),
+            partition=partition,
+        )
+        agg_ctx = _AggCtx(ctx, gid, ngroups, env)
 
         items = [(it.alias or _expr_name(it.expr), it.expr) for it in q.select]
         values: dict[str, np.ndarray] = {}
@@ -188,6 +264,12 @@ class QueryEngine:
             else:
                 v = np.asarray(agg_ctx.eval(e))
                 values[name] = np.broadcast_to(v, (ngroups,)) if v.ndim == 0 else v
+
+        keep = np.ones(ngroups, bool)
+        if q.having is not None:
+            keep = np.broadcast_to(
+                np.asarray(agg_ctx.eval(q.having), bool), (ngroups,)
+            )
         order = []
         for e, d in q.order_by:
             nm = _expr_name(e)
@@ -198,8 +280,25 @@ class QueryEngine:
             else:
                 order.append((np.asarray(agg_ctx.eval(self._expand(table, e))), d))
         idx = _order_index(order, ngroups)
+        idx = idx[keep[idx]]
         idx = idx[q.offset : None if q.limit is None else q.offset + q.limit]
         return Result([n for n, _ in items], {k: np.asarray(v)[idx] for k, v in values.items()})
+
+    def catalogs(self, table: str) -> dict:
+        """db_descriptions seat: tag + metric catalogs for one table."""
+        from .metrics import metric_catalog, tag_catalog
+
+        schema = None
+        try:
+            db, t = self._resolve_table(table)
+            schema = self.store.schema(db, t)
+        except (SQLError, KeyError):
+            pass
+        return {
+            "table": table,
+            "metrics": metric_catalog(table, schema),
+            "tags": tag_catalog(table, schema),
+        }
 
     def metrics(self, table: str) -> dict[str, str]:
         return list_metrics(table)
@@ -262,25 +361,55 @@ class _EvalCtx:
                 raise SQLError("name(tag_column)")
             col = e.args[0].name
             return self.translator.translate(self.table, col, np.asarray(self.eval(e.args[0])))
-        if e.name in _AGG_FUNCS:
+        if e.name in ("k8s_label", "k8s_annotation", "k8s_env"):
+            # k8s_label(pod_id_col, 'key') → per-row label value (the
+            # reference's `k8s.label.<key>` custom tag)
+            if len(e.args) != 2 or not isinstance(e.args[1], Literal):
+                raise SQLError(f"{e.name}(pod_id_column, 'key')")
+            ids = np.asarray(self.eval(e.args[0]))
+            return self.translator.k8s_meta(
+                e.name.removeprefix("k8s_"), str(e.args[1].value), ids
+            )
+        if e.name in _AGG_FUNCS or e.name in _AGG_WRAPPERS:
             raise SQLError(f"aggregate {e.name}() outside aggregation context")
         raise SQLError(f"unknown function {e.name!r}")
 
 
+@dataclasses.dataclass
+class _AggEnv:
+    """Time/typing context the group-level functions need (the view
+    layer's Time struct, function.go GetInterval)."""
+
+    table: str
+    ds_interval: int
+    trange: tuple[int, int] | None  # [lo, hi) from WHERE
+    group_interval: int | None  # interval(time, N) step in GROUP BY
+    time_column: str
+    group_times: np.ndarray | None  # [ngroups] time bucket per group
+    partition: np.ndarray  # [ngroups] series id from non-time group keys
+
+
 class _AggCtx:
     """Aggregate evaluation: aggregates reduce rows → groups, everything
-    above them is per-group arithmetic."""
+    above them is per-group arithmetic. Delay-type metrics get the
+    reference's ignore-zero treatment (AVGIf/MAXIf(x > 0)); Avg on a
+    counter divides the range sum by range/ds-interval (Counter_Avg)."""
 
-    def __init__(self, row_ctx: _EvalCtx, gid: np.ndarray, ngroups: int):
+    def __init__(self, row_ctx: _EvalCtx, gid: np.ndarray, ngroups: int,
+                 env: _AggEnv | None = None):
         self.row = row_ctx
         self.gid = gid
         self.ngroups = ngroups
+        self.env = env or _AggEnv("", 1, None, None, "time", None,
+                                  np.zeros(ngroups, np.int64))
 
     def eval(self, e):
         if isinstance(e, Literal):
             return e.value
         if isinstance(e, Func) and e.name in _AGG_FUNCS:
             return self._agg(e)
+        if isinstance(e, Func) and e.name in _AGG_WRAPPERS:
+            return self._wrapper(e)
         if isinstance(e, BinOp):
             return _binop(e.op, self.eval(e.left), self.eval(e.right))
         if isinstance(e, UnaryOp):
@@ -294,12 +423,95 @@ class _AggCtx:
             )
         raise SQLError(f"cannot evaluate {e!r}")
 
+    # -- helpers ---------------------------------------------------------
+    def _masked_gid(self, v: np.ndarray, mask: np.ndarray) -> np.ndarray:
+        """Rows failing `mask` get an out-of-range gid → dropped."""
+        return np.where(mask, self.gid, self.ngroups)
+
+    def _delay_arg(self, a) -> bool:
+        return isinstance(a, Ident) and is_delay(self.env.table, a.name)
+
+    def _sum(self, v, gid=None):
+        return np.asarray(jax.ops.segment_sum(
+            v.astype(np.float64), self.gid if gid is None else gid,
+            self.ngroups + 1)[: self.ngroups])
+
+    def _mean(self, v, gid):
+        s = self._sum(v, gid)
+        c = self._sum(np.ones_like(v, np.float64), gid)
+        return s / np.maximum(c, 1)
+
+    def _minmax(self, v, gid, fn):
+        r = np.asarray(fn(v.astype(np.float64), gid, self.ngroups + 1)[: self.ngroups])
+        return np.where(np.isfinite(r), r, 0.0)
+
+    def _n_intervals(self) -> float:
+        """Counter_Avg divisor: how many datasource rows one output
+        bucket spans (GetInterval, view/function.go:866-885)."""
+        env = self.env
+        ds = max(1, env.ds_interval)
+        if env.group_interval:
+            return max(1.0, env.group_interval / ds)
+        if env.trange is not None and env.trange[1] < (1 << 61):
+            lo, hi = env.trange
+            return max(1.0, (hi - lo) / ds)
+        t = self.row.cols.get(env.time_column)
+        if t is not None and len(t):
+            return max(1.0, (float(np.max(t)) - float(np.min(t))) / ds + 1)
+        return 1.0
+
+    def _series_seconds(self) -> float:
+        env = self.env
+        if env.group_interval:
+            return float(env.group_interval)
+        if env.trange is not None and env.trange[1] < (1 << 61):
+            return float(max(1, env.trange[1] - env.trange[0]))
+        return float(max(1, env.ds_interval))
+
+    # -- group-level math wrappers --------------------------------------
+    def _wrapper(self, e: Func):
+        if e.name == "persecond":
+            if len(e.args) != 1:
+                raise SQLError("PerSecond() takes one argument")
+            inner = self._auto_agg(e.args[0])
+            return np.asarray(self.eval(inner)) / self._series_seconds()
+        if e.name == "percentage":
+            if not 1 <= len(e.args) <= 2:
+                raise SQLError("Percentage() takes one or two arguments")
+            a = np.asarray(self.eval(self._auto_agg(e.args[0])), np.float64)
+            b = (np.asarray(self.eval(self._auto_agg(e.args[1])), np.float64)
+                 if len(e.args) == 2 else np.float64(1.0))
+            return np.divide(a, b, out=np.zeros(np.broadcast(a, b).shape),
+                             where=np.asarray(b) != 0) * 100.0
+        # nonNegativeDerivative over the time axis, partitioned by the
+        # other group keys (view/function.go NonNegativeDerivativeFunction)
+        if len(e.args) != 1:
+            raise SQLError("Derivative() takes one argument")
+        env = self.env
+        if env.group_times is None:
+            raise SQLError("Derivative() needs interval(time, N) or time in GROUP BY")
+        v = np.asarray(self.eval(self._auto_agg(e.args[0])), np.float64)
+        v = np.broadcast_to(v, (self.ngroups,))
+        t = env.group_times
+        out = np.zeros(self.ngroups, np.float64)
+        order = np.lexsort((t, env.partition))
+        sp, st, sv = env.partition[order], t[order], v[order]
+        same = np.concatenate([[False], sp[1:] == sp[:-1]])
+        dt = np.maximum(np.concatenate([[1], st[1:] - st[:-1]]), 1)
+        d = np.concatenate([[0.0], sv[1:] - sv[:-1]]) / dt
+        out[order] = np.where(same, np.maximum(d, 0.0), 0.0)
+        return out
+
+    def _auto_agg(self, a):
+        """Bare column/row expr inside a wrapper defaults to Sum —
+        PerSecond(byte) ≡ PerSecond(Sum(byte))."""
+        return a if _has_aggregate(a) else Func("sum", (a,))
+
+    # -- aggregates ------------------------------------------------------
     def _agg(self, e: Func):
         if e.name == "count":
-            return np.asarray(
-                jax.ops.segment_sum(np.ones(len(self.gid), np.float32), self.gid, self.ngroups)
-            )
-        if e.name == "percentile":
+            return self._sum(np.ones(len(self.gid), np.float64))
+        if e.name in ("percentile", "percentileexact"):
             # Percentile(col, p) — CK quantile analog, per group
             if len(e.args) != 2:
                 raise SQLError("percentile() takes (column, p)")
@@ -307,9 +519,11 @@ class _AggCtx:
             p = float(np.asarray(self.row.eval(e.args[1])).reshape(-1)[0])
             if not 0 <= p <= 100:
                 raise SQLError(f"percentile p out of range: {p}")
+            gid = (self._masked_gid(v, v > 0)
+                   if self._delay_arg(e.args[0]) else self.gid)
             out = np.zeros(self.ngroups, np.float64)
-            order = np.argsort(self.gid, kind="stable")
-            sg = self.gid[order]
+            order = np.argsort(gid, kind="stable")
+            sg = gid[order]
             sv = v[order]
             starts = np.searchsorted(sg, np.arange(self.ngroups))
             ends = np.searchsorted(sg, np.arange(self.ngroups) + 1)
@@ -317,29 +531,134 @@ class _AggCtx:
                 if ends[g] > starts[g]:
                     out[g] = np.percentile(sv[starts[g]:ends[g]], p)
             return out
+        if e.name == "apdex":
+            # Apdex(delay, T): (satisfied + tolerating/2) / total over
+            # x > 0, in [0, 1] (view/function.go ApdexFunction)
+            if len(e.args) != 2:
+                raise SQLError("Apdex() takes (column, threshold)")
+            v = np.asarray(self.row.eval(e.args[0])).astype(np.float64)
+            thr = float(np.asarray(self.row.eval(e.args[1])).reshape(-1)[0])
+            pos = v > 0
+            gid = self._masked_gid(v, pos)
+            sat = self._sum((pos & (v <= thr)).astype(np.float64))
+            tol = self._sum((pos & (v > thr) & (v <= 4 * thr)).astype(np.float64))
+            tot = self._sum(pos.astype(np.float64))
+            return np.divide(sat + tol / 2, tot, out=np.zeros_like(tot), where=tot > 0)
+        if e.name == "topk":
+            if len(e.args) != 2:
+                raise SQLError("TopK() takes (column, k)")
+            v = np.asarray(self.row.eval(e.args[0]))
+            k = int(np.asarray(self.row.eval(e.args[1])).reshape(-1)[0])
+            return self._per_group_json(
+                v, lambda vals: [x.item() if hasattr(x, "item") else x
+                                 for x, _ in _top_frequent(vals, k)])
+        if e.name == "histogram":
+            if len(e.args) != 2:
+                raise SQLError("Histogram() takes (column, bins)")
+            v = np.asarray(self.row.eval(e.args[0])).astype(np.float64)
+            bins = int(np.asarray(self.row.eval(e.args[1])).reshape(-1)[0])
+
+            def hist(vals):
+                vals = vals[vals > 0]
+                if not len(vals):
+                    return []
+                cnt, edges = np.histogram(vals, bins=max(1, bins))
+                return [[float(edges[i]), float(edges[i + 1]), int(cnt[i])]
+                        for i in range(len(cnt))]
+
+            return self._per_group_json(v, hist)
         if len(e.args) != 1:
             raise SQLError(f"{e.name}() takes one argument")
         v = np.asarray(self.row.eval(e.args[0]))
-        if e.name == "uniq":
+        if e.name in ("uniq", "uniqexact", "countdistinct"):
             pairs = np.stack([self.gid, np.unique(v, return_inverse=True)[1]], axis=1)
             uniq = np.unique(pairs, axis=0)
             return np.bincount(uniq[:, 0], minlength=self.ngroups).astype(np.float64)
-        v = v.astype(np.float32)
+        if e.name == "any":
+            first = self._minmax(np.arange(len(v), dtype=np.float64), self.gid,
+                                 jax.ops.segment_min).astype(np.int64)
+            return v[np.clip(first, 0, max(0, len(v) - 1))] if len(v) else v
+        if e.name == "last":
+            # argMax(x, time) (FUNCTION_LAST)
+            t = self.row.cols.get(self.env.time_column)
+            key = (np.asarray(t, np.float64) if t is not None
+                   else np.arange(len(v), dtype=np.float64))
+            order = np.lexsort((key, self.gid))
+            sg = self.gid[order]
+            starts = np.searchsorted(sg, np.arange(self.ngroups))
+            ends = np.searchsorted(sg, np.arange(self.ngroups) + 1)
+            res = np.zeros(self.ngroups, v.dtype if v.dtype.kind != "U" else object)
+            for g in range(self.ngroups):
+                if ends[g] > starts[g]:
+                    res[g] = v[order[ends[g] - 1]]
+            return res
+        v = v.astype(np.float64)
+        delay = self._delay_arg(e.args[0])
+        gid = self._masked_gid(v, v > 0) if delay else self.gid
         if e.name == "sum":
-            return np.asarray(jax.ops.segment_sum(v, self.gid, self.ngroups))
+            return self._sum(v)
+        if e.name == "aavg":
+            return self._mean(v, gid)
         if e.name == "avg":
-            s = np.asarray(jax.ops.segment_sum(v, self.gid, self.ngroups))
-            c = np.asarray(
-                jax.ops.segment_sum(np.ones_like(v), self.gid, self.ngroups)
-            )
-            return s / np.maximum(c, 1)
+            # Counter_Avg only for counter metrics (incl. expressions
+            # whose every leaf column is a counter, e.g. the expanded
+            # byte_tx + byte_rx); anything untyped averages arithmetically
+            leaves: set = set()
+            _collect_idents(e.args[0], leaves)
+            types = {metric_type(self.env.table, n) for n in leaves}
+            if leaves and types == {"counter"}:
+                # Counter_Avg: sum over the range / expected row count
+                return self._sum(v) / self._n_intervals()
+            return self._mean(v, gid)  # Delay_Avg seat: AVGIf(x, x>0)
         if e.name == "max":
-            r = np.asarray(jax.ops.segment_max(v, self.gid, self.ngroups))
-            return np.where(np.isfinite(r), r, 0.0)
+            return self._minmax(v, gid, jax.ops.segment_max)
         if e.name == "min":
-            r = np.asarray(jax.ops.segment_min(v, self.gid, self.ngroups))
-            return np.where(np.isfinite(r), r, 0.0)
+            return self._minmax(v, gid, jax.ops.segment_min)
+        if e.name == "spread":
+            return (self._minmax(v, gid, jax.ops.segment_max)
+                    - self._minmax(v, gid, jax.ops.segment_min))
+        if e.name == "rspread":
+            mx = self._minmax(v, gid, jax.ops.segment_max) + 1e-15
+            mn = self._minmax(v, gid, jax.ops.segment_min) + 1e-15
+            return mx / mn
+        if e.name == "stddev":
+            m = self._mean(v, gid)
+            m2 = self._mean(v * v, gid)
+            return np.sqrt(np.maximum(m2 - m * m, 0.0))
         raise SQLError(f"unknown aggregate {e.name!r}")
+
+    def _per_group_json(self, v: np.ndarray, fn) -> np.ndarray:
+        import json as _json
+
+        order = np.argsort(self.gid, kind="stable")
+        sg = self.gid[order]
+        starts = np.searchsorted(sg, np.arange(self.ngroups))
+        ends = np.searchsorted(sg, np.arange(self.ngroups) + 1)
+        out = np.empty(self.ngroups, object)
+        for g in range(self.ngroups):
+            out[g] = _json.dumps(fn(v[order[starts[g]:ends[g]]]))
+        return out
+
+
+def _subst_aliases(e, alias_map: dict):
+    if isinstance(e, Ident) and e.name in alias_map:
+        return alias_map[e.name]
+    if isinstance(e, BinOp):
+        return BinOp(e.op, _subst_aliases(e.left, alias_map),
+                     _subst_aliases(e.right, alias_map))
+    if isinstance(e, UnaryOp):
+        return UnaryOp(e.op, _subst_aliases(e.operand, alias_map))
+    if isinstance(e, Func):
+        return Func(e.name, tuple(_subst_aliases(a, alias_map) for a in e.args))
+    if isinstance(e, InList):
+        return InList(_subst_aliases(e.expr, alias_map), e.values, e.negated)
+    return e
+
+
+def _top_frequent(vals: np.ndarray, k: int):
+    uniq, counts = np.unique(vals, return_counts=True)
+    order = np.argsort(-counts, kind="stable")[: max(0, k)]
+    return [(uniq[i], int(counts[i])) for i in order]
 
 
 # -- small shared helpers ---------------------------------------------------
@@ -408,7 +727,8 @@ def _collect_idents(e, out: set):
 
 def _has_aggregate(e) -> bool:
     if isinstance(e, Func):
-        return e.name in _AGG_FUNCS or any(_has_aggregate(a) for a in e.args)
+        return (e.name in _AGG_FUNCS or e.name in _AGG_WRAPPERS
+                or any(_has_aggregate(a) for a in e.args))
     if isinstance(e, BinOp):
         return _has_aggregate(e.left) or _has_aggregate(e.right)
     if isinstance(e, UnaryOp):

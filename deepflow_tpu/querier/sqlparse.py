@@ -80,6 +80,7 @@ class Query:
     order_by: tuple  # of (expr, "asc"|"desc")
     limit: int | None
     offset: int
+    having: Any | None = None
 
 
 # -- lexer ------------------------------------------------------------------
@@ -98,7 +99,7 @@ _TOKEN_RE = re.compile(
 
 _KEYWORDS = {
     "select", "from", "where", "group", "order", "by", "limit", "offset",
-    "as", "and", "or", "not", "in", "asc", "desc",
+    "as", "and", "or", "not", "in", "asc", "desc", "having",
 }
 
 
@@ -263,6 +264,9 @@ class _Parser:
             group_by.append(self.parse_expr())
             while self.accept("op", ","):
                 group_by.append(self.parse_expr())
+        having = None
+        if self.accept("kw", "having"):
+            having = self.parse_expr()
         order_by: list = []
         if self.accept("kw", "order"):
             self.expect("kw", "by")
@@ -292,6 +296,7 @@ class _Parser:
             order_by=tuple(order_by),
             limit=limit,
             offset=offset,
+            having=having,
         )
 
     def _select_item(self) -> SelectItem:

@@ -66,6 +66,39 @@ class Translator:
         else:
             self._cache.pop(kind, None)
 
+    # ch_pod_k8s_label / _annotation / _env lookups — the
+    # `k8s.label.<key>` custom-tag seat (tag/translation.go dictGet on
+    # flow_tag.pod_k8s_label_map)
+    _K8S_TABLES = {
+        "label": "pod_k8s_label_map",
+        "annotation": "pod_k8s_annotation_map",
+        "env": "pod_k8s_env_map",
+    }
+
+    def _load_kv(self, table: str) -> dict[tuple[int, str], str]:
+        cache_key = f"kv:{table}"
+        m = self._cache.get(cache_key)
+        if m is not None:
+            return m
+        m = {}
+        try:
+            cols = self.store.scan(FLOW_TAG_DB, table, columns=["id", "key", "value"])
+            m = {
+                (int(i), str(k)): str(v)
+                for i, k, v in zip(cols["id"], cols["key"], cols["value"])
+            }
+        except KeyError:
+            pass
+        self._cache[cache_key] = m
+        return m
+
+    def k8s_meta(self, kind: str, key: str, pod_ids: np.ndarray) -> np.ndarray:
+        """Pod ids → the value of one label/annotation/env key ('' when
+        absent)."""
+        table = self._K8S_TABLES[kind]
+        m = self._load_kv(table)
+        return np.array([m.get((int(v), key), "") for v in pod_ids])
+
     def translate(self, table: str, column: str, ids: np.ndarray) -> np.ndarray:
         base = column[:-2] if column.endswith(("_0", "_1")) else column
         if base in _ENUMS:

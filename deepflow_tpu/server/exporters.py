@@ -149,6 +149,113 @@ class RemoteWriteExporter(Exporter):
         urllib.request.urlopen(req, timeout=5).read()
 
 
+class OtlpExporter(Exporter):
+    """OTLP/HTTP protobuf sink (exporters/otlp_exporter/otlp_exporter.go).
+
+    l7_flow_log rows → OTLP trace spans (ExportTraceServiceRequest) and
+    metric-table rows → OTLP Sum/Gauge metrics, POSTed with
+    Content-Type application/x-protobuf. Pointing `traces_url` at our
+    own IntegrationCollector's /v1/traces closes the loop: exported
+    spans re-ingest through the OTel lane (the round-trip test pins
+    this)."""
+
+
+    def __init__(self, traces_url: str = "", metrics_url: str = "", *,
+                 metrics: tuple[str, ...] = (), **kw):
+        kw.setdefault("data_sources", ("l7_flow_log", "network", "application"))
+        super().__init__(**kw)
+        self.traces_url = traces_url
+        self.metrics_url = metrics_url
+        self.metrics = metrics
+
+    def _send(self, table: str, rows: list[dict]) -> None:
+        from ..integration.formats import (
+            OtelSpan,
+            OtlpMetric,
+            OtlpMetricPoint,
+            encode_otlp_metrics,
+            encode_otlp_traces,
+        )
+
+        if table == "l7_flow_log" and self.traces_url:
+            spans = [self._row_to_span(r) for r in rows]
+            self._post(self.traces_url, encode_otlp_traces(spans))
+        elif self.metrics_url and self.metrics:
+            points: dict[str, list[OtlpMetricPoint]] = {}
+            for r in rows:
+                t_ns = int(r.get("time", 0)) * 1_000_000_000
+                attrs = {k: str(v) for k, v in r.items()
+                         if isinstance(v, str) and v and k != "time"}
+                for m in self.metrics:
+                    if m in r:
+                        points.setdefault(m, []).append(
+                            OtlpMetricPoint(attrs, t_ns, float(r[m]))
+                        )
+            from ..querier.metrics import metric_type
+
+            # counters export as monotonic cumulative Sums, everything
+            # else (delays, ratios, gauges, untyped) as Gauges
+            ms = [
+                OtlpMetric("deepflow", f"deepflow_{table}_{m}", "",
+                           metric_type(table, m) == "counter", pts)
+                for m, pts in points.items()
+            ]
+            if ms:
+                self._post(self.metrics_url, encode_otlp_metrics(ms))
+
+    @staticmethod
+    def _row_to_span(r: dict):
+        from ..datamodel.code import L7Protocol
+        from ..integration.formats import OtelSpan
+
+        tap_side = int(r.get("tap_side", 0) or 0)
+        try:
+            l7_name = L7Protocol(int(r.get("l7_protocol", 0) or 0)).name
+        except ValueError:
+            l7_name = str(r.get("l7_protocol", ""))
+        attrs = {"df.capture.tap_side": str(tap_side),
+                 "df.l7_protocol": l7_name}
+        for col, attr in (
+            ("request_type", "df.request_type"),
+            ("request_domain", "df.request_domain"),
+            ("request_resource", "df.request_resource"),
+            ("endpoint", "df.endpoint"),
+            ("x_request_id", "df.x_request_id"),
+            ("response_exception", "df.response_exception"),
+        ):
+            v = r.get(col)
+            if v:
+                attrs[attr] = str(v)
+        for col in ("status_code", "server_port", "pod_id_0", "pod_id_1",
+                    "auto_service_id_0", "auto_service_id_1"):
+            v = r.get(col)
+            if v:
+                attrs[f"df.{col}"] = str(v)
+        start_us = int(r.get("start_time", 0) or 0) * 1_000_000
+        end_us = start_us + int(r.get("response_duration", 0) or 0)
+        status = int(r.get("status", 0) or 0)  # 1 ok / 3 client / 4 server err
+        return OtelSpan(
+            service=str(r.get("app_service") or "deepflow"),
+            name=str(r.get("endpoint") or r.get("request_resource") or l7_name),
+            trace_id=str(r.get("trace_id", "") or ""),
+            span_id=str(r.get("span_id", "") or ""),
+            parent_span_id=str(r.get("parent_span_id", "") or ""),
+            # tap_side 1 = client-side capture → CLIENT(3), else SERVER(2)
+            kind=3 if tap_side == 1 else 2,
+            start_us=start_us,
+            end_us=end_us,
+            status_code=2 if status in (3, 4) else 1,
+            attributes=attrs,
+        )
+
+    @staticmethod
+    def _post(url: str, body: bytes) -> None:
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/x-protobuf"}
+        )
+        urllib.request.urlopen(req, timeout=5).read()
+
+
 class ExporterHub:
     """Fan one write-path tap into all configured exporters —
     asynchronously. The ingest hot path must never block on a sink (the

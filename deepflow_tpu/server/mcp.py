@@ -66,6 +66,12 @@ TOOLS = [
         {"start_time": _I, "end_time": _I, "org": _I},
     ),
     _tool(
+        "list_catalog",
+        "List the queryable tags and metrics of a table (name, type, "
+        "unit, allowed operators) — the db_descriptions catalog.",
+        {"table": _S}, ("table",),
+    ),
+    _tool(
         "analyze_profile",
         "Summarize continuous-profiling data for an app service: top "
         "stacks by self time from the flame tree.",
@@ -186,6 +192,8 @@ class MCPServer:
                 tr = (int(args.get("start_time") or 0),
                       int(args.get("end_time") or (1 << 31)))
             out = df.trace_map(time_range=tr, org=int(args.get("org") or 1))
+        elif name == "list_catalog":
+            out = df.query.catalogs(args["table"])
         elif name == "analyze_profile":
             from ..querier.profile import query_flame
 

@@ -160,7 +160,14 @@ def test_mcp_initialize_and_tools(df_server):
     tools = _rpc(port, "tools/list")["result"]["tools"]
     names = {t["name"] for t in tools}
     assert {"query_sql", "query_promql", "query_trace", "trace_map",
-            "analyze_profile"} <= names
+            "analyze_profile", "list_catalog"} <= names
+
+    out = _rpc(port, "tools/call",
+               {"name": "list_catalog", "arguments": {"table": "application"}})
+    cat = json.loads(out["result"]["content"][0]["text"])
+    byname = {m["name"]: m for m in cat["metrics"]}
+    assert byname["rrt_max"]["type"] == "delay"
+    assert byname["error_ratio"]["type"] == "percentage"
 
 
 def test_mcp_trace_tools_end_to_end(df_server):

@@ -1,0 +1,191 @@
+"""OTLP exporter sink — encoder round-trips and the full loop
+export → own IntegrationCollector → ingester → l7_flow_log rows again
+(reference: server/ingester/exporters/otlp_exporter/otlp_exporter.go)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from deepflow_tpu.integration.collector import IntegrationCollector
+from deepflow_tpu.integration.formats import (
+    OtelSpan,
+    OtlpMetric,
+    OtlpMetricPoint,
+    encode_otlp_metrics,
+    encode_otlp_traces,
+    parse_otlp_metrics,
+    parse_otlp_traces,
+)
+from deepflow_tpu.ingest.receiver import Receiver
+from deepflow_tpu.server.exporters import OtlpExporter
+from deepflow_tpu.server.integration import IntegrationIngester
+from deepflow_tpu.storage.store import ColumnarStore
+
+T0 = 1_700_000_000
+
+
+def _span(i=0, parent=""):
+    return OtelSpan(
+        service="checkout",
+        name=f"GET /cart/{i}",
+        trace_id=f"{i + 1:032x}",
+        span_id=f"{i + 0xAB:016x}",
+        parent_span_id=parent,
+        kind=2,
+        start_us=T0 * 1_000_000 + i,
+        end_us=T0 * 1_000_000 + 5000 + i,
+        status_code=2 if i % 2 else 1,
+        attributes={"http.method": "GET", "df.endpoint": f"/cart/{i}"},
+    )
+
+
+def test_otlp_traces_roundtrip():
+    spans = [_span(0), _span(1, parent=f"{0xAB:016x}")]
+    back = parse_otlp_traces(encode_otlp_traces(spans))
+    assert len(back) == 2
+    for a, b in zip(spans, back):
+        assert (a.service, a.name, a.trace_id, a.span_id, a.parent_span_id) == (
+            b.service, b.name, b.trace_id, b.span_id, b.parent_span_id
+        )
+        assert (a.kind, a.start_us, a.end_us, a.status_code) == (
+            b.kind, b.start_us, b.end_us, b.status_code
+        )
+        assert a.attributes == b.attributes
+
+
+def test_otlp_metrics_roundtrip():
+    ms = [
+        OtlpMetric("deepflow", "deepflow_network_byte_tx", "By", True,
+                   [OtlpMetricPoint({"pod": "p1"}, T0 * 10**9, 123.5),
+                    OtlpMetricPoint({"pod": "p2"}, T0 * 10**9, 7.0)]),
+        OtlpMetric("deepflow", "deepflow_network_rtt", "us", False,
+                   [OtlpMetricPoint({}, T0 * 10**9, 250.0)]),
+    ]
+    back = parse_otlp_metrics(encode_otlp_metrics(ms))
+    assert len(back) == 2
+    for a, b in zip(ms, back):
+        assert (a.service, a.name, a.unit, a.monotonic) == (
+            b.service, b.name, b.unit, b.monotonic
+        )
+        assert [(p.attributes, p.time_ns, p.value) for p in a.points] == [
+            (p.attributes, p.time_ns, p.value) for p in b.points
+        ]
+
+
+def _l7_cols():
+    """Minimal l7_flow_log-shaped columns as the write path taps them."""
+    n = 3
+    return {
+        "time": np.full(n, T0, np.uint32),
+        "start_time": np.full(n, T0, np.uint32),
+        "response_duration": np.array([5000, 800, 12000], np.uint32),
+        "status": np.array([1, 1, 4], np.uint32),
+        "status_code": np.array([200, 200, 500], np.uint32),
+        "tap_side": np.array([1, 2, 2], np.uint32),
+        "l7_protocol": np.full(n, 20, np.uint32),  # HTTP1
+        "server_port": np.full(n, 8080, np.uint32),
+        "app_service": np.array(["checkout", "checkout", "cart"]),
+        "endpoint": np.array(["/pay", "/pay", "/add"]),
+        "request_type": np.array(["POST", "POST", "GET"]),
+        "request_resource": np.array(["/pay", "/pay", "/add"]),
+        "trace_id": np.array([f"{7:032x}", f"{8:032x}", f"{9:032x}"]),
+        "span_id": np.array([f"{1:016x}", f"{2:016x}", f"{3:016x}"]),
+        "parent_span_id": np.array(["", "", f"{1:016x}"]),
+        "x_request_id": np.array(["", "", ""]),
+        "request_domain": np.array(["shop.local", "shop.local", ""]),
+        "response_exception": np.array(["", "", "boom"]),
+    }
+
+
+def test_exporter_rows_to_spans():
+    rows = OtlpExporter(traces_url="http://unused")._to_rows("l7_flow_log", _l7_cols())
+    spans = [OtlpExporter._row_to_span(r) for r in rows]
+    assert spans[0].kind == 3 and spans[1].kind == 2  # tap_side c/s
+    assert spans[0].status_code == 1 and spans[2].status_code == 2
+    assert spans[2].attributes["df.response_exception"] == "boom"
+    assert spans[0].end_us - spans[0].start_us == 5000
+    assert spans[2].service == "cart"
+    back = parse_otlp_traces(encode_otlp_traces(spans))
+    assert {s.trace_id for s in back} == {f"{7:032x}", f"{8:032x}", f"{9:032x}"}
+
+
+class _CaptureHandler(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        self.server.captured.append((self.path, body))
+        self.send_response(200)
+        self.end_headers()
+
+
+def test_otlp_metrics_export_post():
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _CaptureHandler)
+    srv.captured = []
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        exp = OtlpExporter(
+            metrics_url=f"http://127.0.0.1:{srv.server_port}/v1/metrics",
+            metrics=("byte_tx", "rtt"),
+            data_sources=("network",),
+        )
+        cols = {
+            "time": np.array([T0], np.uint32),
+            "byte_tx": np.array([4096.0], np.float32),
+            "rtt": np.array([150.0], np.float32),
+            "pod": np.array(["p1"]),
+        }
+        exp.export("network", cols)
+        assert exp.get_counters()["batches"] == 1, exp.get_counters()
+        ms = parse_otlp_metrics(srv.captured[0][1])
+        got = {m.name: (m.monotonic, m.points[0].value) for m in ms}
+        assert got["deepflow_network_byte_tx"] == (True, 4096.0)
+        assert got["deepflow_network_rtt"] == (False, 150.0)
+    finally:
+        srv.shutdown()
+
+
+def test_export_reingest_loop():
+    """export → own IntegrationCollector /v1/traces → OTel ingest lane →
+    l7_flow_log rows come back."""
+    recv = Receiver()
+    recv.start()
+    store = ColumnarStore()
+    ing = IntegrationIngester(recv, store, writer_args={"flush_interval_s": 0.05})
+    col = IntegrationCollector([("127.0.0.1", recv.tcp_port)])
+    try:
+        exp = OtlpExporter(traces_url=f"http://127.0.0.1:{col.port}/v1/traces")
+        exp.export("l7_flow_log", _l7_cols())
+        assert exp.get_counters() == pytest.approx(
+            {"batches": 1, "rows": 3, "errors": 0, "filtered": 0}
+        )
+        deadline = time.time() + 20
+        rows = {}
+        while time.time() < deadline:
+            try:
+                rows = store.scan(
+                    "flow_log", "l7_flow_log",
+                    columns=["app_service", "endpoint", "trace_id", "response_duration"],
+                )
+            except KeyError:  # table appears on first flushed write
+                time.sleep(0.05)
+                continue
+            if rows and len(rows.get("trace_id", ())) >= 3:
+                break
+            time.sleep(0.05)
+        ing.flush()
+        assert len(rows["trace_id"]) == 3, rows
+        assert set(rows["app_service"]) == {"checkout", "cart"}
+        assert set(rows["trace_id"]) == {f"{7:032x}", f"{8:032x}", f"{9:032x}"}
+        assert 5000 in list(rows["response_duration"])
+    finally:
+        col.stop()
+        ing.stop()
+        recv.stop()

@@ -105,6 +105,17 @@ def test_rest_query_and_prom_range(srv):
     w.stop()
 
 
+def test_rest_catalog_endpoints(srv):
+    port = srv.rest.port
+    code, cat = _get(port, "/v1/query/catalog?table=network")
+    assert code == 200 and cat["table"] == "network"
+    byname = {m["name"]: m for m in cat["metrics"]}
+    assert byname["byte_tx"]["type"] == "counter"
+    assert "Apdex" in byname["rtt_max"]["operators"]
+    code, tables = _get(port, "/v1/query/tables")
+    assert code == 200 and isinstance(tables, dict)
+
+
 def test_rest_profile_endpoints(srv):
     port = srv.rest.port
     code, stacks = _get(port, "/v1/profile/stacks")
