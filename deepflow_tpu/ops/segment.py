@@ -35,6 +35,7 @@ the whole sort).
 from __future__ import annotations
 
 import dataclasses
+import os
 
 import jax
 import jax.numpy as jnp
@@ -43,6 +44,19 @@ from jax import lax
 
 # Sentinel slot value for invalid rows: sorts after every real window.
 SENTINEL_SLOT = np.uint32(0xFFFFFFFF)
+
+
+def _use_pallas_reduce() -> bool:
+    """The Pallas suffix-scan reduce replaces the per-row scatter
+    segment ops on TPU (PERF.md §9); XLA ops stay for CPU (fast there,
+    and the conformance suite pins the two paths equal).
+    DEEPFLOW_SEGREDUCE=pallas|xla overrides."""
+    mode = os.environ.get("DEEPFLOW_SEGREDUCE", "auto")
+    if mode == "pallas":
+        return True
+    if mode == "xla":
+        return False
+    return jax.default_backend() not in ("cpu",)
 
 _U32_MAX = np.uint32(0xFFFFFFFF)
 
@@ -120,12 +134,32 @@ def groupby_reduce(
     # One row-gather moves all M meter lanes of a row at once.
     meters_rows = jnp.take(meters_t.T, perm, axis=0)  # [N, M]
 
+    # First sorted position of each kept segment: seg_id is ascending by
+    # construction, so first occurrence = binary search. A segment_min
+    # here measured ~24 ms at 2M rows (r5 bisect, stage G−F) because
+    # TPU scatter reductions cost per ROW; searchsorted is O(cap·log N).
+    first_pos = jnp.searchsorted(seg_id, jnp.arange(cap, dtype=jnp.int32))
+
     # Full-width segment ops + per-column select, NOT subset-indexed
     # ops: `meters_rows[:, sum_cols]` materializes a strided copy of
     # [N, |subset|] before each op, which costs more than running the
     # op over all M lanes and discarding the unwanted half (measured
     # ~16% off the whole fold at 588k rows — PERF.md §7b follow-up).
-    if m:
+    # On TPU both ops fuse into ONE scatter-free Pallas suffix-scan
+    # pass (segreduce_pallas.py, PERF.md §9).
+    if m and _use_pallas_reduce():
+        from .segreduce_pallas import sorted_segment_sum_max
+
+        ps, pm = sorted_segment_sum_max(meters_rows, seg_id, cap, first_pos)
+        if not max_cols.size:
+            out_meters = ps.T
+        elif not sum_cols.size:
+            out_meters = pm.T
+        else:
+            is_sum = np.zeros((m,), bool)
+            is_sum[sum_cols] = True
+            out_meters = jnp.where(jnp.asarray(is_sum)[None, :], ps, pm).T
+    elif m:
         # (segment_max yields -inf for empty segments; the seg_valid mask
         # below zeroes those columns, so no isfinite rewrite — it would
         # also mask NaNs from genuinely corrupt meters.)
@@ -153,12 +187,6 @@ def groupby_reduce(
             out_meters = jnp.where(jnp.asarray(is_sum)[None, :], ps, pm).T  # [M, cap]
     else:
         out_meters = jnp.zeros((0, cap), meters_t.dtype)
-
-    # First sorted position of each kept segment: seg_id is ascending by
-    # construction, so first occurrence = binary search. A segment_min
-    # here measured ~24 ms at 2M rows (r5 bisect, stage G−F) because
-    # TPU scatter reductions cost per ROW; searchsorted is O(cap·log N).
-    first_pos = jnp.searchsorted(seg_id, jnp.arange(cap, dtype=jnp.int32))
 
     k = jnp.arange(cap, dtype=jnp.int32)
     seg_valid = k < jnp.minimum(num_seg, cap)

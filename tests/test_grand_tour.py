@@ -29,34 +29,64 @@ def _wait(cond, timeout=30.0):
     return False
 
 
-def test_grand_tour(tmp_path):
+@pytest.mark.parametrize(
+    "prefer_native,compression,n_agents",
+    [
+        (False, 0, 1),  # the r4 baseline scenario
+        (True, 3, 2),   # native decoder + zstd framing + 2 concurrent agents
+    ],
+    ids=["python-plain-1agent", "native-zstd-2agents"],
+)
+def test_grand_tour(tmp_path, prefer_native, compression, n_agents):
+    if prefer_native:
+        from deepflow_tpu.native import native_available
+
+        if not native_available():
+            pytest.skip("native decode library not built")
     cfg, _ = load_config(
         {
             "receiver": {"tcp_port": 0, "udp_port": 0},
-            "ingester": {"n_decoders": 1, "prefer_native": False},
+            "ingester": {"n_decoders": 1, "prefer_native": prefer_native},
             "storage": {"root": str(tmp_path / "store"), "writer_flush_s": 0.05},
         }
     )
     srv = Server(cfg, lease_path=tmp_path / "lease").start()
-    agent = None
+    agents: list[Agent] = []
     try:
-        agent = Agent(
-            AgentConfig(
-                agent_id=3,
-                servers=(("127.0.0.1", srv.receiver.tcp_port),),
-                batch_size=512,
-                compression=0,
-            )
-        )
-        # replay real captures spanning HTTP, DNS, MySQL, Redis traffic
-        for rel in ("http/httpv1.pcap", "dns/dns.pcap", "mysql/mysql.pcap",
-                    "redis/redis.pcap"):
-            agent.run_pcap(os.path.join(REF, rel))
+        for k in range(n_agents):
+            agents.append(Agent(
+                AgentConfig(
+                    agent_id=3 + k,
+                    servers=(("127.0.0.1", srv.receiver.tcp_port),),
+                    batch_size=512,
+                    compression=compression,
+                )
+            ))
+        agent = agents[0]
+        # replay real captures spanning HTTP, DNS, MySQL, Redis traffic;
+        # concurrent agents split the corpus, all shipping to one server
+        import threading
 
-        # l7 session count the agent actually shipped — wait until the
+        pcaps = ("http/httpv1.pcap", "dns/dns.pcap", "mysql/mysql.pcap",
+                 "redis/redis.pcap")
+
+        def replay(a, rels):
+            for rel in rels:
+                a.run_pcap(os.path.join(REF, rel))
+
+        threads = [
+            threading.Thread(target=replay, args=(a, pcaps[i::n_agents]))
+            for i, a in enumerate(agents)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+
+        # l7 session count the agents actually shipped — wait until the
         # server has WRITTEN that many rows (sender flush + TCP + decode
         # are all async; querying earlier races the pipeline)
-        l7_sent = agent.counters["logs_sent"]
+        l7_sent = sum(a.counters["logs_sent"] for a in agents)
         assert l7_sent > 0
         assert _wait(lambda: srv.flow_metrics.counters["docs_written"] > 0)
         srv.doc_writer.flush()
@@ -107,7 +137,14 @@ def test_grand_tour(tmp_path):
         # 5. self-telemetry flowed
         did = srv.tick()
         assert "leader" in did
+
+        # 6. multi-agent runs: rows arrived from every agent id
+        if n_agents > 1:
+            r = srv.query.execute(
+                "SELECT agent_id, Count() AS c FROM l7_flow_log "
+                "GROUP BY agent_id ORDER BY agent_id")
+            assert len(r.values["agent_id"]) == n_agents, r.to_dicts()
     finally:
-        if agent is not None:
-            agent.close()
+        for a in agents:
+            a.close()
         srv.stop()
