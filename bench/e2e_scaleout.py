@@ -66,8 +66,11 @@ def _prepare(docs_target: int, frame_docs: int, agents: int) -> list[tuple[int, 
     return frames
 
 
-def _worker(port_q, result_q, n_docs_expected: int, n_decoders: int):
-    """One shared-nothing ingester process."""
+def _worker(port_q, result_q, warm_docs: int, n_docs_expected: int,
+            n_decoders: int):
+    """One shared-nothing ingester process. The parent first sends a
+    warm shard (JAX import + enrich-kernel compile happen there); the
+    timed region covers only the steady frames after `ready`."""
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     import threading
@@ -94,13 +97,22 @@ def _worker(port_q, result_q, n_docs_expected: int, n_decoders: int):
         queue_capacity=1 << 15, prefer_native=True,
     )
     port_q.put(recv.tcp_port)
-    # parent signals start via the same queue; then we wait for docs
-    t0 = time.perf_counter()
     deadline = time.time() + 600
-    while writer.docs < n_docs_expected and time.time() < deadline:
+    while writer.docs < warm_docs and time.time() < deadline:
         time.sleep(0.01)
+    warm_seen = writer.docs  # may exceed warm_docs if the frame was resent
+    result_q.put({"ready": True})
+    # steady clock starts at the FIRST steady doc, not at `ready` —
+    # the parent still has to drain every worker's handshake before it
+    # feeds, and that idle gap must not deflate the rate
+    while writer.docs <= warm_seen and time.time() < deadline:
+        time.sleep(0.002)
+    t0 = time.perf_counter()
+    base = warm_seen
+    while writer.docs < warm_seen + n_docs_expected and time.time() < deadline:
+        time.sleep(0.005)
     dt = time.perf_counter() - t0
-    result_q.put({"docs": writer.docs, "seconds": round(dt, 3)})
+    result_q.put({"docs": writer.docs - base, "seconds": round(dt, 3)})
     ing.stop()
     recv.stop()
 
@@ -108,24 +120,57 @@ def _worker(port_q, result_q, n_docs_expected: int, n_decoders: int):
 def run(n_procs: int, frames, total_docs: int) -> dict:
     ctx = mp.get_context("spawn")
     port_q = ctx.Queue()
-    result_q = ctx.Queue()
+    result_qs = [ctx.Queue() for _ in range(n_procs)]
     # shard frames by agent — the receiver-level hash fanout, applied
     # across processes (flow_metrics.go:55-61 at deployment scale)
     shards: list[list[bytes]] = [[] for _ in range(n_procs)]
     shard_docs = [0] * n_procs
+    warm: list[tuple[bytes, int] | None] = [None] * n_procs
     for agent, frame, ndocs in frames:
-        shards[agent % n_procs].append(frame)
-        shard_docs[agent % n_procs] += ndocs
+        i = agent % n_procs
+        if warm[i] is None:
+            warm[i] = (frame, ndocs)
+        else:
+            shards[i].append(frame)
+            shard_docs[i] += ndocs
 
     procs = []
     for i in range(n_procs):
-        p = ctx.Process(target=_worker, args=(port_q, result_q, shard_docs[i], 2))
+        p = ctx.Process(
+            target=_worker,
+            args=(port_q, result_qs[i],
+                  warm[i][1] if warm[i] is not None else 0, shard_docs[i], 2),
+        )
         p.start()
         procs.append(p)
-    ports = [port_q.get(timeout=120) for _ in procs]
+    ports = [port_q.get(timeout=300) for _ in procs]
+
+    socks = [socket.create_connection(("127.0.0.1", port)) for port in ports]
+    # warm phase: compiles + imports happen outside the timed region.
+    # The warm frame is resent on timeout — worker startup on an
+    # oversubscribed host can race the first delivery. A proc whose
+    # shard is empty (more procs than agent ids) gets no warm frame and
+    # reports 0 docs immediately.
+    for s, w in zip(socks, warm):
+        if w is not None:
+            s.sendall(w[0])
+    # NOTE: a ready timeout means the worker is still starting (TCP
+    # already delivered the frame) — the resend is a last-resort nudge
+    # whose duplicate docs are absorbed by the worker's warm_seen
+    # baseline, not counted into the steady region
+    for q, s, w in zip(result_qs, socks, warm):
+        if w is None:
+            continue
+        for attempt in range(6):
+            try:
+                assert q.get(timeout=120).get("ready")
+                break
+            except Exception:
+                if attempt == 5:
+                    raise
+                s.sendall(w[0])
 
     t0 = time.perf_counter()
-    socks = [socket.create_connection(("127.0.0.1", port)) for port in ports]
     import threading
 
     def feed(sock, shard):
@@ -135,7 +180,7 @@ def run(n_procs: int, frames, total_docs: int) -> dict:
                for s, sh in zip(socks, shards)]
     for f in feeders:
         f.start()
-    results = [result_q.get(timeout=600) for _ in procs]
+    results = [q.get(timeout=600) for q in result_qs]
     dt = time.perf_counter() - t0
     for f in feeders:
         f.join()

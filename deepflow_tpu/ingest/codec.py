@@ -84,6 +84,29 @@ def _put_tag_bytes(buf: bytearray, field: int, v: bytes):
         buf += v
 
 
+# unconditional writers (the _put_tag_* family skips falsy values —
+# encoders that must emit zero/empty fields use these). THE shared
+# protobuf writer helpers: formats.py and trident_grpc.py import them.
+def pb_varint(out: bytearray, field: int, v: int) -> None:
+    _put_varint(out, field << 3 | 0)
+    _put_varint(out, int(v) & ((1 << 64) - 1))
+
+
+def pb_bytes(out: bytearray, field: int, b: bytes) -> None:
+    _put_varint(out, field << 3 | _LEN)
+    _put_varint(out, len(b))
+    out += b
+
+
+def pb_str(out: bytearray, field: int, s: str) -> None:
+    pb_bytes(out, field, s.encode())
+
+
+def pb_fixed64(out: bytearray, field: int, v: int) -> None:
+    _put_varint(out, field << 3 | 1)
+    out += (int(v) & ((1 << 64) - 1)).to_bytes(8, "little")
+
+
 def _iter_fields(buf: bytes):
     off = 0
     n = len(buf)

@@ -19,7 +19,15 @@ from __future__ import annotations
 
 import dataclasses
 
-from ..ingest.codec import _get_varint, _iter_fields, _put_varint
+from ..ingest.codec import (
+    _get_varint,
+    _iter_fields,
+    _put_varint,
+    pb_bytes as _pb_bytes,
+    pb_fixed64 as _pb_fixed64,
+    pb_str as _pb_str,
+    pb_varint as _pb_varint,
+)
 
 # ---------------------------------------------------------------------------
 # InfluxDB line protocol
@@ -380,31 +388,6 @@ def parse_folded(text: str) -> tuple[list[ProfileSample], int]:
 # (exporters/otlp_exporter/otlp_exporter.go builds the same messages via
 # the generated SDK; here the encoder is the byte-level inverse of
 # parse_otlp_traces / parse_otlp_metrics so round-trips are testable).
-
-
-def _pb_str(out: bytearray, field: int, s: str) -> None:
-    b = s.encode()
-    _put_varint(out, field << 3 | 2)
-    _put_varint(out, len(b))
-    out += b
-
-
-def _pb_bytes(out: bytearray, field: int, b: bytes) -> None:
-    _put_varint(out, field << 3 | 2)
-    _put_varint(out, len(b))
-    out += b
-
-
-def _pb_varint(out: bytearray, field: int, v: int) -> None:
-    _put_varint(out, field << 3 | 0)
-    _put_varint(out, int(v) & ((1 << 64) - 1))
-
-
-def _pb_fixed64(out: bytearray, field: int, v: int) -> None:
-    # OTLP declares *_time_unix_nano as fixed64 — emitting varint here
-    # would make spec-conformant decoders drop every timestamp
-    _put_varint(out, field << 3 | 1)
-    out += (int(v) & ((1 << 64) - 1)).to_bytes(8, "little")
 
 
 def _kv_str(key: str, value: str) -> bytes:

@@ -103,8 +103,13 @@ def sorted_segment_sum_max(
     `seg_id` [N] (dead rows carry an id ≥ num_segments and must sort
     last). `first_pos` [num_segments] are the first occurrence indices
     (searchsorted upstream). Returns (sums, maxs), both
-    [num_segments, M] — max lanes are _NEG for empty segments, matching
-    jax.ops.segment_max's -inf stance (callers mask by seg_valid)."""
+    [num_segments, M].
+
+    CONTRACT: rows of ABSENT segments are garbage — searchsorted points
+    an absent id at the next live segment's head, so its totals bleed
+    in (NOT the 0 / -inf identities the XLA segment ops emit). Callers
+    MUST mask by their live-segment prefix (groupby_reduce's seg_valid
+    does); never detect emptiness from these values."""
     n, m = rows.shape
     cap = int(num_segments)
     blk = int(min(block, max(8, 1 << (n - 1).bit_length())))
