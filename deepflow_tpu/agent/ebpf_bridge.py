@@ -120,3 +120,42 @@ class EbpfDispatcher:
             app_batch.tags["signal_source"][:] = sig
         self.counters["sessions_out"] += log_batch.size
         return log_batch, app_batch
+
+
+@dataclasses.dataclass
+class PerfStackSample:
+    """One perf/on-CPU stack capture (perf_profiler.c ring output):
+    raw user-space return addresses, leaf first."""
+
+    pid: int
+    stack: list  # of int addresses
+    weight: int = 1  # sample count (or off-CPU µs, etc.)
+
+
+class ContinuousProfiler:
+    """The perf_profiler.c userspace loop: raw stack samples →
+    symbolized folded aggregation per window → PROFILE frames through
+    the given sender (the same wire shape the /api/v1/profile HTTP
+    intake ships, so the server's flame plane needs nothing new)."""
+
+    def __init__(self, sender=None, *, app_service: str = "",
+                 event_type: str = "cpu", interval_s: float = 10.0):
+        from .symbolizer import ProfileAggregator
+
+        self.agg = ProfileAggregator(
+            app_service=app_service, event_type=event_type
+        )
+        self.sender = sender
+        self.interval_s = interval_s
+        self.counters = {"frames_sent": 0}
+
+    def observe(self, samples: list[PerfStackSample]) -> None:
+        for s in samples:
+            self.agg.observe(s.pid, s.stack, s.weight)
+
+    def flush(self, timestamp: int) -> bytes | None:
+        frame = self.agg.flush(timestamp)
+        if frame is not None and self.sender is not None:
+            self.sender.send(frame)
+            self.counters["frames_sent"] += 1
+        return frame

@@ -89,6 +89,11 @@ FLOW_STATE = LogSchema(
             _i("ack_time_d1", LogOp.MIN),
             _i("syn_dir", LogOp.OR),  # bit0: ep0 sent SYN, bit1: ep1
             _i("emitted", LogOp.OR),  # set by tick() after first emission
+            # dispatcher orientation (dispatcher.py): which endpoints
+            # terminate locally (L2End), and the tap the flow rode
+            _i("l2_end_ep0", LogOp.OR),
+            _i("l2_end_ep1", LogOp.OR),
+            _i("tap_type", LogOp.MAX),  # one tap per flow; MAX merges idempotently
             # delta counters (zeroed by tick() after each emission)
             _n("packet_d0"),
             _n("packet_d1"),
@@ -140,7 +145,7 @@ class FlowTimeouts:
 
 
 def packets_to_flow_rows(
-    p: PacketBatch, seq_tracker: dict | None = None
+    p: PacketBatch, seq_tracker: dict | None = None, orient=None
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """PacketBatch → (ints [N, Ki], nums [N, Kn], valid) FLOW_STATE rows.
 
@@ -195,6 +200,20 @@ def packets_to_flow_rows(
     ints[:, _II("ack_time_d0")] = np.where(pure_ack & ~d1, ts_us32, _ABSENT)
     ints[:, _II("ack_time_d1")] = np.where(pure_ack & d1, ts_us32, _ABSENT)
     ints[:, _II("syn_dir")] = np.where(is_syn, np.where(d1, 2, 1), 0)
+
+    if orient is not None:
+        tap, end_src, end_dst = orient
+        ints[:, _II("tap_type")] = tap
+        # src/dst are packet-relative; fold onto the canonical ep0/ep1
+        ints[:, _II("l2_end_ep0")] = np.where(d1, end_dst, end_src)
+        ints[:, _II("l2_end_ep1")] = np.where(d1, end_src, end_dst)
+    else:
+        # no dispatcher: the historical local single-host stance —
+        # everything terminates here (tap_side resolves to the client
+        # view, matching the pre-mode behavior)
+        ints[:, _II("tap_type")] = 3  # TAP_CLOUD
+        ints[:, _II("l2_end_ep0")] = 1
+        ints[:, _II("l2_end_ep1")] = 1
 
     one = np.ones(n, np.float32)
     nums[:, _NI("packet_d0")] = np.where(~d1, one, 0)
@@ -446,11 +465,13 @@ class FlowMap:
         batch_size: int = 1 << 12,
         timeouts: FlowTimeouts = FlowTimeouts(),
         agent_id: int = 1,
+        dispatcher=None,
     ):
         self.capacity = capacity
         self.batch_size = batch_size
         self.timeouts = timeouts
         self.agent_id = agent_id
+        self.dispatcher = dispatcher
         self.state = log_stash_init(capacity, FLOW_STATE)
         # host-side per-(flow, dir) seq high-water marks for cross-batch
         # retrans detection; bounded, oldest-quarter evicted on overflow
@@ -465,8 +486,10 @@ class FlowMap:
         c["occupancy"] = int(np.asarray(self.state.valid).sum())
         return c
 
-    def inject(self, p: PacketBatch) -> None:
-        ints, nums, valid = packets_to_flow_rows(p, self.seq_tracker)
+    def inject(self, p: PacketBatch, orient=None) -> None:
+        if orient is None and self.dispatcher is not None:
+            orient = self.dispatcher.orient(p)
+        ints, nums, valid = packets_to_flow_rows(p, self.seq_tracker, orient)
         if len(self.seq_tracker) > self.seq_tracker_cap:
             import itertools
 
@@ -561,8 +584,18 @@ def _emission_to_l4_rows(raw: dict, n: int, now: int, agent_id: int) -> FlowLogB
     ints_out[:, ii("client_port")] = np.where(cli1, p1, p0)
     ints_out[:, ii("server_port")] = np.where(cli1, p0, p1)
     ints_out[:, ii("protocol")] = fi[:, _II("protocol")]
-    ints_out[:, ii("tap_type")] = 3
-    ints_out[:, ii("tap_side")] = 1
+    # dispatcher orientation → tap_type + tap_side (TapSide::from(L2End),
+    # document.rs): client-local → c(1), server-local → s(2), both → 1
+    # (the reference reports the client view), neither → rest(0)
+    tap = fi[:, _II("tap_type")]
+    ints_out[:, ii("tap_type")] = np.where(tap > 0, tap, 3)
+    e0 = fi[:, _II("l2_end_ep0")].astype(bool)
+    e1 = fi[:, _II("l2_end_ep1")].astype(bool)
+    cli_end = np.where(cli1, e1, e0)
+    srv_end = np.where(cli1, e0, e1)
+    ints_out[:, ii("tap_side")] = np.where(
+        cli_end, 1, np.where(srv_end, 2, 0)
+    )
     ints_out[:, ii("signal_source")] = 0
     ints_out[:, ii("start_time")] = fi[:, _II("start_time")]
     ints_out[:, ii("end_time")] = now

@@ -35,6 +35,7 @@ from ..flowlog.codec import encode_rows
 from ..ingest.codec import encode_docbatch
 from ..ingest.framing import MessageType
 from ..ingest.sender import UniformSender
+from .dispatcher import Dispatcher, DispatcherConfig
 from ..utils.stats import StatsCollector
 from .bridge import emissions_to_flow_batch
 from .flow_map import FlowMap, FlowTimeouts
@@ -69,6 +70,9 @@ class AgentConfig:
     # when on, is_active_host comes from observed traffic instead of
     # the all-active default, enabling inactive-IP aggregation
     track_host_activity: bool = False
+    # dispatcher flavor (dispatcher/mod.rs DispatcherFlavor): local /
+    # mirror / analyzer orientation — see agent/dispatcher.py
+    dispatcher: DispatcherConfig | None = None
 
 
 def _compact(buf: np.ndarray, p, retain: np.ndarray):
@@ -83,8 +87,12 @@ class Agent:
     def __init__(self, config: AgentConfig = AgentConfig(), *, senders=None):
         c = config
         self.config = c
+        self.dispatcher = (
+            Dispatcher(c.dispatcher) if c.dispatcher is not None else None
+        )
         self.flow_map = FlowMap(
-            capacity=c.flow_capacity, batch_size=c.batch_size, agent_id=c.agent_id
+            capacity=c.flow_capacity, batch_size=c.batch_size,
+            agent_id=c.agent_id, dispatcher=self.dispatcher,
         )
         self.l7 = L7Engine(agent_id=c.agent_id)
         fanout = FanoutConfig(agent_id=c.agent_id)

@@ -61,6 +61,19 @@ class PacketBatch:
     packet_len: np.ndarray  # [N] u32 (on-wire length incl. L2)
     tunnel_type: np.ndarray  # [N] u32 (0 none, 1 vxlan, 2 ipip, 3 gre, 4 erspan)
     valid: np.ndarray  # [N] bool
+    # outer-frame L2 identity for the dispatcher modes: low 32 bits of
+    # the MACs (the reference's vm-mac set keys on to_lower_32b,
+    # mirror_mode_dispatcher.rs:103) + the outer VLAN id (analyzer-mode
+    # tap_type mapping). Zeros when absent.
+    mac_src_lo: np.ndarray | None = None  # [N] u32
+    mac_dst_lo: np.ndarray | None = None  # [N] u32
+    vlan_id: np.ndarray | None = None  # [N] u32
+
+    def __post_init__(self):
+        n = self.valid.shape[0]
+        for f in ("mac_src_lo", "mac_dst_lo", "vlan_id"):
+            if getattr(self, f) is None:
+                setattr(self, f, np.zeros(n, np.uint32))
 
     @property
     def size(self) -> int:
@@ -267,6 +280,15 @@ def parse_packets(
             }
         )
 
+    # outer-frame L2 identity (offset 0: dst mac, 6: src mac; the VLAN
+    # id sits after ethertype 0x8100/0x88a8 when tagged)
+    outer_et = _u16(buf, np.full(n, 12, np.int64))
+    vlan_id = np.where(
+        (outer_et == ETH_VLAN) | (outer_et == ETH_QINQ),
+        _u16(buf, np.full(n, 14, np.int64)) & 0x0FFF,
+        0,
+    ).astype(np.uint32)
+
     return PacketBatch(
         timestamp_s=np.asarray(ts_s, np.uint32),
         timestamp_us=np.asarray(
@@ -286,6 +308,9 @@ def parse_packets(
         packet_len=lengths,
         tunnel_type=tunnel,
         valid=h.ok,
+        mac_dst_lo=_u32(buf, np.full(n, 2, np.int64)),
+        mac_src_lo=_u32(buf, np.full(n, 8, np.int64)),
+        vlan_id=vlan_id,
     )
 
 
@@ -304,8 +329,10 @@ def craft_tcp(
     ack: int = 0,
     payload: bytes = b"",
     vlan: int | None = None,
+    mac_src: int = 0x020000000002,
+    mac_dst: int = 0x020000000001,
 ) -> bytes:
-    eth = b"\x02\x00\x00\x00\x00\x01" + b"\x02\x00\x00\x00\x00\x02"
+    eth = mac_dst.to_bytes(6, "big") + mac_src.to_bytes(6, "big")
     if vlan is not None:
         eth += (0x8100).to_bytes(2, "big") + vlan.to_bytes(2, "big")
     eth += (0x0800).to_bytes(2, "big")

@@ -1,0 +1,91 @@
+"""Dispatcher flavors — local/mirror/analyzer orientation folded into
+FlowMap emission (reference: dispatcher/mod.rs DispatcherFlavor,
+mirror_mode_dispatcher.rs VM-MAC set, analyzer VLAN→tap_type)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from deepflow_tpu.agent.dispatcher import Dispatcher, DispatcherConfig
+from deepflow_tpu.agent.flow_map import FlowMap
+from deepflow_tpu.agent.packet import TCP_ACK, TCP_PSH, craft_tcp, parse_packets, to_batch
+
+CLI = 0x0A000001
+SRV = 0x0A000002
+T0 = 1_700_000_000
+VM_MAC = 0x02AA00000033  # low 32 bits = 0x00000033
+PEER_MAC = 0x02BB00000044
+
+
+def _flow(mac_src, mac_dst, vlan=None, sport=40000):
+    pkts = [
+        craft_tcp(CLI, SRV, sport, 443, flags=TCP_ACK | TCP_PSH,
+                  seq=100, payload=b"x" * 10, mac_src=mac_src,
+                  mac_dst=mac_dst, vlan=vlan),
+        craft_tcp(SRV, CLI, 443, sport, flags=TCP_ACK | TCP_PSH,
+                  seq=500, payload=b"y" * 5, mac_src=mac_dst,
+                  mac_dst=mac_src, vlan=vlan),
+    ]
+    return parse_packets(*to_batch(pkts, [T0, T0]))
+
+
+def test_packet_batch_carries_l2_identity():
+    p = _flow(VM_MAC, PEER_MAC, vlan=7)
+    assert p.mac_src_lo[0] == VM_MAC & 0xFFFFFFFF
+    assert p.mac_dst_lo[0] == PEER_MAC & 0xFFFFFFFF
+    assert list(p.vlan_id) == [7, 7]
+
+
+def test_mirror_mode_orients_by_vm_mac_set():
+    d = Dispatcher(DispatcherConfig(
+        mode="mirror", macs=(VM_MAC & 0xFFFFFFFF,)
+    ))
+    fm = FlowMap(capacity=1 << 8, batch_size=64, dispatcher=d)
+    fm.inject(_flow(VM_MAC, PEER_MAC))
+    r = fm.tick(T0 + 1).to_rows()[0]
+    # the VM (client side) is local → tap_side c
+    assert r["tap_side"] == 1
+    assert r["tap_type"] == 3
+    assert d.counters["oriented"] == 2  # both directions touch the VM
+
+
+def test_mirror_mode_server_side_vm():
+    d = Dispatcher(DispatcherConfig(mode="mirror", macs=(PEER_MAC & 0xFFFFFFFF,)))
+    fm = FlowMap(capacity=1 << 8, batch_size=64, dispatcher=d)
+    fm.inject(_flow(VM_MAC, PEER_MAC))  # server's MAC is the VM now
+    r = fm.tick(T0 + 1).to_rows()[0]
+    assert r["tap_side"] == 2  # server-local → s
+
+
+def test_analyzer_mode_maps_vlan_to_tap_type():
+    d = Dispatcher(DispatcherConfig(
+        mode="analyzer", vlan_tap_map={7: 5, 9: 6}, default_tap_type=1
+    ))
+    fm = FlowMap(capacity=1 << 8, batch_size=64, dispatcher=d)
+    fm.inject(_flow(VM_MAC, PEER_MAC, vlan=7))
+    fm.inject(_flow(VM_MAC, PEER_MAC, vlan=12, sport=40001))  # unmapped
+    rows = {r["client_port"]: r for r in fm.tick(T0 + 1).to_rows()}
+    assert rows[40000]["tap_type"] == 5  # mapped VLAN
+    assert rows[40001]["tap_type"] == 1  # default for unmapped
+    # span traffic terminates nowhere locally → rest side
+    assert rows[40000]["tap_side"] == 0
+
+
+def test_local_mode_without_macs_keeps_client_view():
+    fm = FlowMap(capacity=1 << 8, batch_size=64,
+                 dispatcher=Dispatcher(DispatcherConfig(mode="local")))
+    fm.inject(_flow(VM_MAC, PEER_MAC))
+    r = fm.tick(T0 + 1).to_rows()[0]
+    assert r["tap_side"] == 1 and r["tap_type"] == 3
+
+
+def test_agent_config_wires_dispatcher():
+    from deepflow_tpu.agent.main import Agent, AgentConfig
+
+    a = Agent(AgentConfig(
+        dispatcher=DispatcherConfig(mode="mirror", macs=(0x33,)),
+        servers=(),
+    ), senders={})
+    assert a.flow_map.dispatcher is a.dispatcher
+    assert a.dispatcher.config.mode == "mirror"
+    a.close()

@@ -1,0 +1,162 @@
+"""eBPF-userspace symbolization: real ELF symtab parsing (pinned
+against `nm`), live /proc/self resolution of a libc function address,
+JVM perf-map frames, and the continuous-profiler fold→PROFILE-frame
+loop feeding the existing flame-query plane."""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import os
+import subprocess
+
+import pytest
+
+from deepflow_tpu.agent.symbolizer import (
+    ElfSymbols,
+    JavaPerfMap,
+    ProcMaps,
+    ProfileAggregator,
+    Symbolizer,
+)
+
+C_SRC = r"""
+int helper_alpha(int x) { return x + 1; }
+int helper_beta(int x) { return helper_alpha(x) * 2; }
+int main(void) { return helper_beta(20); }
+"""
+
+
+@pytest.fixture(scope="module")
+def tiny_elf(tmp_path_factory):
+    d = tmp_path_factory.mktemp("elf")
+    src = d / "t.c"
+    src.write_text(C_SRC)
+    out = d / "t.bin"
+    r = subprocess.run(["gcc", "-O0", "-o", str(out), str(src)],
+                       capture_output=True)
+    if r.returncode != 0:
+        pytest.skip(f"gcc unavailable: {r.stderr.decode()[:100]}")
+    return str(out)
+
+
+def test_elf_symbols_match_nm(tiny_elf):
+    syms = ElfSymbols.load(tiny_elf)
+    names = {n for _, _, n in syms.syms}
+    assert {"helper_alpha", "helper_beta", "main"} <= names
+
+    nm = subprocess.run(["nm", "--defined-only", tiny_elf],
+                        capture_output=True, text=True)
+    if nm.returncode == 0:
+        want = {}
+        for line in nm.stdout.splitlines():
+            parts = line.split()
+            if len(parts) == 3 and parts[1] in ("T", "t"):
+                want[parts[2]] = int(parts[0], 16)
+        for fn in ("helper_alpha", "helper_beta", "main"):
+            assert syms.resolve(want[fn]) == fn
+            assert syms.resolve(want[fn] + 2) == fn  # inside the body
+
+
+def test_proc_self_maps_and_libc_resolution():
+    maps = ProcMaps.read("self")
+    assert maps.ranges, "no executable ranges for self"
+    libc = ctypes.CDLL(ctypes.util.find_library("c") or "libc.so.6")
+    addr = ctypes.cast(libc.printf, ctypes.c_void_p).value
+    assert maps.find(addr) is not None
+
+    sym = Symbolizer("self")
+    name = sym.resolve(addr)
+    # glibc aliases printf; accept any *printf* symbol in a libc module
+    assert "printf" in name, name
+    assert sym.counters["resolved"] >= 1
+
+
+def test_symbolizer_fallbacks():
+    sym = Symbolizer("self")
+    assert sym.resolve(0x10) == "[0x10]"  # unmapped
+    r = sym.maps.ranges[0]
+    out = sym.resolve(r.start + max(0, r.end - r.start - 1))
+    assert out  # mapped but maybe nameless → bracket fallback allowed
+
+
+def test_java_perf_map(tmp_path):
+    pid = 4242
+    (tmp_path / f"perf-{pid}.map").write_text(
+        "7f0000001000 40 Lcom/shop/Cart;::add\n"
+        "7f0000002000 10 Interpreter\n"
+        "garbage line\n"
+    )
+    m = JavaPerfMap.read(pid, str(tmp_path))
+    assert m.resolve(0x7F0000001010) == "Lcom/shop/Cart;::add"
+    assert m.resolve(0x7F0000001FFF) is None  # past the entry size
+    assert m.resolve(0x7F0000002005) == "Interpreter"
+
+
+def test_profile_aggregator_to_flame_plane(tiny_elf):
+    syms = ElfSymbols.load(tiny_elf)
+    by_name = {n: a for a, _, n in syms.syms}
+    agg = ProfileAggregator(app_service="svc-x", event_type="cpu")
+    # stand in a real symbolizer for the fake pid: module-relative ELF
+    sym = Symbolizer("self")
+    sym.maps = ProcMaps.read("self")
+    # feed pre-symbolized + raw-addr stacks into one window
+    agg.observe_folded("main;helper_beta;helper_alpha", 90)
+    agg.observe_folded("main;helper_beta", 10)
+    frame = agg.flush(1_700_000_000)
+    assert frame is not None
+    head, _, body = frame.decode().partition("\n")
+    assert head.split("\x00") == ["svc-x", "cpu", "1700000000"]
+
+    # the frame is exactly what the profile ingest lane accepts
+    from deepflow_tpu.integration.formats import parse_folded
+
+    samples, errors = parse_folded(body)
+    assert errors == 0
+    assert {s.stack: s.value for s in samples} == {
+        "main;helper_beta;helper_alpha": 90,
+        "main;helper_beta": 10,
+    }
+    assert agg.flush(0) is None  # window cleared
+
+
+def test_aggregator_raw_addresses_via_self(tiny_elf):
+    """Raw addr stacks through a REAL process symbolizer: use our own
+    pid + libc addresses so resolution exercises maps+ELF end-to-end."""
+    libc = ctypes.CDLL(ctypes.util.find_library("c") or "libc.so.6")
+    printf_addr = ctypes.cast(libc.printf, ctypes.c_void_p).value
+    malloc_addr = ctypes.cast(libc.malloc, ctypes.c_void_p).value
+    agg = ProfileAggregator(app_service="self-prof")
+    agg.observe(os.getpid(), [printf_addr, malloc_addr], weight=3)
+    frame = agg.flush(1)
+    assert frame is not None
+    body = frame.decode().split("\n", 1)[1]
+    assert "printf" in body and "malloc" in body and body.endswith(" 3")
+
+
+def test_continuous_profiler_ships_profile_frames():
+    """perf-stack samples → ContinuousProfiler → PROFILE frame → the
+    server-side profile ingest shape (flame-plane compatible)."""
+    import ctypes
+    import ctypes.util
+
+    from deepflow_tpu.agent.ebpf_bridge import ContinuousProfiler, PerfStackSample
+
+    sent = []
+
+    class Sender:
+        def send(self, b):
+            sent.append(b)
+
+    libc = ctypes.CDLL(ctypes.util.find_library("c") or "libc.so.6")
+    printf_addr = ctypes.cast(libc.printf, ctypes.c_void_p).value
+    prof = ContinuousProfiler(Sender(), app_service="svc-prof")
+    prof.observe([
+        PerfStackSample(os.getpid(), [printf_addr], weight=5),
+        PerfStackSample(os.getpid(), [printf_addr], weight=2),
+    ])
+    frame = prof.flush(1_700_000_000)
+    assert frame is not None and sent == [frame]
+    head, _, body = frame.decode().partition("\n")
+    assert head.startswith("svc-prof\x00cpu\x00")
+    assert "printf" in body and body.endswith(" 7")  # merged weights
