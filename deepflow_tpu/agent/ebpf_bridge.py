@@ -147,11 +147,20 @@ class ContinuousProfiler:
         )
         self.sender = sender
         self.interval_s = interval_s
+        self._last_flush = 0.0
         self.counters = {"frames_sent": 0}
 
     def observe(self, samples: list[PerfStackSample]) -> None:
         for s in samples:
             self.agg.observe(s.pid, s.stack, s.weight)
+
+    def maybe_flush(self, now: float, timestamp: int | None = None) -> bytes | None:
+        """Interval-driven flush for poll loops: emits only when
+        `interval_s` elapsed since the last frame."""
+        if now - self._last_flush < self.interval_s:
+            return None
+        self._last_flush = now
+        return self.flush(int(timestamp if timestamp is not None else now))
 
     def flush(self, timestamp: int) -> bytes | None:
         frame = self.agg.flush(timestamp)

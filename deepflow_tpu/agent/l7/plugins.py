@@ -18,6 +18,7 @@ L7 engine, flow logs, and RED metrics with zero further wiring.
 
 from __future__ import annotations
 
+import ctypes
 import importlib.util
 import sys
 from pathlib import Path
@@ -32,8 +33,10 @@ CUSTOM_PROTOCOL_BASE = 200
 def load_plugins(plugin_dir: str | Path) -> list[tuple[int, str]]:
     """Import and register every plugin; returns [(protocol_id, name)].
 
-    A broken plugin is skipped (one bad operator module must not take
-    down the agent), mirroring the reference's plugin-load error stance.
+    Python modules (*.py) and native shared objects (*.so, the C ABI
+    below) register through the same seat. A broken plugin is skipped
+    (one bad operator module must not take down the agent), mirroring
+    the reference's plugin-load error stance.
     """
     loaded = []
     d = Path(plugin_dir)
@@ -54,4 +57,86 @@ def load_plugins(plugin_dir: str | Path) -> list[tuple[int, str]]:
             continue
         register_parser(proto, check, parse)
         loaded.append((proto, path.stem))
+    for path in sorted(d.glob("*.so")):
+        try:
+            proto, check, parse = _load_so_plugin(path)
+        except Exception:
+            continue
+        register_parser(proto, check, parse)
+        loaded.append((proto, path.stem))
     return loaded
+
+
+# ---------------------------------------------------------------------------
+# native shared-object plugin ABI (the reference's plugin/shared_obj
+# seat, agent/src/plugin/shared_obj/: operators compile a C parser once
+# and every agent loads it). Contract — three exported symbols:
+#
+#   int df_protocol(void);
+#       // protocol id (>= 200 for custom protocols)
+#   int df_check(const unsigned char *payload, int len, int port);
+#       // 1 when the payload is this protocol
+#   int df_parse(const unsigned char *payload, int len,
+#                struct df_l7_info *out);
+#       // 1 on success, filling `out`:
+#   struct df_l7_info {
+#       int  msg_type;         // 0 request / 1 response / 2 session
+#       int  status;           // 1 ok / 3 client err / 4 server err
+#       int  status_code;
+#       unsigned int request_id;
+#       char request_type[64];     // NUL-terminated
+#       char request_resource[256];
+#       char request_domain[256];
+#       char endpoint[256];
+#   };
+
+
+class _DfL7Info(ctypes.Structure):
+    _fields_ = [
+        ("msg_type", ctypes.c_int),
+        ("status", ctypes.c_int),
+        ("status_code", ctypes.c_int),
+        ("request_id", ctypes.c_uint),
+        ("request_type", ctypes.c_char * 64),
+        ("request_resource", ctypes.c_char * 256),
+        ("request_domain", ctypes.c_char * 256),
+        ("endpoint", ctypes.c_char * 256),
+    ]
+
+
+def _load_so_plugin(path: Path):
+    from .parsers import MSG_REQUEST, MSG_RESPONSE, L7Message
+
+    lib = ctypes.CDLL(str(path))
+    lib.df_protocol.restype = ctypes.c_int
+    lib.df_check.restype = ctypes.c_int
+    lib.df_check.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+    lib.df_parse.restype = ctypes.c_int
+    lib.df_parse.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(_DfL7Info)
+    ]
+    proto = int(lib.df_protocol())
+
+    def check(payload: bytes, port: int = 0) -> bool:
+        return bool(lib.df_check(payload, len(payload), int(port)))
+
+    def parse(payload: bytes):
+        info = _DfL7Info()
+        if not lib.df_parse(payload, len(payload), ctypes.byref(info)):
+            return None
+        # 2 (session) pairs like a request that already carries its
+        # response status — the engine's FIFO pairing closes it
+        mt = MSG_RESPONSE if int(info.msg_type) == 1 else MSG_REQUEST
+        return L7Message(
+            protocol=proto,
+            msg_type=mt,
+            status=int(info.status) or 1,
+            status_code=int(info.status_code),
+            request_id=int(info.request_id),
+            request_type=info.request_type.decode(errors="replace"),
+            request_resource=info.request_resource.decode(errors="replace"),
+            request_domain=info.request_domain.decode(errors="replace"),
+            endpoint=info.endpoint.decode(errors="replace"),
+        )
+
+    return proto, check, parse

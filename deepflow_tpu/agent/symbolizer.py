@@ -227,8 +227,12 @@ class Symbolizer:
 
     def fold(self, stack: list[int]) -> str:
         """Leaf-FIRST address list (the perf unwind order
-        PerfStackSample documents) → root-first folded frame string."""
-        return ";".join(self.resolve(a) for a in reversed(stack))
+        PerfStackSample documents) → root-first folded frame string.
+        ';' inside a frame name (JVM signatures like 'Lcom/x/C;::m')
+        would corrupt the folded framing — it maps to ':'."""
+        return ";".join(
+            self.resolve(a).replace(";", ":") for a in reversed(stack)
+        )
 
 
 class ProfileAggregator:

@@ -29,7 +29,18 @@ from .metrics import (
     list_metrics,
     metric_type,
 )
-from .sqlparse import BinOp, Func, Ident, InList, Literal, Query, SQLError, UnaryOp, parse
+from .sqlparse import (
+    BinOp,
+    Func,
+    Ident,
+    InList,
+    Literal,
+    Query,
+    Show,
+    SQLError,
+    UnaryOp,
+    parse,
+)
 from .translation import Translator
 
 # row→group reducers (view/function.go FUNCTION_*)
@@ -66,6 +77,8 @@ class QueryEngine:
     # -- public ---------------------------------------------------------
     def execute(self, sql: str) -> Result:
         q = parse(sql)
+        if isinstance(q, Show):
+            return self._run_show(q)
         db, table = self._resolve_table(q.table)
         schema = self.store.schema(db, table)
         colnames = set(schema.column_names())
@@ -283,6 +296,32 @@ class QueryEngine:
         idx = idx[keep[idx]]
         idx = idx[q.offset : None if q.limit is None else q.offset + q.limit]
         return Result([n for n, _ in items], {k: np.asarray(v)[idx] for k, v in values.items()})
+
+    def _run_show(self, q: Show) -> Result:
+        """SHOW tables / metrics / tags — catalog rows as a result set."""
+        if q.what == "tables":
+            rows = [
+                {"db": db, "table": t}
+                for db in sorted(self.store.databases())
+                for t in sorted(self.store.tables(db))
+            ]
+            cols = ["db", "table"]
+        else:
+            # resolve db-qualified names the way SELECT does, and make
+            # unknown tables error instead of returning an empty catalog
+            _, bare = self._resolve_table(q.table)
+            cat = self.catalogs(bare)
+            rows = cat["metrics"] if q.what == "metrics" else cat["tags"]
+            rows = [
+                {k: (", ".join(v) if isinstance(v, list) else v)
+                 for k, v in r.items()}
+                for r in rows
+            ]
+            cols = list(rows[0].keys()) if rows else ["name"]
+        values = {
+            c: np.asarray([r.get(c, "") for r in rows]) for c in cols
+        }
+        return Result(cols, values)
 
     def catalogs(self, table: str) -> dict:
         """db_descriptions seat: tag + metric catalogs for one table."""

@@ -72,6 +72,16 @@ class SelectItem:
 
 
 @dataclasses.dataclass(frozen=True)
+class Show:
+    """SHOW tables | SHOW metrics FROM t | SHOW tags FROM t — the
+    db_descriptions introspection statements (querier/engine/clickhouse
+    ShowSqlParse handles `show tags/metrics from ...`)."""
+
+    what: str  # "tables" | "metrics" | "tags"
+    table: str | None
+
+
+@dataclasses.dataclass(frozen=True)
 class Query:
     select: tuple[SelectItem, ...]
     table: str
@@ -99,7 +109,7 @@ _TOKEN_RE = re.compile(
 
 _KEYWORDS = {
     "select", "from", "where", "group", "order", "by", "limit", "offset",
-    "as", "and", "or", "not", "in", "asc", "desc", "having",
+    "as", "and", "or", "not", "in", "asc", "desc", "having", "show",
 }
 
 
@@ -248,7 +258,21 @@ class _Parser:
         raise SQLError(f"unexpected token {v!r}")
 
     # statement --------------------------------------------------------
-    def parse_query(self) -> Query:
+    def parse_query(self) -> Query | Show:
+        if self.accept("kw", "show"):
+            what = self.expect("id").lower()
+            if what not in ("tables", "metrics", "tags"):
+                raise SQLError(f"SHOW {what!r}: expected tables/metrics/tags")
+            table = None
+            if self.accept("kw", "from"):
+                table = self.expect("id")
+            if self.peek()[0] != "eof":
+                raise SQLError(f"trailing input: {self.peek()[1]!r}")
+            if what != "tables" and table is None:
+                raise SQLError(f"SHOW {what} needs FROM <table>")
+            if what == "tables" and table is not None:
+                raise SQLError("SHOW tables takes no FROM clause")
+            return Show(what, table)
         self.expect("kw", "select")
         items = [self._select_item()]
         while self.accept("op", ","):

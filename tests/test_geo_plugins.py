@@ -66,3 +66,76 @@ def test_ebpf_flows_skip_l4_fanout():
     assert not bool(np.asarray(valid_l4).any())  # no L4 docs from eBPF
     _t, _m, _ts, valid_l7 = fanout_l7(tags, jnp.asarray(fb.meters), jnp.asarray(fb.valid), FanoutConfig())
     assert bool(np.asarray(valid_l7).any())  # L7 plane still emits
+
+
+SO_PLUGIN_SRC = r"""
+#include <string.h>
+
+struct df_l7_info {
+    int  msg_type;
+    int  status;
+    int  status_code;
+    unsigned int request_id;
+    char request_type[64];
+    char request_resource[256];
+    char request_domain[256];
+    char endpoint[256];
+};
+
+int df_protocol(void) { return 211; }
+
+int df_check(const unsigned char *payload, int len, int port) {
+    (void)port;
+    return len >= 4 && memcmp(payload, "NAT/", 4) == 0;
+}
+
+int df_parse(const unsigned char *payload, int len, struct df_l7_info *out) {
+    if (!df_check(payload, len, 0)) return 0;
+    memset(out, 0, sizeof(*out));
+    out->msg_type = (len > 4 && payload[4] == 'R') ? 1 : 0;
+    out->status = 1;
+    out->status_code = 200;
+    out->request_id = 7;
+    strncpy(out->request_type, "CALL", sizeof(out->request_type) - 1);
+    int n = len - 4 < 255 ? len - 4 : 255;
+    memcpy(out->request_resource, payload + 4, n > 0 ? n : 0);
+    return 1;
+}
+"""
+
+
+def test_so_plugin_abi(tmp_path):
+    """The native plugin seat: compile a real C parser against the
+    documented ABI, load the .so, and drive it through the shared
+    registry (reference: agent/src/plugin/shared_obj)."""
+    import subprocess
+
+    import pytest as _pytest
+
+    from deepflow_tpu.agent.l7.parsers import infer_protocol, parse_payload
+    from deepflow_tpu.agent.l7.plugins import load_plugins
+
+    src = tmp_path / "natproto.c"
+    src.write_text(SO_PLUGIN_SRC)
+    so = tmp_path / "natproto.so"
+    r = subprocess.run(
+        ["gcc", "-shared", "-fPIC", "-O2", "-o", str(so), str(src)],
+        capture_output=True,
+    )
+    if r.returncode != 0:
+        _pytest.skip(f"gcc unavailable: {r.stderr.decode()[:120]}")
+    (tmp_path / "broken.so").write_bytes(b"\x7fELFnot-really")
+
+    loaded = load_plugins(tmp_path)
+    assert (211, "natproto") in loaded
+    assert all(name != "broken" for _, name in loaded)
+
+    assert infer_protocol(b"NAT/lookup") == 211
+    msg = parse_payload(211, b"NAT/lookup")
+    assert msg.request_type == "CALL"
+    assert msg.request_resource == "lookup"
+    assert msg.request_id == 7 and msg.status_code == 200
+    resp = parse_payload(211, b"NAT/R ok")
+    from deepflow_tpu.agent.l7.parsers import MSG_RESPONSE
+
+    assert resp.msg_type == MSG_RESPONSE

@@ -304,3 +304,22 @@ def test_avg_untyped_column_is_arithmetic_mean(eng):
     v = one(eng, f"select Avg(server_port) as v from l4_flow_log "
                  f"where time >= {T0} and time <= {T0 + 120}")["v"]
     assert v == pytest.approx((80 * 4 + 443 * 2) / 6)
+
+
+def test_show_statements(eng):
+    r = eng.execute("SHOW tables")
+    pairs = set(zip(r.values["db"], r.values["table"]))
+    assert ("flow_log", "l4_flow_log") in pairs
+    assert ("flow_metrics", "network_1s") in pairs
+
+    r = eng.execute("SHOW metrics FROM network_1s")
+    byname = {n: t for n, t in zip(r.values["name"], r.values["type"])}
+    assert byname["byte_tx"] == "counter" and byname["rtt_max"] == "delay"
+
+    r = eng.execute("SHOW tags FROM l4_flow_log")
+    assert "tap_side" in set(r.values["name"])
+
+    with pytest.raises(SQLError):
+        eng.execute("SHOW metrics")  # needs FROM
+    with pytest.raises(SQLError):
+        eng.execute("SHOW nonsense")

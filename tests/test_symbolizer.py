@@ -160,3 +160,29 @@ def test_continuous_profiler_ships_profile_frames():
     head, _, body = frame.decode().partition("\n")
     assert head.startswith("svc-prof\x00cpu\x00")
     assert "printf" in body and body.endswith(" 7")  # merged weights
+
+
+def test_java_frames_fold_without_separator_corruption(tmp_path):
+    """';' in JVM signatures must not split frames in the folded line."""
+    from deepflow_tpu.agent.symbolizer import JavaPerfMap, Symbolizer
+    from deepflow_tpu.integration.formats import parse_folded
+
+    sym = Symbolizer("self")
+    sym.java = JavaPerfMap([(0x1000, 0x100, "Lcom/shop/Cart;::add")])
+    folded = sym.fold([0x1010])
+    samples, errors = parse_folded(folded + " 4")
+    assert errors == 0 and len(samples) == 1
+    assert samples[0].stack == "Lcom/shop/Cart:::add"
+
+
+def test_continuous_profiler_interval_flush():
+    from deepflow_tpu.agent.ebpf_bridge import ContinuousProfiler
+
+    prof = ContinuousProfiler(None, interval_s=10.0)
+    prof.agg.observe_folded("a;b", 1)
+    assert prof.maybe_flush(5.0) is None  # inside the window
+    frame = prof.maybe_flush(15.0)
+    assert frame is not None
+    prof.agg.observe_folded("a;b", 1)
+    assert prof.maybe_flush(20.0) is None  # window restarts at 15
+    assert prof.maybe_flush(25.0) is not None
