@@ -55,12 +55,16 @@ def load_plugins(plugin_dir: str | Path) -> list[tuple[int, str]]:
         except Exception:
             sys.modules.pop(name, None)
             continue
+        if proto < CUSTOM_PROTOCOL_BASE:
+            continue  # a plugin must never shadow a built-in parser
         register_parser(proto, check, parse)
         loaded.append((proto, path.stem))
     for path in sorted(d.glob("*.so")):
         try:
             proto, check, parse = _load_so_plugin(path)
         except Exception:
+            continue
+        if proto < CUSTOM_PROTOCOL_BASE:
             continue
         register_parser(proto, check, parse)
         loaded.append((proto, path.stem))

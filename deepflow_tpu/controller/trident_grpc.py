@@ -43,14 +43,17 @@ def parse_sync_request(body: bytes) -> dict:
     names = {1: "boot_time", 5: "revision", 7: "process_name",
              9: "version_platform_data", 21: "ctrl_ip", 22: "host",
              25: "ctrl_mac", 26: "vtap_group_id_request", 32: "cpu_num"}
-    for f, v in _iter_fields(body):
-        name = names.get(f)
-        if name is None:
-            continue
-        if isinstance(v, (bytes, bytearray)):
-            req[name] = bytes(v).decode(errors="replace")
-        else:
-            req[name] = int(v)
+    try:
+        for f, v in _iter_fields(body):
+            name = names.get(f)
+            if name is None:
+                continue
+            if isinstance(v, (bytes, bytearray)):
+                req[name] = bytes(v).decode(errors="replace")
+            else:
+                req[name] = int(v)
+    except ValueError:
+        pass  # truncated/garbled frame → whatever parsed so far
     return req
 
 
@@ -73,8 +76,17 @@ def build_sync_response(*, vtap_id: int, sync_interval: int,
 
 
 def parse_sync_response(body: bytes) -> dict:
-    """Client-side decode of the subset (tests + SDK)."""
+    """Client-side decode of the subset (tests + SDK); total on
+    garbage input like every untrusted-edge decoder here."""
     resp: dict = {}
+    try:
+        _parse_sync_response_into(resp, body)
+    except ValueError:
+        pass
+    return resp
+
+
+def _parse_sync_response_into(resp: dict, body: bytes) -> None:
     for f, v in _iter_fields(body):
         if f == 1:
             resp["status"] = int(v)
@@ -92,7 +104,6 @@ def parse_sync_response(body: bytes) -> dict:
             resp["revision"] = bytes(v).decode(errors="replace")
         elif f == 6:
             resp["version_platform_data"] = int(v)
-    return resp
 
 
 class TridentGrpcFacade:

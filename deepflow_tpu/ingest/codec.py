@@ -53,7 +53,12 @@ def _put_varint(buf: bytearray, v: int):
 def _get_varint(buf: bytes, off: int) -> tuple[int, int]:
     out = 0
     shift = 0
+    n = len(buf)
     while True:
+        if off >= n:
+            # truncated input is a rejected frame, same as overflow —
+            # decoders at the untrusted edge catch ValueError uniformly
+            raise ValueError("truncated varint")
         b = buf[off]
         off += 1
         out |= (b & 0x7F) << shift

@@ -103,3 +103,62 @@ def test_pcap_reader_tolerates_truncation(tmp_path):
         p2.write_bytes(data[:cut])
         pkts = read_pcap(p2)  # truncated tail dropped, no raise
         assert len(pkts) <= 2
+
+
+def test_round5_wire_parsers_never_raise_on_random_bytes():
+    """The round-5 codecs (OTLP metrics, trident sync) share the
+    untrusted-input stance: garbage in, empty/partial out, no raise."""
+    import numpy as np
+
+    from deepflow_tpu.controller.trident_grpc import (
+        parse_sync_request,
+        parse_sync_response,
+    )
+    from deepflow_tpu.integration.formats import (
+        parse_otlp_metrics,
+        parse_otlp_traces,
+    )
+
+    rng = np.random.default_rng(11)
+    for n in (0, 1, 7, 64, 513):
+        for _ in range(40):
+            blob = rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+            parse_otlp_metrics(blob)
+            parse_otlp_traces(blob)
+            parse_sync_request(blob)
+            parse_sync_response(blob)
+
+
+def test_round5_encoders_roundtrip_under_mutation():
+    """Flip bytes in valid OTLP/trident messages: parsers must never
+    raise (truncated varints surface as ValueError from _iter_fields
+    for trident, which handle_sync callers catch at the RPC edge)."""
+    import numpy as np
+
+    from deepflow_tpu.controller.trident_grpc import (
+        build_sync_response,
+        parse_sync_response,
+    )
+    from deepflow_tpu.integration.formats import (
+        OtelSpan,
+        encode_otlp_traces,
+        parse_otlp_traces,
+    )
+
+    rng = np.random.default_rng(12)
+    span = OtelSpan("svc", "op", "ab" * 16, "cd" * 8, "", 2,
+                    1_700_000_000_000_000, 1_700_000_001_000_000, 1,
+                    {"k": "v"})
+    base_t = bytearray(encode_otlp_traces([span]))
+    base_s = bytearray(build_sync_response(
+        vtap_id=9, sync_interval=60, platform_version=3))
+    for _ in range(60):
+        for base, parse in ((base_t, parse_otlp_traces),
+                            (base_s, parse_sync_response)):
+            b = bytearray(base)
+            for _ in range(rng.integers(1, 4)):
+                b[rng.integers(0, len(b))] = rng.integers(0, 256)
+            try:
+                parse(bytes(b))
+            except ValueError:
+                pass
