@@ -250,12 +250,15 @@ class Agent:
 
     # -- drivers ---------------------------------------------------------
     def run_live(self, interface: str = "lo", *, duration_s: float | None = None,
-                 snap: int = 192) -> dict:
+                 snap: int = 192, ring: bool = False) -> dict:
         """Live AF_PACKET capture → the same graph as replay (the
-        dispatcher seat when the container grants CAP_NET_RAW)."""
-        from .capture import AfPacketCapture
+        dispatcher seat when the container grants CAP_NET_RAW).
+        `ring=True` uses the TPACKET_V3 mmap block ring (the
+        recv_engine/af_packet fast path) instead of per-packet recv."""
+        from .capture import AfPacketCapture, AfPacketRingCapture
 
-        cap = AfPacketCapture(
+        cls = AfPacketRingCapture if ring else AfPacketCapture
+        cap = cls(
             interface, snap=snap, batch_size=self.config.batch_size
         )
         try:

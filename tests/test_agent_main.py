@@ -212,3 +212,39 @@ def test_live_capture_loopback():
     assert stats["capture"]["frames"] >= 80
     assert stats["packets"] >= 80  # parsed + injected into FlowMap
     agent.close()
+
+
+def test_live_capture_ring_loopback():
+    """TPACKET_V3 mmap block-ring capture (recv_engine/af_packet fast
+    path): real UDP over loopback through ring → parse → FlowMap."""
+    import socket as pysocket
+    import threading
+    import time as pytime
+
+    import pytest
+
+    try:
+        from deepflow_tpu.agent.capture import AfPacketRingCapture
+
+        probe = AfPacketRingCapture("lo")
+        probe.close()
+    except (PermissionError, OSError):
+        pytest.skip("AF_PACKET ring unavailable")
+
+    agent = Agent(AgentConfig(batch_size=256), senders={})
+
+    def chatter():
+        s = pysocket.socket(pysocket.AF_INET, pysocket.SOCK_DGRAM)
+        for i in range(120):
+            s.sendto(b"ring-%d" % i, ("127.0.0.1", 39998))
+            pytime.sleep(0.002)
+        s.close()
+
+    t = threading.Thread(target=chatter)
+    t.start()
+    stats = agent.run_live("lo", duration_s=1.5, ring=True)
+    t.join()
+    agent.close()
+    assert stats["capture"]["frames"] >= 120, stats["capture"]
+    assert stats["capture"]["blocks"] >= 1
+    assert agent.counters["packets"] >= 120
