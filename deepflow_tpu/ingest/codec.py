@@ -366,6 +366,15 @@ class DocumentDecoder:
         self.decode_errors = 0
         self.unknown_codes = 0
 
+    def decode_parts(
+        self, parts: list[tuple[bytes, list[tuple[int, int]]]]
+    ) -> dict[int, DecodedBatch]:
+        """Span-based twin of NativeDocumentDecoder.decode_parts (the
+        Python path still slices — it is the fallback, not the fast
+        path)."""
+        msgs = [body[o:o + ln] for body, spans in parts for o, ln in spans]
+        return self.decode(msgs)
+
     def decode(self, messages: list[bytes]) -> dict[int, DecodedBatch]:
         rows: dict[int, list] = {}
         strings = StringDict()

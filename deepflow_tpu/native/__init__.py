@@ -244,10 +244,39 @@ class NativeDocumentDecoder:
         if n == 0:
             return {}
         buf = b"".join(messages)
-        arr = np.frombuffer(buf, dtype=np.uint8)
         lens = np.array([len(m) for m in messages], dtype=np.uint32)
         offs = np.zeros(n, dtype=np.uint64)
         np.cumsum(lens[:-1], out=offs[1:])
+        return self._decode_buffer(buf, offs, lens)
+
+    def decode_parts(
+        self, parts: list[tuple[bytes, list[tuple[int, int]]]]
+    ) -> dict[int, DecodedBatch]:
+        """Zero-slice path: [(frame body, [(msg offset, len), ...])] →
+        batches. The bodies concatenate once; per-message offsets shift
+        by each body's base — no per-message bytes objects (the r5
+        host-path fix: split_messages + b"".join re-copied every doc)."""
+        total = sum(len(sp) for _, sp in parts)
+        if total == 0:
+            return {}
+        buf = b"".join(b for b, _ in parts)
+        offs = np.empty(total, dtype=np.uint64)
+        lens = np.empty(total, dtype=np.uint32)
+        i = 0
+        base = 0
+        for body, spans in parts:
+            k = len(spans)
+            if k:
+                a = np.asarray(spans, dtype=np.uint64)
+                offs[i:i + k] = a[:, 0] + base
+                lens[i:i + k] = a[:, 1].astype(np.uint32)
+                i += k
+            base += len(body)
+        return self._decode_buffer(buf, offs, lens)
+
+    def _decode_buffer(self, buf: bytes, offs, lens) -> dict[int, DecodedBatch]:
+        n = len(offs)
+        arr = np.frombuffer(buf, dtype=np.uint8)
 
         tags = np.zeros((n, _T.num_fields), dtype=np.uint32)
         meters = np.zeros((n, _M_COLS), dtype=np.float32)

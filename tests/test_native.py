@@ -134,3 +134,28 @@ def test_overwrite_queue_threaded():
         t.join()
     # conservation: every item was either consumed or counted as shed
     assert got + q.overwritten == 4 * N
+
+
+def test_native_decode_parts_matches_decode():
+    """decode_parts (the production zero-slice path) must agree with
+    decode() across multi-frame drains, including base-offset shifts
+    and bodies with zero messages."""
+    from deepflow_tpu.ingest.framing import split_message_spans
+
+    msgs = _pipeline_msgs()
+    # three frame bodies of different sizes + one empty body
+    bodies = []
+    cut1, cut2 = len(msgs) // 3, 2 * len(msgs) // 3
+    for chunk in (msgs[:cut1], msgs[cut1:cut2], [], msgs[cut2:]):
+        frame = encode_frame(FlowHeader(msg_type=3), chunk)
+        bodies.append(frame[19:])
+    parts = [(b, split_message_spans(b)) for b in bodies]
+
+    nat = native.NativeDocumentDecoder()
+    got = nat.decode_parts(parts)
+    want = native.NativeDocumentDecoder().decode(msgs)
+    _assert_decodes_equal(got, want)
+
+    # python twin agrees too
+    py = DocumentDecoder().decode_parts(parts)
+    _assert_decodes_equal(py, want)
