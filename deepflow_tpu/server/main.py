@@ -32,7 +32,35 @@ from ..querier.translation import Translator
 from ..server.datasource import DataSource, Downsampler
 from ..server.debug import DebugServer
 from ..server.events import EventIngester
-from ..server.exporters import ExporterHub
+from ..server.exporters import ExporterHub, FileExporter, OtlpExporter, RemoteWriteExporter
+
+
+def build_exporters(specs) -> list:
+    """Config-driven sink construction (the reference's
+    exporters/config seat): each spec is {"kind": ..., kwargs...}.
+    Unknown kinds raise at boot — a misconfigured sink must not
+    silently drop telemetry."""
+    out = []
+    for spec in specs or ():
+        spec = dict(spec)
+        kind = spec.pop("kind", None)
+        if "data_sources" in spec:
+            spec["data_sources"] = tuple(spec["data_sources"])
+        if kind == "kafka":
+            from ..server.kafka_exporter import KafkaExporter
+
+            out.append(KafkaExporter(**spec))
+        elif kind == "otlp":
+            out.append(OtlpExporter(**spec))
+        elif kind == "prom_rw":
+            if "metrics" in spec:
+                spec["metrics"] = tuple(spec["metrics"])
+            out.append(RemoteWriteExporter(**spec))
+        elif kind == "jsonl":
+            out.append(FileExporter(**spec))
+        else:
+            raise ValueError(f"unknown exporter kind {kind!r}")
+    return out
 from ..server.flow_metrics import FlowMetricsIngester
 from ..server.integration import IntegrationIngester
 from ..server.mcp import MCPServer
@@ -48,7 +76,10 @@ from ..utils.stats import default_collector
 class Server:
     def __init__(self, config: ServerConfig | None = None, *, exporters=None, lease_path=None):
         self.config = config or load_config(None)[0]
-        self.exporters = exporters or []
+        self.exporters = (
+            exporters if exporters is not None
+            else build_exporters(self.config.exporters)
+        )
         self.lease_path = lease_path
         self.started = False
 

@@ -80,6 +80,11 @@ class ServerConfig:
     sketch: SketchConfig = SketchConfig()
     region_id: int = 0
     log_level: str = "info"
+    # exporter sink specs (exporters/config seat): list of mappings,
+    # each {"kind": "kafka"|"otlp"|"prom_rw"|"jsonl", ...kind kwargs,
+    # "data_sources": [table prefixes]} — built by
+    # server.main.build_exporters at boot
+    exporters: tuple = ()
 
 
 def _overlay(cls, defaults, data: dict[str, Any], path: str, unknown: list[str]):
@@ -98,6 +103,8 @@ def _overlay(cls, defaults, data: dict[str, Any], path: str, unknown: list[str])
                 raise ConfigError(f"{path}{key}: expected mapping")
             kwargs[key] = _overlay(type(cur), cur, value, f"{path}{key}.", unknown)
         else:
+            if isinstance(cur, tuple) and isinstance(value, list):
+                value = tuple(value)  # YAML sequences arrive as lists
             if cur is not None and not isinstance(
                 value, (type(cur), int) if isinstance(cur, float) else type(cur)
             ):

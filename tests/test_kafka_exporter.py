@@ -242,3 +242,33 @@ def test_plugins_cannot_shadow_builtin_protocols(tmp_path):
         "def parse_payload(p): return None\n"
     )
     assert load_plugins(tmp_path) == []  # proto 1 (HTTP) rejected
+
+
+def test_config_driven_exporter_construction(tmp_path):
+    """server.yaml exporters: section → real sinks at boot (the
+    exporters/config seat)."""
+    import pytest
+
+    from deepflow_tpu.server.exporters import FileExporter, OtlpExporter
+    from deepflow_tpu.server.kafka_exporter import KafkaExporter
+    from deepflow_tpu.server.main import Server, build_exporters
+    from deepflow_tpu.utils.config import load_config
+
+    cfg, unknown = load_config({
+        "storage": {"root": str(tmp_path / "s")},
+        "exporters": [
+            {"kind": "kafka", "host": "127.0.0.1", "port": 19092,
+             "acks": 0, "data_sources": ["network"]},
+            {"kind": "otlp", "traces_url": "http://127.0.0.1:1/v1/traces"},
+            {"kind": "jsonl", "directory": str(tmp_path / "sink")},
+        ],
+    })
+    assert not unknown
+    srv = Server(cfg)  # constructor builds the sinks; no start needed
+    kinds = [type(e) for e in srv.exporters]
+    assert kinds == [KafkaExporter, OtlpExporter, FileExporter]
+    assert srv.exporters[0].addr == ("127.0.0.1", 19092)
+    assert srv.exporters[0].data_sources == ("network",)
+
+    with pytest.raises(ValueError):
+        build_exporters([{"kind": "nonsense"}])
