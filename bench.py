@@ -6,18 +6,28 @@ replaying the BASELINE config-1 workload shape: synthetic
 accumulated-flow batches over 10k unique 5-tuples at 1s windows.
 
 The cycle is the production cadence (aggregator/pipeline.py): per batch
-one `append` (batch-local groupby pre-reduce → fanout → fingerprint →
-accumulator write), and every ACCUM_BATCHES batches one `fold` (the
-amortized sort+segment reduce of [stash + accumulator] rows). The
-pre-reduce (PERF.md §7) collapses each batch to its unique raw keys
-BEFORE the 4-lane doc fanout — exact for any workload, and the reason
-fold rows stop scaling with the dup factor. Reported records/sec
+one `append` (batch-local groupby pre-reduce → fanout → packed-word
+fingerprint → accumulator write), and every ACCUM_BATCHES batches one
+`fold` (the amortized sort+segment reduce of [stash + accumulator]
+rows). The pre-reduce (PERF.md §7) collapses each batch to its unique
+raw keys BEFORE the 4-lane doc fanout — exact for any workload, and the
+reason fold rows stop scaling with the dup factor. Reported records/sec
 includes the full amortized cost of aggregation, not just the append.
 
 Timing uses an explicit host fetch as the sync point: on the remote
 accelerator tunnel `block_until_ready` returns before execution
 completes (PERF.md §6), so the loop chains state through K cycles and
 subtracts one measured fetch latency.
+
+Wedge-proofing (r5 verdict #1): compiling batch shapes past the
+known-good envelope has twice wedged the accelerator tunnel for the
+rest of the session (PERF.md §5, §9c — a dead `jax.devices()` hang, not
+an exception). The shape gate below encodes that rule in code: BATCH >
+MAX_SAFE_BATCH is refused (rc=2, parseable record) unless BENCH_FORCE=1
+is set explicitly. Backend failures (tunnel dead, backend init error)
+emit a PARTIAL record — same schema, value 0, an `error` field — and
+exit 0, so the driver always gets one parseable JSON line instead of a
+raw traceback.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 vs_baseline is against the north-star target of 50M records/sec/chip
@@ -28,26 +38,16 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-from deepflow_tpu.aggregator.fanout import FANOUT_LANES, FanoutConfig
-from deepflow_tpu.aggregator.pipeline import make_ingest_step
-from deepflow_tpu.aggregator.stash import accum_init, stash_init
-from deepflow_tpu.datamodel.schema import FLOW_METER, TAG_SCHEMA
-from deepflow_tpu.ingest.replay import SyntheticFlowGen
 
 TARGET = 50e6  # records/sec/chip north star
 
-# Measured-safe shapes (PERF.md §7, 2026-07-30 on-chip): compile+first
-# ~105 s at these sizes, steady 21.3 M rec/s at the 2M batch.
-# The fold sorts CAPACITY + ACCUM_BATCHES×4×UNIQUE_CAP rows (262k here);
-# the appends sort BATCH raw rows. UNIQUE_CAP bounds per-batch unique
-# keys (3x headroom over the 10k-tuple workload); overflow is shed and
-# counted, never silent.
+# Measured-safe shapes (PERF.md §7/§9, on-chip): compile+first ~105 s at
+# these sizes. The fold sorts CAPACITY + ACCUM_BATCHES×4×UNIQUE_CAP rows
+# (262k here); the appends sort BATCH raw rows. UNIQUE_CAP bounds
+# per-batch unique keys (3x headroom over the 10k-tuple workload);
+# overflow is shed and counted, never silent.
 BATCH = int(os.environ.get("BENCH_BATCH", 1 << 21))  # flows per step
 CAPACITY = int(os.environ.get("BENCH_CAPACITY", 1 << 16))  # stash segments
 ACCUM_BATCHES = int(os.environ.get("BENCH_ACCUM_BATCHES", 2))
@@ -55,8 +55,35 @@ UNIQUE_CAP = int(os.environ.get("BENCH_UNIQUE_CAP", 1 << 15))
 WARMUP_CYCLES = 1
 CYCLES = int(os.environ.get("BENCH_CYCLES", 8))
 
+# Known-good compiled-shape envelope (PERF.md §5, §9c): a 4M-batch probe
+# wedged the axon tunnel for the whole session, twice. Encoded here so
+# the rule survives operator turnover; BENCH_FORCE=1 overrides.
+MAX_SAFE_BATCH = 1 << 21
 
-def main():
+
+def _record(value: float, **extra) -> str:
+    return json.dumps(
+        {
+            "metric": "flow_records_per_sec_per_chip",
+            "value": round(value, 1),
+            "unit": "records/s",
+            "vs_baseline": round(value / TARGET, 4),
+            **extra,
+        }
+    )
+
+
+def _run() -> float:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepflow_tpu.aggregator.fanout import FANOUT_LANES, FanoutConfig
+    from deepflow_tpu.aggregator.pipeline import make_ingest_step
+    from deepflow_tpu.aggregator.stash import accum_init, stash_init
+    from deepflow_tpu.datamodel.schema import FLOW_METER, TAG_SCHEMA
+    from deepflow_tpu.ingest.replay import SyntheticFlowGen
+
     gen = SyntheticFlowGen(num_tuples=10_000, seed=0)
     fb = gen.flow_batch(BATCH, 1_700_000_000)
     tags = {k: jnp.asarray(v) for k, v in fb.tags.items()}
@@ -92,18 +119,39 @@ def main():
     _ = np.asarray(state.slot[:1])
     dt = time.perf_counter() - t0 - fetch_base
 
-    rate = BATCH * ACCUM_BATCHES * CYCLES / dt
-    print(
-        json.dumps(
-            {
-                "metric": "flow_records_per_sec_per_chip",
-                "value": round(rate, 1),
-                "unit": "records/s",
-                "vs_baseline": round(rate / TARGET, 4),
-            }
+    return BATCH * ACCUM_BATCHES * CYCLES / dt
+
+
+def main() -> int:
+    # Shape gate FIRST — before any jax import can touch the backend.
+    if BATCH > MAX_SAFE_BATCH and os.environ.get("BENCH_FORCE") != "1":
+        print(
+            _record(
+                0.0,
+                partial=True,
+                error=(
+                    f"BENCH_BATCH={BATCH} exceeds the known-good compiled-shape "
+                    f"envelope (≤{MAX_SAFE_BATCH}; PERF.md §5/§9c tunnel wedge); "
+                    "set BENCH_FORCE=1 to override"
+                ),
+            )
         )
-    )
+        return 2
+
+    try:
+        rate = _run()
+    except Exception as e:  # backend init/compile/runtime failure
+        print(
+            _record(
+                0.0,
+                partial=True,
+                error=f"{type(e).__name__}: {e}",
+            )
+        )
+        return 0
+    print(_record(rate))
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -166,11 +166,11 @@ def main():
         if n <= 1 << 15:
             from deepflow_tpu.ops.segment import groupby_reduce
 
-            def r3(slot, hi, lo, tags_t, meters_t, valid):
-                return groupby_reduce(slot, hi, lo, tags_t, meters_t, valid,
+            def r3(slot, hi, lo, tags_t, meters_r, valid):
+                return groupby_reduce(slot, hi, lo, tags_t, meters_r, valid,
                                       SUM_COLS, MAX_COLS)
 
-            timeit("r3_scan", jax.jit(r3), slot, hi, lo, tags_t, meters_t, valid)
+            timeit("r3_scan", jax.jit(r3), slot, hi, lo, tags_t, meters_r, valid)
 
     print(f"--- hash-stash cost model (N={N_DOC}, H={H}) ---")
     rng = np.random.default_rng(1)

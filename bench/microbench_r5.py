@@ -10,13 +10,26 @@ one host fetch at the end), so successive deltas attribute time to:
   A  stack 25 tag cols + fingerprint64_t + slot
   B  + lax.sort((slot, hi, lo, iota))
   C  + head flags / segment-id cumsum
-  D  + meter row-gather [N, 17] via perm
+  D  + meter row-gather [N, 62] via perm
   E  + full-width segment_sum (num_segments=CAPU)
   F  + full-width segment_max
-  G  = full batch_prereduce (adds segment_min heads + tag gathers)
+  G  = full batch_prereduce (adds head positions + tag gathers)
   H  = full append (prereduce + fanout + key fingerprint + accum write)
 
+r6 variants (the levers that replaced D/E/F and part of A):
+  2  A with the fingerprint folding dict columns directly (no stack)
+  3  A with the PACKED-word fingerprint (datamodel/code.py plans)
+  p  C + fused Pallas suffix reduce: the kernel gathers rows THROUGH
+     the sort permutation (no standalone D gather pass at all)
+  q  C + standalone row-gather (D) + pre-gathered Pallas suffix reduce
+     (the r5 shipped shape) — q − p is the row-gather's residual cost
+
+G/H always time the CURRENT production graph, so after the r6 rebuild
+they include the packed fingerprint and (on TPU / forced pallas) the
+fused kernel; compare p vs q and 3 vs A on-chip to attribute the wins.
+
 Usage: python bench/microbench_r5.py [--batch 2097152] [--capu 32768]
+                                     [--stages abcdefgh23pq]
 Copy results into PERF.md.
 """
 
@@ -145,6 +158,39 @@ def stage_v2(c, tags, meters, valid):
     return c ^ hi[0] ^ lo[0] ^ slot[0]
 
 
+def stage_v3(c, tags, meters, valid):
+    """Like A but with the r6 packed-word fingerprint: bin-packed u32
+    key words built once, both seeds fold ~23 words instead of 37."""
+    from deepflow_tpu.datamodel.code import RAW_TAG_PACK, pack_tag_words
+    from deepflow_tpu.ops.hashing import fingerprint64_words
+
+    tags = dict(tags)
+    tags["ip0_w3"] = tags["ip0_w3"] ^ c
+    hi, lo = fingerprint64_words(pack_tag_words(tags, RAW_TAG_PACK, jnp))
+    slot = jnp.asarray(tags["timestamp"], jnp.uint32)
+    return c ^ hi[0] ^ lo[0] ^ slot[0]
+
+
+def _stage_pallas(c, tags, meters, valid, capu, fused):
+    """C + the Pallas suffix reduce. fused=True: the kernel gathers
+    meter rows through the sort permutation (NO standalone row-gather
+    stage); fused=False: the r5 shape (D's take, then the kernel)."""
+    from deepflow_tpu.ops.segreduce_pallas import sorted_segment_sum_max
+
+    lanes, _ = _sorted(c, tags, valid)
+    seg_id, num_seg = _segids(lanes)
+    first_pos = jnp.searchsorted(seg_id, jnp.arange(capu, dtype=jnp.int32))
+    if fused:
+        ps, pm = sorted_segment_sum_max(
+            meters, seg_id, capu, first_pos, perm=lanes[3]
+        )
+    else:
+        rows = jnp.take(meters, lanes[3], axis=0)
+        ps, pm = sorted_segment_sum_max(rows, seg_id, capu, first_pos)
+    return (c ^ ps[0, 0].astype(jnp.uint32) ^ pm[0, 0].astype(jnp.uint32)
+            ^ jnp.uint32(num_seg))
+
+
 def stage_g(c, tags, meters, valid, capu):
     tags = dict(tags)
     tags["ip0_w3"] = tags["ip0_w3"] ^ c
@@ -200,9 +246,15 @@ def main():
     jit_g = jax.jit(partial(stage_g, capu=CAPU))
     jit_v1 = jax.jit(partial(stage_v1, capu=CAPU))
     jit_v2 = jax.jit(stage_v2)
+    jit_v3 = jax.jit(stage_v3)
+    jit_p = jax.jit(partial(_stage_pallas, capu=CAPU, fused=True))
+    jit_q = jax.jit(partial(_stage_pallas, capu=CAPU, fused=False))
     stages = {
         "1": ("V1 narrow segment_max", lambda c: jit_v1(c, tags, meters, valid)),
         "2": ("V2 destacked fingerprint", lambda c: jit_v2(c, tags, meters, valid)),
+        "3": ("V3 packed-word fingerprint", lambda c: jit_v3(c, tags, meters, valid)),
+        "p": ("P fused-gather pallas reduce", lambda c: jit_p(c, tags, meters, valid)),
+        "q": ("Q pregather pallas reduce", lambda c: jit_q(c, tags, meters, valid)),
         "a": ("A stack+fingerprint", lambda c: jit_a(c, tags, meters, valid)),
         "b": ("B +sort4", lambda c: jit_b(c, tags, meters, valid)),
         "c": ("C +segids", lambda c: jit_c(c, tags, meters, valid)),

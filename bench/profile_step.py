@@ -28,7 +28,7 @@ import jax.numpy as jnp
 sys.path.insert(0, ".")
 
 from deepflow_tpu.aggregator.fanout import FANOUT_LANES, FanoutConfig, fanout_l4
-from deepflow_tpu.aggregator.pipeline import _KEY_COLS, make_ingest_step
+from deepflow_tpu.aggregator.pipeline import _KEY_COLS, _doc_fingerprint, make_ingest_step
 from deepflow_tpu.aggregator.stash import accum_init, stash_init
 from deepflow_tpu.datamodel.schema import FLOW_METER, TAG_SCHEMA
 from deepflow_tpu.ingest.replay import SyntheticFlowGen
@@ -88,20 +88,22 @@ def main():
         jax.block_until_ready(doc_tags)
         key_cols = jnp.asarray(_KEY_COLS)
 
-        def fp(dt):
-            # doc tags are column-major [T, 4N]; key selection is a
-            # static row select, fingerprint runs lane-wise.
+        def fp_raw(dt):
+            # legacy raw-column fold: key row select + 32-column murmur
             km = jnp.take(dt, key_cols, axis=0)
             return fingerprint64_t(km)
 
-        res["fingerprint"] = timeit(fp, doc_tags)
+        res["fingerprint_raw"] = timeit(fp_raw, doc_tags)
+        # production path since r6: packed key words (PERF.md §9d)
+        res["fingerprint_packed"] = timeit(_doc_fingerprint, doc_tags)
 
         # 3. batch-local sort+reduce ([4N] rows)
-        hi, lo = jax.jit(fp)(doc_tags)
+        hi, lo = jax.jit(_doc_fingerprint)(doc_tags)
         window = (ts // jnp.uint32(1)).astype(jnp.uint32)
 
         def local_reduce(w, h, l, dt, dm, dv):
-            return groupby_reduce(w, h, l, dt, dm, dv, sum_cols, max_cols)
+            return groupby_reduce(w, h, l, dt, jnp.transpose(dm), dv,
+                                  sum_cols, max_cols)
 
         res["local_sort_reduce_4N"] = timeit(
             local_reduce, window, hi, lo, doc_tags, doc_meters, doc_valid

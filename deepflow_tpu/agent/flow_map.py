@@ -281,11 +281,16 @@ def _seq_list_retrans(tracker: dict, hi, lo, d1, seq, plen, is_data):
         key = (int(hi[i]), int(lo[i]), int(d1[i]))
         s32 = int(seq[i])
         ln = int(plen[i])
-        ent = tracker.get(key)
+        # pop + reinsert on EVERY touch: dict order then approximates
+        # LRU, so the overflow eviction in FlowMap.inject (which deletes
+        # the oldest-quarter of keys) sheds idle flows, not the
+        # long-lived active ones whose cross-batch retrans detection
+        # matters most (ADVICE.md #3 — update-in-place left dict order
+        # at insertion time, evicting exactly the wrong entries).
+        ent = tracker.pop(key, None)
         if ent is None:
             anchor = s32
             ivals: list[list[int]] = []
-            tracker[key] = (anchor, ivals)
         else:
             anchor, ivals = ent
         # wrap-tolerant signed offset from the anchor
@@ -294,6 +299,7 @@ def _seq_list_retrans(tracker: dict, hi, lo, d1, seq, plen, is_data):
         covered = any(a <= s and e <= b for a, b in ivals)
         if covered:
             out[i] = True
+            tracker[key] = (anchor, ivals)  # refresh recency on hit too
             continue
         # insert + merge (list stays sorted and disjoint; adjacency
         # merges so contiguous transmissions form one range)
@@ -473,8 +479,11 @@ class FlowMap:
         self.agent_id = agent_id
         self.dispatcher = dispatcher
         self.state = log_stash_init(capacity, FLOW_STATE)
-        # host-side per-(flow, dir) seq high-water marks for cross-batch
-        # retrans detection; bounded, oldest-quarter evicted on overflow
+        # host-side per-(flow, dir) seq interval lists for cross-batch
+        # retrans detection; bounded. Entries move to the dict tail on
+        # every touch (_seq_list_retrans pop+reinsert), so the
+        # oldest-quarter eviction below approximates LRU — idle flows
+        # go first, active long-lived flows keep their seq history
         self.seq_tracker: dict = {}
         self.seq_tracker_cap = max(1024, 4 * capacity)
         self.counters = {"packets_in": 0, "invalid_packets": 0, "flows_emitted": 0, "flows_closed": 0}
@@ -493,8 +502,14 @@ class FlowMap:
         if len(self.seq_tracker) > self.seq_tracker_cap:
             import itertools
 
-            for k in list(itertools.islice(iter(self.seq_tracker),
-                                           self.seq_tracker_cap // 4)):
+            # dict head = least-recently-touched (pop+reinsert in
+            # _seq_list_retrans); drop a quarter, and always at least
+            # enough to get back under the cap
+            n_evict = max(
+                len(self.seq_tracker) - self.seq_tracker_cap,
+                self.seq_tracker_cap // 4,
+            )
+            for k in list(itertools.islice(iter(self.seq_tracker), n_evict)):
                 del self.seq_tracker[k]
         n = ints.shape[0]
         if n > self.batch_size:

@@ -17,13 +17,28 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..datamodel.batch import DocBatch, FlowBatch
-from ..datamodel.code import DocumentFlag
+from ..datamodel.code import DOC_KEY_PACK, RAW_TAG_PACK, DocumentFlag, pack_tag_words
 from ..datamodel.schema import APP_METER, FLOW_METER, TAG_SCHEMA, MeterSchema
-from ..ops.hashing import fingerprint64_t
+from ..ops.hashing import fingerprint64_words
 from .fanout import FanoutConfig, fanout_l4, fanout_l7
 from .window import FlushedWindow, WindowConfig, WindowManager
 
 _KEY_COLS = np.nonzero(TAG_SCHEMA.key_mask)[0].astype(np.int32)
+# DOC_KEY_PACK covers exactly the TAG_SCHEMA key columns — drift between
+# the schema and the packing widths table fails at import, not at runtime.
+assert set(DOC_KEY_PACK.field_names()) == {
+    f.name for f in TAG_SCHEMA.fields if f.key
+}, "DOC_KEY_WIDTHS out of sync with TAG_SCHEMA key columns"
+
+
+def _doc_fingerprint(doc_tags):
+    """(hi, lo) over a [T, N] doc tag matrix via the packed-word plan:
+    the key columns are bin-packed into ~22 u32 words built once
+    (datamodel/code.py), and both murmur seeds fold the words instead
+    of 32 raw columns (PERF.md §9d). Row extraction from the
+    column-major matrix is free (contiguous [N] slices)."""
+    cols = {f: doc_tags[TAG_SCHEMA.index(f)] for f in DOC_KEY_PACK.field_names()}
+    return fingerprint64_words(pack_tag_words(cols, DOC_KEY_PACK, jnp))
 
 
 def batch_prereduce(tags, meters, valid, interval, cap, sum_cols, max_cols):
@@ -37,20 +52,19 @@ def batch_prereduce(tags, meters, valid, interval, cap, sum_cols, max_cols):
     Returns (tags, meters [cap, M], valid, dropped) — rows beyond `cap`
     unique keys are shed; callers count `dropped` (newest-shed
     stance)."""
-    from ..ops.hashing import SEED_HI, SEED_LO, _fold
     from ..ops.segment import groupby_reduce
 
     names = sorted(tags)
     cols = [jnp.asarray(tags[k], jnp.uint32) for k in names]
     tags_t = jnp.stack(cols)
-    # fold the columns directly — hashing through the [T, N] stack costs
-    # an extra materialization (~4 ms at 2M rows, r5 bisect V2); the
-    # stack itself is still needed as the groupby payload
-    hi = _fold(cols, SEED_HI, jnp)
-    lo = _fold(cols, SEED_LO, jnp)
+    # fingerprint the PACKED words, not the raw columns: ~23 fold rounds
+    # instead of 37 per seed, built once for both seeds (PERF.md §9d;
+    # the [T, N] stack stays only as the groupby payload — r5 bisect V2
+    # already showed hashing through it wastes a materialization)
+    hi, lo = fingerprint64_words(pack_tag_words(tags, RAW_TAG_PACK, jnp))
     slot = jnp.asarray(tags["timestamp"], jnp.uint32) // jnp.uint32(interval)
     g = groupby_reduce(
-        slot, hi, lo, tags_t, jnp.transpose(meters), valid,
+        slot, hi, lo, tags_t, meters, valid,
         sum_cols, max_cols, out_capacity=cap,
     )
     r_tags = {k: g.tags[i] for i, k in enumerate(names)}
@@ -84,7 +98,6 @@ def make_ingest_step(fanout_config: FanoutConfig, interval: int = 1, app: bool =
     max_cols = tuple(int(i) for i in np.nonzero(meter_schema.max_mask)[0])
     sum_cols_np = np.asarray(sum_cols, np.int32)
     max_cols_np = np.asarray(max_cols, np.int32)
-    key_cols = jnp.asarray(_KEY_COLS)
 
     from .stash import _append_impl, _fold_impl
 
@@ -98,8 +111,7 @@ def make_ingest_step(fanout_config: FanoutConfig, interval: int = 1, app: bool =
                 stash, dropped_overflow=stash.dropped_overflow + dropped
             )
         doc_tags, doc_meters, ts, doc_valid = fanout_fn(tags, meters, valid, fanout_config)
-        key_mat = jnp.take(doc_tags, key_cols, axis=0)  # [K, 4N] — static row select
-        hi, lo = fingerprint64_t(key_mat)
+        hi, lo = _doc_fingerprint(doc_tags)  # packed key words, no key_mat take
         window = (ts // jnp.uint32(interval)).astype(jnp.uint32)
         acc = _append_impl(acc, window, hi, lo, doc_tags, doc_meters, doc_valid, offset)
         return stash, acc
@@ -157,8 +169,7 @@ class RollupPipeline:
         doc_tags, doc_meters, ts, doc_valid = self.fanout_fn(
             tags, meters, valid, self.config.fanout
         )
-        key_mat = jnp.take(doc_tags, jnp.asarray(_KEY_COLS), axis=0)
-        hi, lo = fingerprint64_t(key_mat)
+        hi, lo = _doc_fingerprint(doc_tags)
 
         flushed = self.wm.ingest(ts, hi, lo, doc_tags, doc_meters, doc_valid)
         return [self._to_docbatch(f) for f in flushed]

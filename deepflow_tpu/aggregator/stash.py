@@ -70,8 +70,12 @@ def _merge_impl(state: StashState, slot, key_hi, key_lo, tags_t, meters_t, valid
     all_meters = jnp.concatenate([state.meters, meters_t], axis=1)
     all_valid = jnp.concatenate([state.valid, valid])
 
+    # groupby_reduce consumes row-major meters; the stash keeps its
+    # column-major layout (free column selection at flush), so the fold
+    # transposes here — at fold scale this replaces the row-gather the
+    # reduce no longer performs, and XLA folds it into that copy.
     g = groupby_reduce(
-        all_slot, all_hi, all_lo, all_tags, all_meters, all_valid,
+        all_slot, all_hi, all_lo, all_tags, jnp.transpose(all_meters), all_valid,
         sum_cols, max_cols, out_capacity=s,
     )
 

@@ -17,13 +17,23 @@ map (id, the whole dict JSON-encoded — the `k8s.labels` column seat).
 from __future__ import annotations
 
 import json
+import logging
 
 import numpy as np
 
 from ..storage.store import ColumnarStore, ColumnSpec, TableSchema
+from ..utils.stats import register_countable
 from .resources import KINDS, ResourceDB
 
+log = logging.getLogger(__name__)
+
 FLOW_TAG_DB = "flow_tag"
+
+# Width of the plural k8s-metadata JSON column (_plural_schema). The
+# reference's ClickHouse String column is unbounded; this store's
+# fixed-width seat truncates, so oversized dicts are counted and logged
+# (ADVICE.md #1) instead of silently leaving invalid JSON behind.
+PLURAL_JSON_WIDTH = 1024
 
 # pod attr → (singular table stem, plural table stem)
 _K8S_META = {
@@ -64,7 +74,7 @@ def _plural_schema(name: str) -> TableSchema:
         (
             ColumnSpec("time", "u4"),
             ColumnSpec("id", "u4"),
-            ColumnSpec("value", "U1024"),
+            ColumnSpec("value", f"U{PLURAL_JSON_WIDTH}"),
         ),
         partition_s=1 << 30,
     )
@@ -76,7 +86,11 @@ class TagRecorder:
         self.store = store
         self.translator = translator
         self._synced_version = 0
-        self.counters = {"syncs": 0, "rows": 0}
+        self.counters = {"syncs": 0, "rows": 0, "plural_json_truncated": 0}
+        register_countable("tagrecorder", self)
+
+    def get_counters(self):
+        return dict(self.counters)
 
     def sync(self) -> bool:
         """Rewrite dictionaries if resources changed; returns whether a
@@ -126,7 +140,20 @@ class TagRecorder:
                     values.append(str(v))
                 if kv:
                     p_ids.append(r.id)
-                    p_values.append(json.dumps(kv, sort_keys=True))
+                    blob = json.dumps(kv, sort_keys=True)
+                    if len(blob) > PLURAL_JSON_WIDTH:
+                        # the store's fixed-width cast will clip this to
+                        # invalid JSON — count + name the pod so the
+                        # corruption is observable (deepflow_stats
+                        # `tagrecorder.plural_json_truncated`), per the
+                        # silent-truncation finding (ADVICE.md #1)
+                        self.counters["plural_json_truncated"] += 1
+                        log.warning(
+                            "%s: pod id=%d %s JSON (%d chars) exceeds U%d "
+                            "column; stored value truncated to invalid JSON",
+                            plural, r.id, attr, len(blob), PLURAL_JSON_WIDTH,
+                        )
+                    p_values.append(blob)
             for name, schema in ((singular, _kv_schema(singular)),
                                  (plural, _plural_schema(plural))):
                 self.store.create_table(FLOW_TAG_DB, schema)

@@ -61,6 +61,15 @@ FLOW_RECORD_TAG_FIELDS: tuple[str, ...] = (
     "time_span",
 )
 
+# The raw-tag packing plan (fingerprint hot path) must cover exactly
+# these columns — a field added here without a width entry would be
+# silently dropped from the group-by key, so fail at import instead.
+from .code import RAW_TAG_PACK as _RAW_TAG_PACK  # noqa: E402
+
+assert set(_RAW_TAG_PACK.field_names()) == set(FLOW_RECORD_TAG_FIELDS), (
+    "RAW_TAG_WIDTHS (datamodel/code.py) out of sync with FLOW_RECORD_TAG_FIELDS"
+)
+
 
 @dataclasses.dataclass
 class FlowBatch:

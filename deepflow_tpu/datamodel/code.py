@@ -11,7 +11,9 @@ integer columns, not struct fields.
 
 from __future__ import annotations
 
+import dataclasses
 import enum
+from typing import Mapping
 
 
 class Code(enum.IntFlag):
@@ -177,6 +179,158 @@ class MeterId(enum.IntEnum):
     FLOW = 1
     USAGE = 4
     APP = 5
+
+
+# ---------------------------------------------------------------------------
+# Packed tag words — the fingerprint's dense key representation.
+#
+# The group-by fingerprint used to murmur-fold every raw tag column
+# (25-37 u32 lanes × 2 seeds); most of those columns carry far fewer
+# than 32 meaningful bits (flags, enums, ports, i16 EPC ids). These
+# helpers bin-pack the narrow columns into full u32 words once, so the
+# fold runs over ~22 words instead of ~37 (PERF.md §9d). Packing is
+# injective for in-range values: each field gets a disjoint bit span.
+# Values wider than their declared span would alias, so the excess bits
+# (value >> width) are rotated per-field and XOR-folded into one extra
+# word — in-range inputs leave it all-zero, out-of-range inputs still
+# perturb the hash instead of silently colliding.
+#
+# Widths are CONTRACTS: the decoders (ingest/codec.py, agent/packet.py)
+# and the fanout stage produce values within them. Widening a field is
+# a one-line change here; the excess word keeps even a violated
+# contract collision-safe (astronomically unlikely structured collision
+# instead of a guaranteed one).
+
+# FlowBatch.FLOW_RECORD_TAG_FIELDS → bit width (pre-fanout raw records).
+RAW_TAG_WIDTHS: dict[str, int] = {
+    "timestamp": 32,
+    "global_thread_id": 16,
+    "agent_id": 16,
+    "signal_source": 8,
+    "is_ipv6": 1,
+    "ip0_w0": 32, "ip0_w1": 32, "ip0_w2": 32, "ip0_w3": 32,
+    "ip1_w0": 32, "ip1_w1": 32, "ip1_w2": 32, "ip1_w3": 32,
+    "mac0_hi": 16, "mac0_lo": 32,
+    "mac1_hi": 16, "mac1_lo": 32,
+    "l3_epc_id": 16, "l3_epc_id1": 16,  # i16 sign-folded to u16
+    "gpid0": 32, "gpid1": 32,
+    "pod_id": 32,
+    "protocol": 8,
+    "server_port": 16,
+    "tap_port": 32,
+    "tap_type": 8,
+    "l7_protocol": 8,
+    "direction0": 8, "direction1": 8,  # Direction bit patterns ≤ 0x3f
+    "is_active_host0": 1, "is_active_host1": 1,
+    "is_vip0": 1, "is_vip1": 1,
+    "is_active_service": 1,
+    "endpoint_hash": 32,
+    "biz_type": 8,
+    "time_span": 32,
+}
+
+# TAG_SCHEMA key columns (post-fanout doc rows) → bit width.
+DOC_KEY_WIDTHS: dict[str, int] = {
+    "code_id": 4,  # dense CodeId ≤ 9
+    "meter_id": 4,  # MeterId ≤ 5
+    "global_thread_id": 16,
+    "agent_id": 16,
+    "is_ipv6": 1,
+    "ip0_w0": 32, "ip0_w1": 32, "ip0_w2": 32, "ip0_w3": 32,
+    "ip1_w0": 32, "ip1_w1": 32, "ip1_w2": 32, "ip1_w3": 32,
+    "l3_epc_id": 16, "l3_epc_id1": 16,
+    "mac0_hi": 16, "mac0_lo": 32,
+    "mac1_hi": 16, "mac1_lo": 32,
+    "direction": 8,
+    "protocol": 8,
+    "acl_gid": 16,
+    "server_port": 16,
+    "tap_port": 32,
+    "tap_type": 8,
+    "l7_protocol": 8,
+    "gpid0": 32, "gpid1": 32,
+    "endpoint_hash": 32,
+    "time_span": 32,
+    "biz_type": 8,
+    "signal_source": 8,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class TagPackPlan:
+    """Static packing layout: `wide` columns pass through verbatim;
+    each `packed` word is a tuple of (field, shift, width) spans."""
+
+    wide: tuple[str, ...]
+    packed: tuple[tuple[tuple[str, int, int], ...], ...]
+
+    @property
+    def num_words(self) -> int:
+        # +1 for the excess word (present whenever anything is packed)
+        return len(self.wide) + len(self.packed) + (1 if self.packed else 0)
+
+    def field_names(self) -> tuple[str, ...]:
+        return self.wide + tuple(f for w in self.packed for f, _, _ in w)
+
+
+def plan_tag_pack(widths: Mapping[str, int]) -> TagPackPlan:
+    """First-fit-decreasing bin packing of the sub-32-bit columns into
+    u32 words. Deterministic for a given widths table (sorted by
+    descending width then name), so device and host packers agree."""
+    wide = tuple(sorted(f for f, w in widths.items() if w >= 32))
+    narrow = sorted(
+        ((w, f) for f, w in widths.items() if w < 32), key=lambda t: (-t[0], t[1])
+    )
+    bins: list[list[tuple[str, int, int]]] = []
+    fill: list[int] = []
+    for w, f in narrow:
+        for i, used in enumerate(fill):
+            if used + w <= 32:
+                bins[i].append((f, used, w))
+                fill[i] += w
+                break
+        else:
+            bins.append([(f, 0, w)])
+            fill.append(w)
+    return TagPackPlan(wide=wide, packed=tuple(tuple(b) for b in bins))
+
+
+RAW_TAG_PACK = plan_tag_pack(RAW_TAG_WIDTHS)
+DOC_KEY_PACK = plan_tag_pack(DOC_KEY_WIDTHS)
+
+
+def pack_tag_words(cols: Mapping, plan: TagPackPlan, xp):
+    """Build the packed u32 word list from named [N] u32 columns.
+
+    `cols` maps field name → array; `xp` is the array namespace (jnp on
+    device, np in the oracle) — both implement wrapping u32 arithmetic.
+    Returns wide words + packed words + the excess word (see module
+    note). Safe under jit: the plan is static, so this unrolls to pure
+    vector ops.
+    """
+    words = [xp.asarray(cols[f], dtype=xp.uint32) for f in plan.wide]
+    excess = None
+    rot = 1
+    for spans in plan.packed:
+        word = None
+        for f, shift, width in spans:
+            c = xp.asarray(cols[f], dtype=xp.uint32)
+            part = c & xp.uint32((1 << width) - 1)
+            if shift:
+                part = part << xp.uint32(shift)
+            word = part if word is None else (word | part)
+            e = c >> xp.uint32(width)
+            e = (e << xp.uint32(rot)) | (e >> xp.uint32(32 - rot))
+            excess = e if excess is None else (excess ^ e)
+            # period-31 walk (gcd(7,31)=1) keeps every field's rotation
+            # distinct for plans up to 31 narrow fields — a shared
+            # rotation would let two out-of-contract tuples cancel in
+            # the XOR and collide deterministically
+            rot = (rot + 7) % 31 + 1
+        words.append(word)
+    if excess is not None:
+        words.append(excess)
+    return words
 
 
 class L7Protocol(enum.IntEnum):

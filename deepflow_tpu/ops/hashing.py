@@ -81,3 +81,14 @@ def fingerprint64_t(tags_t, xp=jnp):
     tags_t = xp.asarray(tags_t, dtype=xp.uint32)
     cols = [tags_t[j] for j in range(tags_t.shape[0])]
     return _fold(cols, SEED_HI, xp), _fold(cols, SEED_LO, xp)
+
+
+def fingerprint64_words(words, xp=jnp):
+    """Fold a pre-packed word list (datamodel.code.pack_tag_words) into
+    the (hi, lo) pair. The packed representation covers the same key
+    bits in ~40% fewer fold rounds than the raw column fold — the hot
+    paths build the words ONCE and feed both seeds (PERF.md §9d).
+    Hash VALUES differ from fingerprint64 on the raw columns; only
+    within-path consistency matters (every producer of a given key
+    space goes through the same packing plan)."""
+    return _fold(words, SEED_HI, xp), _fold(words, SEED_LO, xp)

@@ -9,11 +9,18 @@ with a fully static-shape XLA pattern:
       → segment_sum / segment_max with sorted ids, num_segments = cap
       → representative-row gathers only at the ≤cap segment heads
 
-Layout at the interface is column-major ([T, N] / [M, N] with the row
+Layout at the interface: tags stay column-major ([T, N] with the row
 axis minor — it maps rows onto the 128-wide vector lanes and keeps
-column selection free); the meter payload is transposed to row-major
-internally because one row-gather of [N, M] moves M contiguous elements
-per index, which measures ~17x better than M strided lane-gathers.
+column selection free); the METER payload is row-major [N, M] since r6,
+because the reduce consumes rows — one row-gather of [N, M] moves M
+contiguous elements per index (~17x better than M strided
+lane-gathers), and the fused Pallas path (segreduce_pallas.py) streams
+rows through the sort permutation by per-row DMA, which needs the
+original array row-contiguous. The batch pre-reduce hot path produces
+[N, M] natively (FlowBatch.meters), so no transpose is ever
+materialized at 2M rows; the stash fold transposes its column-major
+state at the call site, where XLA folds it into the downstream
+gather/copy.
 
 Kernel selection is measurement-driven (PERF.md, round 4, v5e):
   * round-3 segmented `associative_scan`: 5.4-35 ms at 32k rows and
@@ -58,6 +65,14 @@ def _use_pallas_reduce() -> bool:
         return False
     return jax.default_backend() not in ("cpu",)
 
+
+def _use_fused_gather() -> bool:
+    """On the pallas path, gather meter rows INSIDE the kernel via
+    permutation-indexed DMA (PERF.md §9d) instead of a standalone
+    `take` pass. DEEPFLOW_FUSED_GATHER=0 re-enables the pre-gather
+    variant for on-chip A/B runs."""
+    return os.environ.get("DEEPFLOW_FUSED_GATHER", "1") != "0"
+
 _U32_MAX = np.uint32(0xFFFFFFFF)
 
 
@@ -83,7 +98,7 @@ def groupby_reduce(
     key_hi,
     key_lo,
     tags_t,
-    meters_t,
+    meters_rows,
     valid,
     sum_cols: np.ndarray,
     max_cols: np.ndarray,
@@ -93,7 +108,9 @@ def groupby_reduce(
 
     Args:
       slot/key_hi/key_lo: [N] u32. Invalid rows are re-keyed to sentinel.
-      tags_t: [T, N] u32; meters_t: [M, N] f32; valid: [N] bool.
+      tags_t: [T, N] u32; meters_rows: [N, M] f32 ROW-major (one meter
+        row per record — see the module docstring on layout); valid:
+        [N] bool.
       sum_cols / max_cols: static np arrays of meter row indices, a
         partition of range(M) (from MeterSchema.sum_mask/max_mask).
       out_capacity: static output size; segments beyond it (in ascending
@@ -101,7 +118,7 @@ def groupby_reduce(
         in num_segments so callers can account overflow. Defaults to N.
     """
     n = slot.shape[0]
-    m = meters_t.shape[0]
+    m = meters_rows.shape[1]
     cap = int(out_capacity) if out_capacity is not None else n
     sum_cols = np.asarray(sum_cols, np.int32)
     max_cols = np.asarray(max_cols, np.int32)
@@ -131,9 +148,6 @@ def groupby_reduce(
     # indices_are_sorted hint below to be honest.
     seg_id = jnp.where(live_row, seg_id, n)
 
-    # One row-gather moves all M meter lanes of a row at once.
-    meters_rows = jnp.take(meters_t.T, perm, axis=0)  # [N, M]
-
     # First sorted position of each kept segment: seg_id is ascending by
     # construction, so first occurrence = binary search. A segment_min
     # here measured ~24 ms at 2M rows (r5 bisect, stage G−F) because
@@ -150,7 +164,16 @@ def groupby_reduce(
     if m and _use_pallas_reduce():
         from .segreduce_pallas import sorted_segment_sum_max
 
-        ps, pm = sorted_segment_sum_max(meters_rows, seg_id, cap, first_pos)
+        if _use_fused_gather():
+            # the kernel reads rows THROUGH the sort permutation — no
+            # standalone gather pass ever materializes the sorted payload
+            ps, pm = sorted_segment_sum_max(
+                meters_rows, seg_id, cap, first_pos, perm=perm
+            )
+        else:
+            ps, pm = sorted_segment_sum_max(
+                jnp.take(meters_rows, perm, axis=0), seg_id, cap, first_pos
+            )
         if not max_cols.size:
             out_meters = ps.T
         elif not sum_cols.size:
@@ -160,19 +183,21 @@ def groupby_reduce(
             is_sum[sum_cols] = True
             out_meters = jnp.where(jnp.asarray(is_sum)[None, :], ps, pm).T
     elif m:
+        # One row-gather moves all M meter lanes of a row at once.
+        sorted_rows = jnp.take(meters_rows, perm, axis=0)  # [N, M]
         # (segment_max yields -inf for empty segments; the seg_valid mask
         # below zeroes those columns, so no isfinite rewrite — it would
         # also mask NaNs from genuinely corrupt meters.)
         ps = (
             jax.ops.segment_sum(
-                meters_rows, seg_id, num_segments=cap, indices_are_sorted=True
+                sorted_rows, seg_id, num_segments=cap, indices_are_sorted=True
             )
             if sum_cols.size
             else None
         )
         pm = (
             jax.ops.segment_max(
-                meters_rows, seg_id, num_segments=cap, indices_are_sorted=True
+                sorted_rows, seg_id, num_segments=cap, indices_are_sorted=True
             )
             if max_cols.size
             else None
@@ -186,7 +211,7 @@ def groupby_reduce(
             is_sum[sum_cols] = True
             out_meters = jnp.where(jnp.asarray(is_sum)[None, :], ps, pm).T  # [M, cap]
     else:
-        out_meters = jnp.zeros((0, cap), meters_t.dtype)
+        out_meters = jnp.zeros((0, cap), meters_rows.dtype)
 
     k = jnp.arange(cap, dtype=jnp.int32)
     seg_valid = k < jnp.minimum(num_seg, cap)

@@ -26,7 +26,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+try:  # jax ≥ 0.5 exports shard_map at top level
+    from jax import shard_map
+except ImportError:  # pragma: no cover - 0.4.x fallback
+    from jax.experimental.shard_map import shard_map
 
 from ..aggregator.fanout import FANOUT_LANES, FanoutConfig
 from ..aggregator.pipeline import make_ingest_step

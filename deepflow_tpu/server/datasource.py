@@ -279,7 +279,7 @@ class Downsampler:
                 jnp.asarray(hi),
                 jnp.asarray(lo),
                 jnp.asarray(key_mat.T),
-                jnp.asarray(meters_in.T),
+                jnp.asarray(meters_in),
                 jnp.ones(n, bool),
                 np.concatenate([sum_cols, max_cols, [meters.shape[1]]]).astype(np.int32),
                 np.array([], np.int32),
@@ -290,7 +290,7 @@ class Downsampler:
                 jnp.asarray(hi),
                 jnp.asarray(lo),
                 jnp.asarray(key_mat.T),
-                jnp.asarray(meters.T),
+                jnp.asarray(meters),
                 jnp.ones(n, bool),
                 sum_cols,
                 max_cols,

@@ -293,7 +293,12 @@ class EventIngester:
         m = _re.match(r"1 (\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}(?:\.\d+)?)(Z|[+-]\d{2}:\d{2})?\s*", line)
         if m:
             try:
-                iso = m.group(1) + (m.group(2) or "+00:00").replace("Z", "+00:00")
+                iso = m.group(1)
+                if "." in iso:
+                    # py3.10 fromisoformat only takes 3/6-digit fractions
+                    head, frac = iso.split(".")
+                    iso = head + "." + frac[:6].ljust(6, "0")
+                iso += (m.group(2) or "+00:00").replace("Z", "+00:00")
                 dt = _dt.datetime.fromisoformat(iso)
                 return int(dt.timestamp() * 1_000_000), line[m.end():]
             except ValueError:

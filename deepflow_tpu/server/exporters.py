@@ -149,6 +149,11 @@ class RemoteWriteExporter(Exporter):
         urllib.request.urlopen(req, timeout=5).read()
 
 
+# Tables whose rows are spans/logs, not metric documents — they must
+# only ever reach the OTLP traces lane.
+_TRACE_TABLES = ("l7_flow_log",)
+
+
 class OtlpExporter(Exporter):
     """OTLP/HTTP protobuf sink (exporters/otlp_exporter/otlp_exporter.go).
 
@@ -177,9 +182,21 @@ class OtlpExporter(Exporter):
             encode_otlp_traces,
         )
 
-        if table == "l7_flow_log" and self.traces_url:
-            spans = [self._row_to_span(r) for r in rows]
-            self._post(self.traces_url, encode_otlp_traces(spans))
+        if table in _TRACE_TABLES:
+            # trace rows NEVER fall through to the metrics branch: with
+            # metrics_url set but traces_url empty, l7_flow_log rows
+            # used to be exported as bogus deepflow_l7_flow_log_*
+            # metrics (ADVICE.md #4) — now they are skipped AND counted
+            # (deepflow_stats `exporter.trace_rows_skipped`) until a
+            # traces_url is configured, so the drop is observable.
+            if self.traces_url:
+                spans = [self._row_to_span(r) for r in rows]
+                self._post(self.traces_url, encode_otlp_traces(spans))
+            else:
+                with self._lock:
+                    self.counters["trace_rows_skipped"] = (
+                        self.counters.get("trace_rows_skipped", 0) + len(rows)
+                    )
         elif self.metrics_url and self.metrics:
             points: dict[str, list[OtlpMetricPoint]] = {}
             for r in rows:

@@ -464,3 +464,46 @@ def test_xiangdao_retrans_golden_any_batch_split():
     assert len(set(counts.values())) == 1, counts  # split-invariant
     tx, rx = counts[1]
     assert tx + rx == 4, counts  # reference: 2 + the two discarded dups
+
+
+def test_seq_tracker_lru_order_and_eviction():
+    """seq_tracker eviction approximates LRU: entries refresh dict
+    position on every touch (update AND covered-hit), so the
+    oldest-quarter overflow eviction sheds idle flows while long-lived
+    active flows keep their cross-batch retrans history (ADVICE.md #3:
+    insertion-order eviction used to drop exactly the old active
+    flows)."""
+    from deepflow_tpu.agent.flow_map import _seq_list_retrans
+
+    tracker: dict = {}
+
+    def touch(key_id, seq, ln=100):
+        hi = np.array([key_id], np.uint32)
+        lo = np.array([0], np.uint32)
+        d1 = np.array([0], np.uint32)
+        _seq_list_retrans(
+            tracker, hi, lo, d1,
+            np.array([seq], np.uint32), np.array([ln], np.uint32),
+            np.array([True]),
+        )
+
+    touch(1, 1000)  # old flow, stays active below
+    touch(2, 1000)
+    touch(3, 1000)
+    # flow 1 sends NEW data → must move to the dict tail
+    touch(1, 2000)
+    assert list(tracker)[0][0] == 2 and list(tracker)[-1][0] == 1
+    # flow 2 re-sends covered bytes (a retrans HIT) → also refreshes
+    touch(2, 1000)
+    assert list(tracker)[0][0] == 3 and list(tracker)[-1][0] == 2
+
+    # the FlowMap overflow eviction deletes the dict head — with LRU
+    # order that is the idle flow (3), never the just-active ones
+    fm = FlowMap(capacity=1 << 8, batch_size=64)
+    fm.seq_tracker = tracker
+    fm.seq_tracker_cap = 3  # force overflow on next inject
+    pkt = craft_tcp(CLI, SRV, 1234, 80, flags=TCP_ACK | TCP_PSH,
+                    seq=1, payload=b"x" * 10)
+    fm.inject(_parse([pkt]))
+    assert (3, 0, 0) not in fm.seq_tracker  # idle flow evicted
+    assert (1, 0, 0) in fm.seq_tracker and (2, 0, 0) in fm.seq_tracker

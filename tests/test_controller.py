@@ -156,3 +156,42 @@ def test_trisolaris_ntp_and_upgrade(tmp_path):
         assert svc.counters["upgrade_pulls"] == 1
     finally:
         svc.stop()
+
+
+def test_tagrecorder_counts_plural_json_truncation(caplog):
+    """A pod whose label dict JSON exceeds the plural column's U1024
+    seat is counted + logged (surfaced via utils/stats countables)
+    instead of silently leaving clipped, invalid JSON in k8s.labels
+    (ADVICE.md #1)."""
+    import json as _json
+    import logging as _logging
+
+    from deepflow_tpu.controller.tagrecorder import FLOW_TAG_DB
+
+    db = ResourceDB()
+    store = ColumnarStore()
+    rec = TagRecorder(db, store)
+    big = {f"label-key-{i}": "v" * 40 for i in range(40)}  # ≫ 1024 chars JSON
+    small = {"app": "web"}
+    db.put("pod", 1, "huge-labels", labels=big)
+    db.put("pod", 2, "ok-labels", labels=small)
+    with caplog.at_level(_logging.WARNING, "deepflow_tpu.controller.tagrecorder"):
+        assert rec.sync() is True
+    assert rec.get_counters()["plural_json_truncated"] == 1
+    assert any("pod_k8s_labels_map" in r.message for r in caplog.records)
+
+    # the in-range pod's stored JSON stays valid
+    cols = store.scan(FLOW_TAG_DB, "pod_k8s_labels_map", columns=["id", "value"])
+    by_id = dict(zip(cols["id"].tolist(), cols["value"].tolist()))
+    assert _json.loads(by_id[2]) == small
+    # and the clipped one is indeed invalid — that is exactly what the
+    # counter makes observable
+    try:
+        _json.loads(by_id[1])
+        assert False, "expected truncated JSON to be invalid"
+    except _json.JSONDecodeError:
+        pass
+
+    # re-sync without changes does not double-count
+    assert rec.sync() is False
+    assert rec.get_counters()["plural_json_truncated"] == 1

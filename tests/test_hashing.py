@@ -1,7 +1,15 @@
 import jax.numpy as jnp
 import numpy as np
 
-from deepflow_tpu.ops.hashing import fingerprint64, fmix32
+from deepflow_tpu.datamodel.code import (
+    DOC_KEY_PACK,
+    DOC_KEY_WIDTHS,
+    RAW_TAG_PACK,
+    RAW_TAG_WIDTHS,
+    pack_tag_words,
+    plan_tag_pack,
+)
+from deepflow_tpu.ops.hashing import fingerprint64, fingerprint64_words, fmix32
 
 
 def test_fmix32_matches_numpy_and_jax():
@@ -56,3 +64,85 @@ def test_fingerprint_collision_rate_smoke():
     hi, lo = fingerprint64(jnp.asarray(uniq))
     packed = (np.asarray(hi).astype(np.uint64) << np.uint64(32)) | np.asarray(lo).astype(np.uint64)
     assert len(np.unique(packed)) == len(uniq)
+
+
+# ---------------------------------------------------------------------------
+# packed-tag fingerprint (datamodel/code.py plans + fingerprint64_words)
+
+
+def test_pack_plan_disjoint_full_coverage():
+    """Every field of both plans gets a disjoint bit span; packed words
+    never overlap and wide fields pass through."""
+    for plan, widths in ((RAW_TAG_PACK, RAW_TAG_WIDTHS), (DOC_KEY_PACK, DOC_KEY_WIDTHS)):
+        assert set(plan.field_names()) == set(widths)
+        for spans in plan.packed:
+            used = 0
+            for f, shift, width in spans:
+                assert widths[f] == width < 32
+                span = ((1 << width) - 1) << shift
+                assert used & span == 0, f"overlap at {f}"
+                used |= span
+            assert used < 1 << 32
+        # the packed representation is substantially denser than the
+        # raw column list — the whole point of the plan
+        assert plan.num_words <= len(widths) - 8
+
+
+def test_pack_words_injective_in_range():
+    """In-range tag tuples map 1:1 onto packed words (disjoint spans ⇒
+    exact recoverability), so the packed fingerprint keys the same
+    equivalence classes as the raw columns."""
+    rng = np.random.default_rng(2)
+    n = 4096
+    cols = {
+        f: rng.integers(0, 1 << min(w, 31), n).astype(np.uint32)
+        for f, w in RAW_TAG_WIDTHS.items()
+    }
+    words = pack_tag_words(cols, RAW_TAG_PACK, np)
+    assert len(words) == RAW_TAG_PACK.num_words
+    # excess word (last) must be all-zero for in-range values
+    np.testing.assert_array_equal(words[-1], np.zeros(n, np.uint32))
+    raw = np.stack([cols[f] for f in sorted(cols)], axis=1)
+    packed = np.stack(words, axis=1)
+    n_raw = len(np.unique(raw, axis=0))
+    assert len(np.unique(packed, axis=0)) == n_raw
+    # and the packed fingerprint keeps those keys distinct
+    hi, lo = fingerprint64_words([jnp.asarray(w) for w in words])
+    fp = (np.asarray(hi).astype(np.uint64) << np.uint64(32)) | np.asarray(lo).astype(np.uint64)
+    assert len(np.unique(fp)) == n_raw
+
+
+def test_pack_words_out_of_range_still_distinguished():
+    """A value exceeding its declared width must still perturb the
+    packed representation (via the excess word) — a contract violation
+    degrades to a hash, never to a guaranteed collision."""
+    n = 4
+    base = {f: np.zeros(n, np.uint32) for f in RAW_TAG_WIDTHS}
+    hot = {k: v.copy() for k, v in base.items()}
+    hot["protocol"] = np.full(n, 0x1FF, np.uint32)  # 9 bits into an 8-bit seat
+    in_range = {k: v.copy() for k, v in base.items()}
+    in_range["protocol"] = np.full(n, 0xFF, np.uint32)  # same low 8 bits
+    w_hot = np.stack(pack_tag_words(hot, RAW_TAG_PACK, np), axis=1)
+    w_in = np.stack(pack_tag_words(in_range, RAW_TAG_PACK, np), axis=1)
+    assert not np.array_equal(w_hot, w_in)
+    assert w_hot[:, -1].any()  # the excess word carries the overflow
+
+
+def test_pack_words_jnp_np_agree():
+    rng = np.random.default_rng(3)
+    n = 256
+    cols = {f: rng.integers(0, 1 << 31, n).astype(np.uint32) for f in DOC_KEY_WIDTHS}
+    w_np = pack_tag_words(cols, DOC_KEY_PACK, np)
+    w_jnp = pack_tag_words({k: jnp.asarray(v) for k, v in cols.items()}, DOC_KEY_PACK, jnp)
+    for a, b in zip(w_np, w_jnp):
+        np.testing.assert_array_equal(a, np.asarray(b))
+
+
+def test_plan_tag_pack_deterministic_ffd():
+    plan = plan_tag_pack({"a": 16, "b": 16, "c": 8, "d": 8, "e": 1, "w": 32})
+    assert plan.wide == ("w",)
+    assert plan.packed == (
+        (("a", 0, 16), ("b", 16, 16)),
+        (("c", 0, 8), ("d", 8, 8), ("e", 16, 1)),
+    )
+    assert plan.num_words == 4  # w + 2 packed + excess

@@ -189,3 +189,41 @@ def test_export_reingest_loop():
         col.stop()
         ing.stop()
         recv.stop()
+
+
+def test_l7_rows_never_exported_as_metrics():
+    """l7_flow_log rows with metrics_url configured but traces_url
+    UNSET must be skipped, not emitted as bogus
+    deepflow_l7_flow_log_* OTLP metrics (ADVICE.md #4 — the default
+    data_sources include l7_flow_log, so the old fall-through silently
+    polluted the metrics sink)."""
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _CaptureHandler)
+    srv.captured = []
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        exp = OtlpExporter(
+            metrics_url=f"http://127.0.0.1:{srv.server_port}/v1/metrics",
+            metrics=("response_duration",),
+        )
+        cols = {
+            "time": np.array([T0], np.uint32),
+            "response_duration": np.array([1234.0], np.float32),
+            "endpoint": np.array(["/cart"]),
+        }
+        exp.export("l7_flow_log", cols)
+        assert srv.captured == []  # nothing posted for the trace table
+        assert exp.get_counters()["trace_rows_skipped"] == 1  # drop observable
+        # metric tables still flow to the metrics sink
+        exp2 = OtlpExporter(
+            metrics_url=f"http://127.0.0.1:{srv.server_port}/v1/metrics",
+            metrics=("byte_tx",),
+            data_sources=("network",),
+        )
+        exp2.export("network", {
+            "time": np.array([T0], np.uint32),
+            "byte_tx": np.array([1.0], np.float32),
+        })
+        assert len(srv.captured) == 1
+    finally:
+        srv.shutdown()

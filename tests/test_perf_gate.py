@@ -17,6 +17,10 @@ compile blowup or a log-depth-scan kernel — still trips it.
 
 from __future__ import annotations
 
+import json
+import os
+import subprocess
+import sys
 import time
 
 import jax
@@ -118,3 +122,52 @@ def test_prereduce_hot_path_bounds():
         f"pre-reduce steady state {per_batch_ms:.1f} ms/batch "
         f"(bound {STEADY_BOUND_MS} ms) — kernel regression"
     )
+
+
+# ---------------------------------------------------------------------------
+# bench.py wedge-proofing (r5 verdict #1): the official perf driver must
+# never hand the harness a raw traceback or a tunnel-wedging shape.
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_bench(extra_env: dict, timeout: int) -> tuple[int, dict]:
+    env = {**os.environ, **extra_env}
+    proc = subprocess.run(
+        [sys.executable, "bench.py"],
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=timeout,
+    )
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    assert lines, f"bench.py printed nothing (stderr: {proc.stderr[-500:]})"
+    return proc.returncode, json.loads(lines[-1])
+
+
+def test_bench_refuses_unsafe_batch_shape():
+    """A >2M BENCH_BATCH has twice wedged the accelerator tunnel
+    (PERF.md §5/§9c); bench.py must refuse it BEFORE touching any
+    backend, emit a parseable record, and point at the override."""
+    rc, rec = _run_bench({"BENCH_BATCH": str(1 << 22)}, timeout=60)
+    assert rc == 2
+    assert rec["metric"] == "flow_records_per_sec_per_chip"
+    assert rec["value"] == 0.0
+    assert rec.get("partial") is True
+    assert "BENCH_FORCE" in rec["error"]
+
+
+def test_bench_emits_partial_record_on_backend_failure():
+    """When the backend cannot initialize (the r5 wedge signature:
+    'Unable to initialize backend'), bench.py exits 0 with a partial —
+    but parseable — record instead of rc=1 and a raw traceback."""
+    rc, rec = _run_bench(
+        {
+            "JAX_PLATFORMS": "nonexistent",
+            "BENCH_BATCH": "4096",
+            "BENCH_UNIQUE_CAP": "1024",
+            "BENCH_CYCLES": "1",
+        },
+        timeout=300,
+    )
+    assert rc == 0
+    assert rec["metric"] == "flow_records_per_sec_per_chip"
+    assert rec.get("partial") is True
+    assert rec["error"]

@@ -47,7 +47,7 @@ def _run_and_compare(n, t, m, n_keys, seed, valid_frac=1.0):
         jnp.asarray(hi),
         jnp.asarray(lo),
         jnp.asarray(tags.T),
-        jnp.asarray(meters.T),
+        jnp.asarray(meters),  # row-major [N, M] since r6
         jnp.asarray(valid),
     )
 
@@ -96,7 +96,7 @@ def test_groupby_all_invalid():
         jnp.zeros(n, jnp.uint32),
         jnp.zeros(n, jnp.uint32),
         jnp.zeros((t, n), jnp.uint32),
-        jnp.ones((m, n), jnp.float32),
+        jnp.ones((n, m), jnp.float32),
         jnp.zeros(n, bool),
         sum_cols=np.arange(m, dtype=np.int32),
         max_cols=np.array([], dtype=np.int32),
@@ -114,7 +114,7 @@ def test_groupby_single_key_all_rows():
         jnp.full((n,), 11, jnp.uint32),
         jnp.full((n,), 13, jnp.uint32),
         jnp.asarray(tags.T),
-        jnp.ones((m, n), jnp.float32),
+        jnp.ones((n, m), jnp.float32),
         jnp.ones(n, bool),
         sum_cols=np.array([0, 1], dtype=np.int32),
         max_cols=np.array([2, 3], dtype=np.int32),

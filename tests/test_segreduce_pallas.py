@@ -1,6 +1,9 @@
 """Pallas suffix-scan segmented reduce vs the XLA segment ops — the two
 paths of ops/segment.py must agree exactly on integer-valued meters and
-to 1 ulp on arbitrary floats (tree-order association)."""
+to 1 ulp on arbitrary floats (tree-order association). Since r6 the
+pallas path also gathers rows through the sort permutation INSIDE the
+kernel (fused gather, permutation-indexed DMA); fused and pre-gathered
+variants are pinned bit-equal here on both backend selections."""
 
 from __future__ import annotations
 
@@ -9,10 +12,10 @@ import pytest
 
 import jax.numpy as jnp
 
-from deepflow_tpu.ops.segreduce_pallas import sorted_segment_sum_max
+from deepflow_tpu.ops.segreduce_pallas import LANES, sorted_segment_sum_max
 
 
-def _case(n, cap, n_keys, m=7, seed=0, integral=True, block=256):
+def _case(n, cap, n_keys, m=7, seed=0, integral=True, block=256, fused=False):
     rng = np.random.default_rng(seed)
     seg = np.sort(rng.integers(0, n_keys, n)).astype(np.int32)
     n_live = n - n // 8  # tail of dead rows, ids past every live one
@@ -23,10 +26,21 @@ def _case(n, cap, n_keys, m=7, seed=0, integral=True, block=256):
         rows = rng.standard_normal((n, m)).astype(np.float32) * 1e3
     first_pos = np.searchsorted(seg, np.arange(cap)).astype(np.int32)
 
-    got_s, got_m = sorted_segment_sum_max(
-        jnp.asarray(rows), jnp.asarray(seg), cap, jnp.asarray(first_pos),
-        block=block,
-    )
+    if fused:
+        # hand the kernel the ORIGINAL (pre-sort) array + the sort
+        # permutation: rows == rows_orig[perm]
+        perm = rng.permutation(n).astype(np.int32)
+        rows_orig = np.empty_like(rows)
+        rows_orig[perm] = rows
+        got_s, got_m = sorted_segment_sum_max(
+            jnp.asarray(rows_orig), jnp.asarray(seg), cap,
+            jnp.asarray(first_pos), perm=jnp.asarray(perm), block=block,
+        )
+    else:
+        got_s, got_m = sorted_segment_sum_max(
+            jnp.asarray(rows), jnp.asarray(seg), cap, jnp.asarray(first_pos),
+            block=block,
+        )
     import jax
 
     want_s = jax.ops.segment_sum(jnp.asarray(rows), jnp.asarray(seg),
@@ -39,51 +53,92 @@ def _case(n, cap, n_keys, m=7, seed=0, integral=True, block=256):
             np.asarray(want_s)[live], np.asarray(want_m)[live])
 
 
-@pytest.mark.parametrize("n,cap,n_keys,block", [
+CASES = [
     (1024, 256, 100, 256),     # multi-block, segments span blocks
     (1024, 256, 3, 128),       # few huge segments (span many blocks)
     (777, 64, 40, 256),        # non-multiple-of-block row count
     (2048, 2048, 1500, 512),   # cap == n-scale, many singletons
     (512, 32, 1, 128),         # one segment spanning everything
-])
-def test_matches_xla_integral(n, cap, n_keys, block):
-    gs, gm, ws, wm = _case(n, cap, n_keys, block=block)
+]
+
+
+@pytest.mark.parametrize("n,cap,n_keys,block", CASES)
+@pytest.mark.parametrize("fused", [False, True], ids=["pregather", "fused"])
+def test_matches_xla_integral(n, cap, n_keys, block, fused):
+    gs, gm, ws, wm = _case(n, cap, n_keys, block=block, fused=fused)
     np.testing.assert_array_equal(gs, ws)
     np.testing.assert_array_equal(gm, wm)
 
 
-def test_matches_xla_float_tolerance():
-    gs, gm, ws, wm = _case(1024, 256, 50, integral=False, seed=3)
+@pytest.mark.parametrize("fused", [False, True], ids=["pregather", "fused"])
+def test_matches_xla_float_tolerance(fused):
+    gs, gm, ws, wm = _case(1024, 256, 50, integral=False, seed=3, fused=fused)
     np.testing.assert_allclose(gs, ws, rtol=1e-5)
     np.testing.assert_array_equal(gm, wm)  # max is order-free → exact
 
 
-def test_groupby_reduce_pallas_path_matches(monkeypatch):
-    """Force the pallas path through the full groupby_reduce and pin it
-    against the XLA path on the same inputs."""
-    monkeypatch.setenv("DEEPFLOW_SEGREDUCE", "pallas")
-    from deepflow_tpu.ops.segment import groupby_reduce
+def test_fused_matches_pregather_bitexact_floats():
+    """Fused gather reorders only the DMA, not the reduction tree —
+    arbitrary floats must agree BIT-exactly between the two pallas
+    variants (tolerance is only vs the XLA linear-order sum)."""
+    a = _case(1024, 256, 50, integral=False, seed=9, fused=False)
+    b = _case(1024, 256, 50, integral=False, seed=9, fused=True)
+    np.testing.assert_array_equal(a[0], b[0])
+    np.testing.assert_array_equal(a[1], b[1])
 
-    rng = np.random.default_rng(7)
-    n, t, m = 512, 5, 6
+
+def test_full_lane_width():
+    """m == LANES leaves no garbage lanes; the fused DMA copies whole
+    rows."""
+    gs, gm, ws, wm = _case(512, 64, 20, m=LANES, block=128, fused=True)
+    np.testing.assert_array_equal(gs, ws)
+    np.testing.assert_array_equal(gm, wm)
+
+
+def test_meter_width_guard():
+    """A meter schema wider than the kernel's lane tile must fail
+    loudly (ADVICE.md #2), not mis-shape the hot-path reduce."""
+    with pytest.raises(ValueError, match="lane"):
+        sorted_segment_sum_max(
+            jnp.zeros((16, LANES + 1), jnp.float32),
+            jnp.zeros((16,), jnp.int32),
+            4,
+            jnp.zeros((4,), jnp.int32),
+        )
+
+
+def _groupby_inputs(seed=7, n=512, t=5, m=6):
+    rng = np.random.default_rng(seed)
     slot = rng.integers(0, 3, n).astype(np.uint32)
     hi = rng.integers(0, 50, n).astype(np.uint32)
     lo = rng.integers(0, 2, n).astype(np.uint32)
     tags = rng.integers(0, 100, (t, n)).astype(np.uint32)
-    meters = rng.integers(0, 500, (m, n)).astype(np.float32)
+    meters = rng.integers(0, 500, (n, m)).astype(np.float32)
     valid = rng.random(n) < 0.9
     sum_cols = np.array([0, 1, 2, 3], np.int32)
     max_cols = np.array([4, 5], np.int32)
+    return slot, hi, lo, tags, meters, valid, sum_cols, max_cols
 
-    g1 = groupby_reduce(jnp.asarray(slot), jnp.asarray(hi), jnp.asarray(lo),
-                        jnp.asarray(tags), jnp.asarray(meters),
-                        jnp.asarray(valid), sum_cols, max_cols,
-                        out_capacity=128)
-    monkeypatch.setenv("DEEPFLOW_SEGREDUCE", "xla")
-    g2 = groupby_reduce(jnp.asarray(slot), jnp.asarray(hi), jnp.asarray(lo),
-                        jnp.asarray(tags), jnp.asarray(meters),
-                        jnp.asarray(valid), sum_cols, max_cols,
-                        out_capacity=128)
+
+def _run_groupby(monkeypatch, segreduce: str, fused: str):
+    monkeypatch.setenv("DEEPFLOW_SEGREDUCE", segreduce)
+    monkeypatch.setenv("DEEPFLOW_FUSED_GATHER", fused)
+    from deepflow_tpu.ops.segment import groupby_reduce
+
+    slot, hi, lo, tags, meters, valid, sum_cols, max_cols = _groupby_inputs()
+    return groupby_reduce(jnp.asarray(slot), jnp.asarray(hi), jnp.asarray(lo),
+                          jnp.asarray(tags), jnp.asarray(meters),
+                          jnp.asarray(valid), sum_cols, max_cols,
+                          out_capacity=128)
+
+
+@pytest.mark.parametrize("fused", ["0", "1"], ids=["pregather", "fused"])
+def test_groupby_reduce_pallas_path_matches(monkeypatch, fused):
+    """Force the pallas path (both gather variants) through the full
+    groupby_reduce and pin it against the XLA path on the same
+    inputs."""
+    g1 = _run_groupby(monkeypatch, "pallas", fused)
+    g2 = _run_groupby(monkeypatch, "xla", fused)
     np.testing.assert_array_equal(np.asarray(g1.meters), np.asarray(g2.meters))
     np.testing.assert_array_equal(np.asarray(g1.slot), np.asarray(g2.slot))
     np.testing.assert_array_equal(np.asarray(g1.seg_valid), np.asarray(g2.seg_valid))
