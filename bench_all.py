@@ -338,7 +338,10 @@ def config5(quick: bool):
                 env={**__import__("os").environ, "MESH_PER_DEV": str(1 << 13),
                      "MESH_ITERS": "8"},
             )
-            scaling = json.loads(out.stdout.strip().splitlines()[-1])["rows"]
+            rec = json.loads(out.stdout.strip().splitlines()[-1])
+            scaling = rec["rows"]
+            if rec.get("partial"):  # mesh_scaling's partial-JSON convention
+                scaling = scaling + [{"error": rec.get("error", "partial run")}]
         except Exception as e:
             scaling = [{"error": repr(e)}]
     emit("c5_pod_1m_rollup_mesh", rate, "records/s", rate / NORTH_STAR,

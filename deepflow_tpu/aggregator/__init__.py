@@ -1,4 +1,11 @@
-from .stash import StashState, stash_init, stash_merge, stash_flush
+from .stash import (
+    StashState,
+    stash_flush,
+    stash_flush_range,
+    stash_init,
+    stash_merge,
+    unpack_flush_rows,
+)
 from .window import WindowConfig, WindowManager
 
 __all__ = [
@@ -6,6 +13,8 @@ __all__ = [
     "stash_init",
     "stash_merge",
     "stash_flush",
+    "stash_flush_range",
+    "unpack_flush_rows",
     "WindowConfig",
     "WindowManager",
 ]

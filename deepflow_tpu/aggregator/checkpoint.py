@@ -7,47 +7,90 @@ the state a preempted chip loses. These helpers serialize a
 WindowManager to one .npz so an evicted worker resumes mid-window
 instead of dropping every open window's partial aggregates.
 
-Format: the StashState/AccumState arrays (device → host), the host
-counters, and a version tag. Resume rebuilds device arrays lazily on
-first use (jnp.asarray on merge).
+Format v2: ONE packed u32 matrix per direction — the stash leaves
+(slot/keys/valid/tags/bit-cast meters) concatenate on device into a
+single [4+T+M, S] array fetched in one transfer, and restore uploads one
+matrix and splits it back in a single jitted call. v1 paid the PERF.md
+§8 per-leaf transfer tax: 7 stash + 5 accumulator round trips per
+save/restore. v1 checkpoints still load FORMAT-wise — but note v1 files
+predate the r6 packed-word key fingerprint, so their stash keys will
+not merge with freshly-hashed rows for the same logical key (the same
+caveat any pre-r6 in-flight state has); treat a resumed v1 stash as
+flush-only.
 """
 
 from __future__ import annotations
 
 import io
 import json
+from functools import partial
 from pathlib import Path
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..datamodel.schema import MeterSchema, TagSchema
-from .stash import AccumState, StashState
+from .stash import AccumState, StashState, pack_u32_columns
 from .window import WindowConfig, WindowManager
 
-_VERSION = 1
+_VERSION = 2
 
 
-def save_window_state(wm: WindowManager, path: str | Path) -> None:
-    arrays = {
-        "stash_slot": np.asarray(wm.state.slot),
-        "stash_key_hi": np.asarray(wm.state.key_hi),
-        "stash_key_lo": np.asarray(wm.state.key_lo),
-        "stash_tags": np.asarray(wm.state.tags),
-        "stash_meters": np.asarray(wm.state.meters),
-        "stash_valid": np.asarray(wm.state.valid),
-        "stash_dropped": np.asarray(wm.state.dropped_overflow),
-    }
+@jax.jit
+def _pack_stash(state: StashState) -> jnp.ndarray:
+    """[4+T+M, S] u32: slot, key_hi, key_lo, valid, tags…, meters…"""
+    return pack_u32_columns(
+        state.slot, state.key_hi, state.key_lo, state.tags, state.meters,
+        valid=state.valid,
+    )
+
+
+@partial(jax.jit, static_argnames=("num_tags",))
+def _unpack_stash(mat, dropped, *, num_tags: int) -> StashState:
+    return StashState(
+        slot=mat[0],
+        key_hi=mat[1],
+        key_lo=mat[2],
+        valid=mat[3].astype(bool),
+        tags=mat[4 : 4 + num_tags],
+        meters=jax.lax.bitcast_convert_type(mat[4 + num_tags :], jnp.float32),
+        dropped_overflow=jnp.asarray(dropped, dtype=jnp.int32),
+    )
+
+
+@jax.jit
+def _pack_acc(acc: AccumState) -> jnp.ndarray:
+    """[3+T+M, A] u32: slot, key_hi, key_lo, tags…, meters…"""
+    return pack_u32_columns(acc.slot, acc.key_hi, acc.key_lo, acc.tags, acc.meters)
+
+
+@partial(jax.jit, static_argnames=("num_tags",))
+def _unpack_acc(mat, *, num_tags: int) -> AccumState:
+    return AccumState(
+        slot=mat[0],
+        key_hi=mat[1],
+        key_lo=mat[2],
+        tags=mat[3 : 3 + num_tags],
+        meters=jax.lax.bitcast_convert_type(mat[3 + num_tags :], jnp.float32),
+    )
+
+
+def save_window_state(wm: WindowManager, path: str | Path):
+    """Snapshot `wm` to one .npz. Returns the FlushedWindows that were
+    still in flight in async_drain mode (deferred stats / dispatched
+    flushes) — their rows have already left the stash, so the CALLER
+    must emit them before treating the checkpoint as the resume point;
+    an unsettled snapshot would silently lose those windows' documents.
+    Empty list in sync mode."""
+    in_flight = wm.settle()
+    arrays = {"stash_packed": np.asarray(_pack_stash(wm.state))}
     if wm.acc is not None:
-        arrays.update(
-            acc_slot=np.asarray(wm.acc.slot),
-            acc_key_hi=np.asarray(wm.acc.key_hi),
-            acc_key_lo=np.asarray(wm.acc.key_lo),
-            acc_tags=np.asarray(wm.acc.tags),
-            acc_meters=np.asarray(wm.acc.meters),
-        )
+        arrays["acc_packed"] = np.asarray(_pack_acc(wm.acc))
     meta = {
         "version": _VERSION,
+        "num_tags": wm.tag_schema.num_fields,
+        "dropped_overflow": int(np.asarray(wm.state.dropped_overflow)),
         "fill": wm.fill,
         "start_window": wm.start_window,
         "drop_before_window": wm.drop_before_window,
@@ -57,11 +100,13 @@ def save_window_state(wm: WindowManager, path: str | Path) -> None:
         "delay": wm.config.delay,
         "capacity": wm.config.capacity,
         "accum_batches": wm.config.accum_batches,
+        "async_drain": wm.config.async_drain,
     }
     buf = io.BytesIO()
     np.savez_compressed(buf, meta=np.frombuffer(json.dumps(meta).encode(), np.uint8),
                         **arrays)
     Path(path).write_bytes(buf.getvalue())
+    return in_flight
 
 
 def load_window_state(
@@ -69,32 +114,52 @@ def load_window_state(
 ) -> WindowManager:
     with np.load(io.BytesIO(Path(path).read_bytes())) as z:
         meta = json.loads(bytes(z["meta"]).decode())
-        if meta["version"] != _VERSION:
+        if meta["version"] not in (1, _VERSION):
             raise ValueError(f"checkpoint version {meta['version']} != {_VERSION}")
         cfg = WindowConfig(
             interval=meta["interval"],
             delay=meta["delay"],
             capacity=meta["capacity"],
             accum_batches=meta["accum_batches"],
+            async_drain=meta.get("async_drain", False),
         )
         wm = WindowManager(cfg, tag_schema, meter_schema)
-        wm.state = StashState(
-            slot=jnp.asarray(z["stash_slot"]),
-            key_hi=jnp.asarray(z["stash_key_hi"]),
-            key_lo=jnp.asarray(z["stash_key_lo"]),
-            tags=jnp.asarray(z["stash_tags"]),
-            meters=jnp.asarray(z["stash_meters"]),
-            valid=jnp.asarray(z["stash_valid"]),
-            dropped_overflow=jnp.asarray(z["stash_dropped"]),
-        )
-        if "acc_slot" in z:
-            wm.acc = AccumState(
-                slot=jnp.asarray(z["acc_slot"]),
-                key_hi=jnp.asarray(z["acc_key_hi"]),
-                key_lo=jnp.asarray(z["acc_key_lo"]),
-                tags=jnp.asarray(z["acc_tags"]),
-                meters=jnp.asarray(z["acc_meters"]),
+        t = tag_schema.num_fields
+        if meta["version"] == _VERSION and meta["num_tags"] != t:
+            # the packed split is shape-valid for ANY num_tags — a
+            # mismatch would bit-cast misaligned words into meters
+            # silently, so schema drift must fail loudly
+            raise ValueError(
+                f"checkpoint tag schema width {meta['num_tags']} != "
+                f"{t} ({tag_schema.__class__.__name__}); cannot unpack"
             )
+        if meta["version"] == 1:
+            wm.state = StashState(
+                slot=jnp.asarray(z["stash_slot"]),
+                key_hi=jnp.asarray(z["stash_key_hi"]),
+                key_lo=jnp.asarray(z["stash_key_lo"]),
+                tags=jnp.asarray(z["stash_tags"]),
+                meters=jnp.asarray(z["stash_meters"]),
+                valid=jnp.asarray(z["stash_valid"]),
+                dropped_overflow=jnp.asarray(z["stash_dropped"]),
+            )
+            if "acc_slot" in z:
+                wm.acc = AccumState(
+                    slot=jnp.asarray(z["acc_slot"]),
+                    key_hi=jnp.asarray(z["acc_key_hi"]),
+                    key_lo=jnp.asarray(z["acc_key_lo"]),
+                    tags=jnp.asarray(z["acc_tags"]),
+                    meters=jnp.asarray(z["acc_meters"]),
+                )
+        else:
+            # one upload + one jitted split per direction
+            wm.state = _unpack_stash(
+                jnp.asarray(z["stash_packed"]),
+                np.int32(meta["dropped_overflow"]),
+                num_tags=t,
+            )
+            if "acc_packed" in z:
+                wm.acc = _unpack_acc(jnp.asarray(z["acc_packed"]), num_tags=t)
         wm.fill = meta["fill"]
         wm.start_window = meta["start_window"]
         wm.drop_before_window = meta["drop_before_window"]

@@ -7,12 +7,21 @@ replacement for the reference chains QuadrupleGenerator::inject_flow →
 Collector::collect_l4 → Stash::add → flush_stats and
 L7QuadrupleGenerator → L7Collector::collect_l7 (SURVEY §3.1), collapsed
 into one jit step per batch plus a host-driven window controller.
+
+Since r7 the whole per-batch slice — optional pre-reduce, fanout,
+fingerprint, late-arrival gate, window bookkeeping, ring append — runs
+as ONE jitted call per batch (`RollupPipeline._build_step`): the ~37 tag
+columns upload as a single packed [T, N] matrix (every pytree leaf is a
+separate transfer through the tunnel, PERF.md §8) and the only per-batch
+download is the 5-scalar stats vector the window controller reads
+(window.py module docstring has the full sync budget).
 """
 
 from __future__ import annotations
 
 import dataclasses
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -20,8 +29,9 @@ from ..datamodel.batch import DocBatch, FlowBatch
 from ..datamodel.code import DOC_KEY_PACK, RAW_TAG_PACK, DocumentFlag, pack_tag_words
 from ..datamodel.schema import APP_METER, FLOW_METER, TAG_SCHEMA, MeterSchema
 from ..ops.hashing import fingerprint64_words
-from .fanout import FanoutConfig, fanout_l4, fanout_l7
-from .window import FlushedWindow, WindowConfig, WindowManager
+from .fanout import FANOUT_LANES, FanoutConfig, fanout_l4, fanout_l7
+from .stash import _append_impl
+from .window import FlushedWindow, WindowConfig, WindowManager, batch_stats
 
 _KEY_COLS = np.nonzero(TAG_SCHEMA.key_mask)[0].astype(np.int32)
 # DOC_KEY_PACK covers exactly the TAG_SCHEMA key columns — drift between
@@ -137,7 +147,10 @@ L4PipelineConfig = PipelineConfig
 
 class RollupPipeline:
     """Single-granularity (e.g. 1s) rollup pipeline: fanout → fingerprint
-    → windowed stash merge, with host-driven window flushes."""
+    → windowed stash merge, with host-driven window flushes.
+
+    The per-batch device slice is ONE jitted call (see module docstring);
+    WindowManager.ingest_step drives the window protocol around it."""
 
     fanout_fn = staticmethod(fanout_l4)
     meter_schema: MeterSchema = FLOW_METER
@@ -145,49 +158,82 @@ class RollupPipeline:
     def __init__(self, config: PipelineConfig = PipelineConfig()):
         self.config = config
         self.wm = WindowManager(config.window, TAG_SCHEMA, self.meter_schema)
-        # device-side running count — fetching it per batch would cost a
-        # host round trip; counters reads it on demand
-        self._prereduce_dropped = jnp.zeros((), jnp.int32)
+        self._tag_names: tuple | None = None  # fixed on first batch
+        self._step = None
+
+    def _build_step(self, names: tuple):
+        """One fused device step per batch: [T, N] packed tags → stats +
+        ring append. `names` orders the packed matrix rows (static)."""
+        m = self.meter_schema
+        sum_cols = np.nonzero(m.sum_mask)[0].astype(np.int32)
+        max_cols = np.nonzero(m.max_mask)[0].astype(np.int32)
+        cap_u = self.config.batch_unique_cap
+        interval = self.config.window.interval
+        fanout_cfg = self.config.fanout
+        fanout_fn = self.fanout_fn
+
+        def step(acc, offset, start_window, tag_mat, meters, valid):
+            tags = {k: tag_mat[i] for i, k in enumerate(names)}
+            aux = None
+            if cap_u is not None:
+                tags, meters, valid, aux = batch_prereduce(
+                    tags, meters, valid, interval, cap_u, sum_cols, max_cols
+                )
+            doc_tags, doc_meters, ts, doc_valid = fanout_fn(
+                tags, meters, valid, fanout_cfg
+            )
+            hi, lo = _doc_fingerprint(doc_tags)
+            gated, window, stats = batch_stats(
+                ts, doc_valid, start_window, interval, aux=aux
+            )
+            acc = _append_impl(
+                acc, window, hi, lo, doc_tags, doc_meters, gated, offset
+            )
+            return acc, stats
+
+        return jax.jit(step, donate_argnums=(0,))
 
     def ingest(self, batch: FlowBatch) -> list[DocBatch]:
         """Feed one decoded flow batch; returns any closed windows."""
         batch = batch.pad_to(self.config.batch_size)
-        tags = {k: jnp.asarray(v) for k, v in batch.tags.items()}
+        if not np.any(batch.valid):
+            # idle heartbeat: skip the upload/append (it would burn ring
+            # rows and force empty folds); still settle any deferred
+            # async-drain buffers so closed windows aren't held up
+            return [self._to_docbatch(f) for f in self.wm.settle()]
+        if self._tag_names is None:
+            self._tag_names = tuple(sorted(batch.tags))
+            self._step = self._build_step(self._tag_names)
+        # pack the ~37 tag columns into ONE host→device upload
+        tag_mat = jnp.asarray(
+            np.stack(
+                [np.asarray(batch.tags[k], dtype=np.uint32) for k in self._tag_names]
+            )
+        )
         meters = jnp.asarray(batch.meters)
         valid = jnp.asarray(batch.valid)
-
-        if self.config.batch_unique_cap is not None:
-            m = self.meter_schema
-            tags, meters, valid, dropped = batch_prereduce(
-                tags, meters, valid, self.config.window.interval,
-                self.config.batch_unique_cap,
-                np.nonzero(m.sum_mask)[0].astype(np.int32),
-                np.nonzero(m.max_mask)[0].astype(np.int32),
-            )
-            self._prereduce_dropped = self._prereduce_dropped + dropped
-
-        doc_tags, doc_meters, ts, doc_valid = self.fanout_fn(
-            tags, meters, valid, self.config.fanout
+        # with the pre-reduce on, the append writes a FANOUT_LANES×cap_u
+        # block (static groupby output) regardless of batch rows
+        rows = FANOUT_LANES * (
+            self.config.batch_unique_cap or self.config.batch_size
         )
-        hi, lo = _doc_fingerprint(doc_tags)
 
-        flushed = self.wm.ingest(ts, hi, lo, doc_tags, doc_meters, doc_valid)
+        def dispatch(acc, offset, start_window):
+            return self._step(acc, offset, start_window, tag_mat, meters, valid)
+
+        flushed = self.wm.ingest_step(dispatch, rows)
         return [self._to_docbatch(f) for f in flushed]
 
     def drain(self) -> list[DocBatch]:
         return [self._to_docbatch(f) for f in self.wm.flush_all()]
 
     def _to_docbatch(self, f: FlushedWindow) -> DocBatch:
-        mask = np.asarray(f.out["mask"])
-        tags = np.asarray(f.out["tags"]).T[mask]  # device [T, S] → host rows
-        meters = np.asarray(f.out["meters"]).T[mask]
-        n = tags.shape[0]
-        ts = np.full((n,), f.start_time, dtype=np.uint32)
+        ts = np.full((f.count,), f.start_time, dtype=np.uint32)
         return DocBatch(
-            tags=tags,
-            meters=meters,
+            tags=f.tags,
+            meters=f.meters,
             timestamp=ts,
-            valid=np.ones((n,), dtype=bool),
+            valid=np.ones((f.count,), dtype=bool),
             tag_schema=TAG_SCHEMA,
             meter_schema=self.meter_schema,
         )
@@ -196,7 +242,9 @@ class RollupPipeline:
     def counters(self) -> dict:
         out = dict(self.wm.counters)
         if self.config.batch_unique_cap is not None:
-            out["prereduce_dropped"] = int(self._prereduce_dropped)
+            # shed pre-reduce uniques ride the per-batch stats vector
+            # (stats[4]) — no extra device fetch
+            out["prereduce_dropped"] = self.wm.aux_count
         return out
 
     @property

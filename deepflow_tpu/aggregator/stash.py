@@ -224,6 +224,11 @@ def stash_flush(state: StashState, window_idx) -> tuple[StashState, dict]:
     `mask` of emitted rows (static shapes; host compacts). The stash keeps
     its sort invariant trivially — holes are sentinel rows reclaimed by the
     next merge's compaction.
+
+    This is the per-window oracle shape; the production drain is
+    `stash_flush_range` (ONE device call + ONE packed fetch for every
+    closed window at once — PERF.md §8's per-fetch latency made the
+    per-window loop the windowed path's floor).
     """
     window_idx = jnp.asarray(window_idx, dtype=jnp.uint32)
     mask = state.valid & (state.slot == window_idx)
@@ -242,3 +247,71 @@ def stash_flush(state: StashState, window_idx) -> tuple[StashState, dict]:
         valid=state.valid & ~mask,
     )
     return new_state, out
+
+
+# Packed flush-row layout: [window, key_hi, key_lo, tags…, meters(bitcast)…]
+FLUSH_META_COLS = 3
+
+
+def pack_u32_columns(slot, key_hi, key_lo, tags, meters, valid=None):
+    """Shared packed-u32 layout: [K+T+M, S] with rows slot, key_hi,
+    key_lo, (valid,) tags…, bitcast(meters)…; K = FLUSH_META_COLS, +1
+    with the optional valid lane (checkpoint format). Every builder of
+    this layout (flush range, checkpoint stash/acc) goes through here
+    so the row offsets the unpackers hard-code cannot drift."""
+    meta = [slot[None, :], key_hi[None, :], key_lo[None, :]]
+    if valid is not None:
+        meta.append(valid.astype(jnp.uint32)[None, :])
+    return jnp.concatenate(
+        meta + [tags, jax.lax.bitcast_convert_type(meters, jnp.uint32)], axis=0
+    )
+
+
+def _flush_range_impl(state: StashState, lo_window, hi_window):
+    """Close every window in [lo_window, hi_window): compact their rows
+    to the front of ONE row-major [S, 3+T+M] u32 matrix (window-id,
+    key, tags, bit-cast meters per row) and reclaim their slots.
+
+    Rows are ordered by (window, stash position) — exactly the order the
+    sequential ascending per-window `stash_flush` loop emits, so the two
+    paths are bit-identical (pinned by tests/test_flush_range.py). The
+    host fetches the row count, then only `packed[:total]` — two
+    transfers per window advance, independent of how many windows closed.
+    """
+    lo = jnp.asarray(lo_window, dtype=jnp.uint32)
+    hi = jnp.asarray(hi_window, dtype=jnp.uint32)
+    mask = state.valid & (state.slot >= lo) & (state.slot < hi)
+    # Stable (window, position) compaction: flushed rows first, ascending
+    # window, original stash order within a window. Unflushed rows rank
+    # as SENTINEL (> any real window — slots are < hi ≤ SENTINEL).
+    rank = jnp.where(mask, state.slot, jnp.uint32(SENTINEL_SLOT))
+    iota = jnp.arange(state.capacity, dtype=jnp.int32)
+    _, order = jax.lax.sort((rank, iota), num_keys=1)
+    cols = pack_u32_columns(
+        state.slot, state.key_hi, state.key_lo, state.tags, state.meters
+    )  # [3+T+M, S]
+    packed = jnp.take(cols, order, axis=1).T  # row-major [S, 3+T+M]
+    total = jnp.sum(mask.astype(jnp.int32))
+    new_state = dataclasses.replace(
+        state,
+        slot=jnp.where(mask, jnp.uint32(SENTINEL_SLOT), state.slot),
+        valid=state.valid & ~mask,
+    )
+    return new_state, packed, total
+
+
+stash_flush_range = jax.jit(_flush_range_impl, donate_argnums=(0,))
+
+
+def unpack_flush_rows(rows: np.ndarray, num_tags: int):
+    """Split fetched packed flush rows ([n, 3+T+M] u32, host) back into
+    (window, key_hi, key_lo, tags [n, T], meters [n, M] f32)."""
+    t0 = FLUSH_META_COLS
+    meters = np.ascontiguousarray(rows[:, t0 + num_tags :]).view(np.float32)
+    return (
+        rows[:, 0],
+        rows[:, 1],
+        rows[:, 2],
+        rows[:, t0 : t0 + num_tags],
+        meters,
+    )

@@ -15,13 +15,23 @@ reference interleaves per-flow inserts with window moves, while we apply
 batch-atomic semantics — merge the whole batch, then advance the window
 to `max(batch time) - delay`. Within-batch reordering is invisible to the
 output because merges are commutative per window.
+
+Host-sync budget (PERF.md §8: every device→host fetch costs a fixed
+~150-200 ms round trip on the TPU tunnel): steady-state `ingest` performs
+exactly ONE tiny fetch per batch — a packed stats vector the jitted
+append step computes ([t_max, t_min, n_valid, n_late, aux]) — plus two
+fetches per *window advance* (row count + the packed flush matrix),
+independent of batch size and of how many windows closed. All transfers
+route through `host_fetch` so the CI gate (tests/test_perf_gate.py) can
+count them and trip on a reintroduced per-row or per-window fetch.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable
+from functools import partial
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -29,13 +39,58 @@ from ..datamodel.schema import FLOW_METER, TAG_SCHEMA, MeterSchema, TagSchema
 from .stash import (
     AccumState,
     StashState,
-    accum_append,
+    _append_impl,
     accum_init,
     plan_append,
-    stash_flush,
+    stash_flush_range,
     stash_fold,
     stash_init,
+    unpack_flush_rows,
 )
+
+_U32_MAX = np.uint32(0xFFFFFFFF)
+
+
+def host_fetch(x) -> np.ndarray:
+    """THE device→host fetch boundary for the windowed path.
+
+    Every transfer WindowManager performs goes through here so the
+    perf gate can shim it and assert the per-batch budget; keep new
+    fetches behind this seam."""
+    return np.asarray(x)
+
+
+def batch_stats(timestamp, valid, start_window, interval, aux=None):
+    """Per-batch bookkeeping, device-side (traced): returns (gated_valid,
+    window, stats[5] u32) where stats = [t_max, t_min, n_valid, n_late,
+    aux]. `start_window` is a traced u32 scalar (0 = no gate yet: no row
+    can be late). t_max/t_min are over pre-gate valid rows (0 / U32_MAX
+    when none). `aux` rides along so callers piggyback one extra counter
+    (e.g. pre-reduce shed rows) on the same single fetch."""
+    ts = jnp.asarray(timestamp, dtype=jnp.uint32)
+    valid = jnp.asarray(valid)
+    window = ts // jnp.uint32(interval)
+    late = valid & (window < start_window)
+    gated = valid & ~late
+    stats = jnp.stack(
+        [
+            jnp.max(jnp.where(valid, ts, jnp.uint32(0))),
+            jnp.min(jnp.where(valid, ts, jnp.uint32(_U32_MAX))),
+            jnp.sum(valid).astype(jnp.uint32),
+            jnp.sum(late).astype(jnp.uint32),
+            jnp.uint32(0) if aux is None else jnp.asarray(aux).astype(jnp.uint32),
+        ]
+    )
+    return gated, window, stats
+
+
+@partial(jax.jit, donate_argnums=(0,), static_argnames=("interval",))
+def _raw_append_step(acc, offset, start_window, timestamp, key_hi, key_lo,
+                     tags, meters, valid, *, interval):
+    """One jitted call per raw doc batch: late gate + stats + ring append."""
+    gated, window, stats = batch_stats(timestamp, valid, start_window, interval)
+    acc = _append_impl(acc, window, key_hi, key_lo, tags, meters, gated, offset)
+    return acc, stats
 
 
 @dataclasses.dataclass(frozen=True)
@@ -49,6 +104,16 @@ class WindowConfig:
     # row. 8 amortizes the O((S+A) log(S+A)) sort ~8x while keeping the
     # fold shape small enough for fast (remote) XLA compiles.
     accum_batches: int = 8
+    # Double-buffered drain: defer each batch's stats fetch by one
+    # ingest call, so the host never blocks on the current batch (JAX
+    # async dispatch stays ahead) and a closing window's flush is
+    # dispatched before — and its packed output fetched after — the
+    # next batch's append dispatch, overlapping transfer with compute.
+    # Flushed windows are then RETURNED exactly one ingest call later
+    # than in sync mode (content is identical — rows that would race
+    # the flush are late-dropped either way), and counters trail by
+    # ≤1 batch. flush_all()/drain()/settle() always settles.
+    async_drain: bool = False
 
     @property
     def ring(self) -> int:
@@ -58,9 +123,18 @@ class WindowConfig:
 
 @dataclasses.dataclass
 class FlushedWindow:
+    """One closed window's documents, host-resident and compacted.
+
+    tags/meters are row-major ([n, T] u32 / [n, M] f32) — already
+    unpacked from the single flush matrix, so consumers index rows
+    directly instead of masking full-capacity device planes."""
+
     window_idx: int  # absolute window index (timestamp // interval)
     start_time: int  # window start in seconds
-    out: dict  # device arrays from stash_flush (mask/tags/meters/...)
+    key_hi: np.ndarray  # [n] u32
+    key_lo: np.ndarray  # [n] u32
+    tags: np.ndarray  # [n, T] u32
+    meters: np.ndarray  # [n, M] f32
     count: int
 
 
@@ -83,6 +157,47 @@ class WindowManager:
         self.drop_before_window = 0
         self.total_docs_in = 0
         self.total_flushed = 0
+        self.aux_count = 0  # caller-defined stats[4] accumulator
+        # async-drain double buffers (device handles, fetched next call)
+        self._pending_stats = None
+        self._pending_flush: list[tuple] = []
+
+    # -- device→host drains ---------------------------------------------
+    def _drain_flush(self, packed, total_dev) -> list[FlushedWindow]:
+        """Fetch ONE packed flush result and split it into windows.
+
+        Two transfers regardless of row/window count: the scalar row
+        count, then only the live prefix of the packed matrix."""
+        total = int(host_fetch(total_dev))
+        if total == 0:
+            return []
+        rows = host_fetch(packed[:total])
+        win, key_hi, key_lo, tags, meters = unpack_flush_rows(
+            rows, self.tag_schema.num_fields
+        )
+        self.total_flushed += total
+        flushed = []
+        bounds = np.flatnonzero(np.r_[True, win[1:] != win[:-1]]).tolist() + [total]
+        for a, b in zip(bounds, bounds[1:]):
+            w = int(win[a])
+            flushed.append(
+                FlushedWindow(
+                    window_idx=w,
+                    start_time=w * self.config.interval,
+                    key_hi=key_hi[a:b],
+                    key_lo=key_lo[a:b],
+                    tags=tags[a:b],
+                    meters=meters[a:b],
+                    count=b - a,
+                )
+            )
+        return flushed
+
+    def _drain_ready(self, ready) -> list[FlushedWindow]:
+        out = []
+        for packed, total_dev in ready:
+            out.extend(self._drain_flush(packed, total_dev))
+        return out
 
     def _fold(self):
         if self.fill == 0:
@@ -90,26 +205,49 @@ class WindowManager:
         self.state, self.acc = stash_fold(self.state, self.acc, self.meter_schema)
         self.fill = 0
 
-    def _append(self, window, key_hi, key_lo, tags, meters, valid, rows: int):
-        plan = plan_append(self.fill, self.acc.capacity if self.acc else None, rows)
-        if plan == "init":
-            self._fold()  # pending rows must reach the stash before the ring is replaced
-            self.acc = accum_init(
-                max(self.config.accum_batches * rows, rows),
-                self.tag_schema,
-                self.meter_schema,
-            )
-        elif plan == "fold":
-            self._fold()
-        self.acc = accum_append(
-            self.acc, window, key_hi, key_lo, tags, meters, valid,
-            jnp.int32(self.fill),
-        )
-        self.fill += rows
-
     def window_of(self, timestamp):
         return timestamp // self.config.interval
 
+    # -- stats processing (the ONE per-batch host sync) ------------------
+    def _process_stats(self, stats_dev) -> None:
+        """Fetch one batch's packed stats vector; update host counters,
+        advance the open span and dispatch (not fetch) the range flush."""
+        t_max, t_min, n_valid, n_late, aux = (
+            int(v) for v in host_fetch(stats_dev)
+        )
+        self.aux_count += aux
+        if n_valid == 0:
+            return
+        if self.start_window is None:
+            # Open the ring far enough back that data older than the first
+            # batch but within `delay` is still accepted — the reference
+            # starts its window 2min in the past for the same reason
+            # (quadruple_generator.rs:782-783). The first batch was gated
+            # at window 0, which admits exactly the same rows: this start
+            # is ≤ the first batch's oldest valid window.
+            self.start_window = self.window_of(
+                max(0, min(t_min, t_max - self.config.delay))
+            )
+        self.drop_before_window += n_late
+        self.total_docs_in += n_valid - n_late
+
+        # Advance: every window whose end is more than `delay` behind the
+        # newest arrival closes now (move_window, quadruple_generator.rs:339).
+        # ALL closed windows flush in ONE fused device call; empty
+        # intermediate windows shift silently (the packed matrix simply
+        # has no rows for them), so a large timestamp gap costs nothing.
+        new_start = self.window_of(max(t_max - self.config.delay, 0))
+        if self.start_window < new_start:
+            self._fold()  # flushed windows must see every accumulated row
+            self.state, packed, total = stash_flush_range(
+                self.state,
+                np.uint32(self.start_window),
+                np.uint32(new_start),
+            )
+            self._pending_flush.append((packed, total))
+            self.start_window = new_start
+
+    # -- ingest ----------------------------------------------------------
     def ingest(
         self,
         timestamp,  # [N] u32 seconds (device or host)
@@ -121,82 +259,97 @@ class WindowManager:
     ) -> list[FlushedWindow]:
         """Merge a doc batch; advance and flush any windows that closed.
 
-        Returns flushed windows in order (possibly empty).
-        """
+        Returns flushed windows in order (possibly empty). With
+        `async_drain`, returns the windows closed by the *previous*
+        batch instead (double-buffered — see WindowConfig)."""
         timestamp = jnp.asarray(timestamp, dtype=jnp.uint32)
-        valid = jnp.asarray(valid)
-        window = (timestamp // jnp.uint32(self.config.interval)).astype(jnp.uint32)
+        rows = int(timestamp.shape[0])
+        interval = self.config.interval
 
-        ts_np = np.asarray(timestamp)
-        valid_np = np.asarray(valid)
-        if not valid_np.any():
-            return []
-        t_max = int(ts_np[valid_np].max())
+        def dispatch(acc, offset, start_window):
+            return _raw_append_step(
+                acc, offset, start_window, timestamp, key_hi, key_lo,
+                tags, meters, valid, interval=interval,
+            )
 
-        if self.start_window is None:
-            # Open the ring far enough back that data older than the first
-            # batch but within `delay` is still accepted — the reference
-            # starts its window 2min in the past for the same reason
-            # (quadruple_generator.rs:782-783).
-            t_min = int(ts_np[valid_np].min())
-            self.start_window = self.window_of(max(0, min(t_min, t_max - self.config.delay)))
+        return self.ingest_step(dispatch, rows)
 
-        # Late-arrival gate: rows for already-flushed windows are dropped.
-        window_np = ts_np // self.config.interval
-        late = valid_np & (window_np < self.start_window)
-        n_late = int(late.sum())
-        if n_late:
-            self.drop_before_window += n_late
-            valid = valid & (window >= jnp.uint32(self.start_window))
-        self.total_docs_in += int(valid_np.sum()) - n_late
+    def ingest_step(self, dispatch, rows: int) -> list[FlushedWindow]:
+        """Window protocol around a caller-supplied jitted append step.
 
-        self._append(window, key_hi, key_lo, tags, meters, valid, int(ts_np.shape[0]))
+        `dispatch(acc, offset, start_window)` must return (new_acc,
+        stats[5]) with stats as produced by `batch_stats` — pipelines use
+        this to fuse fanout/fingerprint/pre-reduce into the same single
+        device call (aggregator/pipeline.py). `rows` is the static number
+        of accumulator rows the step appends."""
+        if rows == 0:
+            return self._settle_ready()
 
-        # Advance: every window whose end is more than `delay` behind the
-        # newest arrival closes now (move_window, quadruple_generator.rs:339).
-        # Flush only the distinct windows actually present in the stash —
-        # a large timestamp gap (agent restart, replay skip) must not cost
-        # one device call per empty intermediate window.
-        flushed: list[FlushedWindow] = []
-        new_start = self.window_of(max(t_max - self.config.delay, 0))
-        if self.start_window < new_start:
-            self._fold()  # flushed windows must see every accumulated row
-            slots = np.asarray(self.state.slot)
-            valid_rows = np.asarray(self.state.valid)
-            occupied = np.unique(slots[valid_rows]) if valid_rows.any() else np.array([], np.uint32)
-            for w in sorted(int(w) for w in occupied if w < new_start):
-                self.state, out = stash_flush(self.state, np.uint32(w))
-                count = int(out["count"])
-                self.total_flushed += count
-                if count:  # empty slots shift silently (reference emits nothing)
-                    flushed.append(
-                        FlushedWindow(
-                            window_idx=w,
-                            start_time=w * self.config.interval,
-                            out=out,
-                            count=count,
-                        )
-                    )
-            self.start_window = new_start
-        return flushed
+        ready = self._pending_flush
+        self._pending_flush = []
+
+        if self._pending_stats is not None:
+            # async: settle the previous batch BEFORE this one's gate —
+            # start_window advances exactly as it would have in sync mode.
+            stats, self._pending_stats = self._pending_stats, None
+            self._process_stats(stats)
+
+        plan = plan_append(self.fill, self.acc.capacity if self.acc else None, rows)
+        if plan == "init":
+            self._fold()  # pending rows must reach the stash before the ring is replaced
+            self.acc = accum_init(
+                max(self.config.accum_batches * rows, rows),
+                self.tag_schema,
+                self.meter_schema,
+            )
+        elif plan == "fold":
+            self._fold()
+        sw = 0 if self.start_window is None else self.start_window
+        self.acc, stats_dev = dispatch(self.acc, jnp.int32(self.fill), jnp.uint32(sw))
+        self.fill += rows
+
+        if self.config.async_drain:
+            # defer only the STATS fetch: the host returns before this
+            # batch's compute finishes, and the previous batch's flush
+            # (dispatched above, before this append) is fetched below —
+            # its transfer overlaps this batch's in-flight append.
+            self._pending_stats = stats_dev
+        else:
+            self._process_stats(stats_dev)
+        ready.extend(self._pending_flush)
+        self._pending_flush = []
+        return self._drain_ready(ready)
+
+    def _settle_ready(self) -> list[FlushedWindow]:
+        """Drain whatever finished without appending anything new."""
+        ready = self._pending_flush
+        self._pending_flush = []
+        return self._drain_ready(ready)
+
+    def settle(self) -> list[FlushedWindow]:
+        """Fetch every deferred async-drain buffer (pending stats +
+        dispatched flushes) so host counters/span are consistent with
+        the device. Returns the windows that were in flight — callers
+        that snapshot state (checkpoint.save_window_state) MUST emit
+        them, since their rows have already left the stash."""
+        if self._pending_stats is not None:
+            stats, self._pending_stats = self._pending_stats, None
+            self._process_stats(stats)
+        return self._settle_ready()
 
     def flush_all(self) -> list[FlushedWindow]:
         """Drain every open window (shutdown path)."""
+        flushed = self.settle()
         if self.start_window is None:
-            return []
+            return flushed
         self._fold()
-        flushed = []
-        slots = np.asarray(self.state.slot)
-        valid = np.asarray(self.state.valid)
-        open_windows = sorted(int(w) for w in np.unique(slots[valid])) if valid.any() else []
-        for w in open_windows:
-            self.state, out = stash_flush(self.state, np.uint32(w))
-            count = int(out["count"])
-            self.total_flushed += count
-            flushed.append(
-                FlushedWindow(window_idx=w, start_time=w * self.config.interval, out=out, count=count)
-            )
-            self.start_window = max(self.start_window, w + 1)
+        self.state, packed, total = stash_flush_range(
+            self.state, np.uint32(0), _U32_MAX
+        )
+        self._pending_flush.append((packed, total))
+        flushed += self._settle_ready()
+        for f in flushed:
+            self.start_window = max(self.start_window, f.window_idx + 1)
         return flushed
 
     @property
@@ -205,7 +358,9 @@ class WindowManager:
             "doc_in": self.total_docs_in,
             "flushed_doc": self.total_flushed,
             "drop_before_window": self.drop_before_window,
-            "drop_overflow": int(self.state.dropped_overflow),
-            "occupancy": int(np.asarray(self.state.valid).sum()),
+            # scalar device reductions fetched on demand — never the full
+            # valid plane (PERF.md §8)
+            "drop_overflow": int(host_fetch(self.state.dropped_overflow)),
+            "occupancy": int(host_fetch(jnp.sum(self.state.valid).astype(jnp.int32))),
             "acc_fill": self.fill,  # rows awaiting the next fold
         }

@@ -29,10 +29,13 @@ log = logging.getLogger(__name__)
 
 FLOW_TAG_DB = "flow_tag"
 
-# Width of the plural k8s-metadata JSON column (_plural_schema). The
-# reference's ClickHouse String column is unbounded; this store's
-# fixed-width seat truncates, so oversized dicts are counted and logged
-# (ADVICE.md #1) instead of silently leaving invalid JSON behind.
+# Compat width of the plural k8s-metadata JSON column. The store column
+# is variable-width (object dtype — the ClickHouse String analogue), so
+# nothing is ever clipped here; this threshold only feeds the
+# `plural_json_truncated` counter, which records how many values WOULD
+# be clipped by a fixed-width downstream sink (U1024 exports, the
+# pre-r7 store format) so operators can spot them before wiring one up
+# (ADVICE.md #1).
 PLURAL_JSON_WIDTH = 1024
 
 # pod attr → (singular table stem, plural table stem)
@@ -74,7 +77,8 @@ def _plural_schema(name: str) -> TableSchema:
         (
             ColumnSpec("time", "u4"),
             ColumnSpec("id", "u4"),
-            ColumnSpec("value", f"U{PLURAL_JSON_WIDTH}"),
+            # whole-dict JSON: variable-width (see PLURAL_JSON_WIDTH)
+            ColumnSpec("value", "O"),
         ),
         partition_s=1 << 30,
     )
@@ -142,15 +146,15 @@ class TagRecorder:
                     p_ids.append(r.id)
                     blob = json.dumps(kv, sort_keys=True)
                     if len(blob) > PLURAL_JSON_WIDTH:
-                        # the store's fixed-width cast will clip this to
-                        # invalid JSON — count + name the pod so the
-                        # corruption is observable (deepflow_stats
-                        # `tagrecorder.plural_json_truncated`), per the
-                        # silent-truncation finding (ADVICE.md #1)
+                        # stored intact (variable-width column) — the
+                        # counter is a compat metric: a fixed-width
+                        # U1024 sink fed from this table WOULD clip
+                        # this value to invalid JSON (ADVICE.md #1)
                         self.counters["plural_json_truncated"] += 1
                         log.warning(
-                            "%s: pod id=%d %s JSON (%d chars) exceeds U%d "
-                            "column; stored value truncated to invalid JSON",
+                            "%s: pod id=%d %s JSON (%d chars) exceeds the "
+                            "U%d fixed-width compat limit; stored intact, "
+                            "but fixed-width sinks would truncate it",
                             plural, r.id, attr, len(blob), PLURAL_JSON_WIDTH,
                         )
                     p_values.append(blob)

@@ -90,6 +90,7 @@ class ShardedPipeline:
         self._fold = self._build_fold()
         self._close = self._build_window_close()
         self._flush = self._build_flush()
+        self._flush_range = self._build_flush_range()
 
     # -- state ----------------------------------------------------------
     def init_state(self) -> tuple[StashState, SketchPlanes]:
@@ -288,8 +289,41 @@ class ShardedPipeline:
         doc stashes are per-device (the reference isolates per-pipeline
         docs the same way via global_thread_id, document.rs:293); the
         host compacts all shards into one DocBatch.
+
+        This is the per-window oracle shape; the production drain is
+        `flush_range` (all closed windows in one call — PERF.md §8).
         """
         return self._flush(stash, jnp.asarray(window_idx, dtype=jnp.uint32))
+
+    def _build_flush_range(self):
+        from ..aggregator.stash import _flush_range_impl
+
+        def fr(stash, lo, hi):
+            stash1 = jax.tree.map(lambda x: x[0], stash)
+            new_state, packed, total = _flush_range_impl(stash1, lo, hi)
+            expand = lambda x: x[None]
+            return jax.tree.map(expand, new_state), packed[None], total[None]
+
+        pspec = P(self.axes)
+        mapped = shard_map(
+            fr,
+            mesh=self.mesh,
+            in_specs=(pspec, P(), P()),
+            out_specs=(pspec, pspec, pspec),
+        )
+        return jax.jit(mapped, donate_argnums=(0,))
+
+    def flush_range(self, stash, lo_window, hi_window):
+        """Flush every window in [lo, hi) from every device stash in ONE
+        device call. Returns (new_stash, packed [D, S, 3+T+M] u32 row
+        matrices, totals [D] i32) — the host fetches the totals plus one
+        [D, max(totals)] row block instead of (windows × leaves)
+        transfers (aggregator/stash.stash_flush_range layout)."""
+        return self._flush_range(
+            stash,
+            jnp.asarray(lo_window, dtype=jnp.uint32),
+            jnp.asarray(hi_window, dtype=jnp.uint32),
+        )
 
 
 class ShardedWindowManager:
@@ -319,35 +353,49 @@ class ShardedWindowManager:
         self.stash, self.acc = self.pipe.fold(self.stash, self.acc)
         self.fill = 0
 
-    def _flush_one(self, w: int):
-        """Flush window w from every device stash → DocBatch | None."""
+    def _drain_range(self, lo: int, hi: int):
+        """Flush [lo, hi) from every device stash in one fused call and
+        regroup the packed rows into per-window DocBatches.
+
+        Host pays: the [D] totals fetch + ONE [D, max(totals)] row-block
+        fetch — independent of how many windows closed (previously: a
+        full slot+valid plane scan plus 3 plane fetches PER window)."""
+        from ..aggregator.stash import unpack_flush_rows
         from ..datamodel.batch import DocBatch
         from ..datamodel.schema import FLOW_METER, TAG_SCHEMA
 
-        self.stash, out = self.pipe.flush_window(self.stash, np.uint32(w))
-        mask = np.asarray(out["mask"])  # [D, S]
-        if not mask.any():
-            return None
-        # device payloads are column-major [D, T, S]; host rows are [n, T]
-        tags_out = np.transpose(np.asarray(out["tags"]), (0, 2, 1))[mask]
-        meters_out = np.transpose(np.asarray(out["meters"]), (0, 2, 1))[mask]
-        n = tags_out.shape[0]
-        self.total_flushed += n
-        return DocBatch(
-            tags=tags_out,
-            meters=meters_out,
-            timestamp=np.full((n,), w * self.interval, dtype=np.uint32),
-            valid=np.ones((n,), dtype=bool),
-            tag_schema=TAG_SCHEMA,
-            meter_schema=FLOW_METER,
+        self.stash, packed, totals = self.pipe.flush_range(
+            self.stash, np.uint32(lo), np.uint32(hi)
         )
-
-    def _occupied_windows(self):
-        slots = np.asarray(self.stash.slot)
-        valid_rows = np.asarray(self.stash.valid)
-        if not valid_rows.any():
+        totals_np = np.asarray(totals)  # [D]
+        max_t = int(totals_np.max())
+        if max_t == 0:
             return []
-        return sorted(int(w) for w in np.unique(slots[valid_rows]))
+        block = np.asarray(packed[:, :max_t])  # [D, max_t, 3+T+M]
+        per_dev = [
+            unpack_flush_rows(block[d, : int(t)], TAG_SCHEMA.num_fields)
+            for d, t in enumerate(totals_np)
+        ]
+        flushed = []
+        for w in sorted({int(w) for win, *_ in per_dev for w in np.unique(win)}):
+            # device-major concat within the window — the same row order
+            # the per-window flush_window loop produced
+            tag_parts = [tags[win == w] for win, _, _, tags, _ in per_dev]
+            met_parts = [met[win == w] for win, _, _, _, met in per_dev]
+            tags_out = np.concatenate(tag_parts)
+            n = tags_out.shape[0]
+            self.total_flushed += n
+            flushed.append(
+                DocBatch(
+                    tags=tags_out,
+                    meters=np.concatenate(met_parts),
+                    timestamp=np.full((n,), w * self.interval, dtype=np.uint32),
+                    valid=np.ones((n,), dtype=bool),
+                    tag_schema=TAG_SCHEMA,
+                    meter_schema=FLOW_METER,
+                )
+            )
+        return flushed
 
     def ingest(self, tags, meters, valid):
         """Feed one flow batch (leading dim divisible by device count);
@@ -400,26 +448,20 @@ class ShardedWindowManager:
         flushed = []
         if advancing:
             self._fold()  # flushed windows must see every accumulated row
-            for w in self._occupied_windows():
-                if w >= new_start:
-                    continue
-                db = self._flush_one(w)
-                if db is not None:
-                    flushed.append(db)
+            flushed = self._drain_range(self.start_window, new_start)
             self.start_window = new_start
         return flushed
 
     def drain(self):
         """Flush every open window (shutdown path). Advances the open
         span past each drained window so a straggler ingest cannot
-        re-open and re-emit it (same invariant as WindowManager.flush_all,
-        window.py:159)."""
+        re-open and re-emit it (same invariant as WindowManager.flush_all)."""
+        from ..ops.segment import SENTINEL_SLOT
+
         self._fold()
-        flushed = []
-        for w in self._occupied_windows():
-            db = self._flush_one(w)
-            if db is not None:
-                flushed.append(db)
+        flushed = self._drain_range(0, int(SENTINEL_SLOT))
+        for db in flushed:
             if self.start_window is not None:
+                w = int(db.timestamp[0]) // self.interval
                 self.start_window = max(self.start_window, w + 1)
         return flushed

@@ -38,7 +38,11 @@ def org_db(base: str, org_id: int = DEFAULT_ORG_ID) -> str:
 @dataclasses.dataclass(frozen=True)
 class ColumnSpec:
     name: str
-    dtype: str  # numpy dtype string: "u4", "f4", "i8", "U64"…
+    # numpy dtype string: "u4", "f4", "i8", "U64"… — or "O" for a
+    # variable-width string column (the ClickHouse String analogue:
+    # values are never clipped to a fixed width; each on-disk part
+    # stores them at that part's own max width)
+    dtype: str
 
 
 @dataclasses.dataclass(frozen=True)
@@ -191,7 +195,18 @@ class ColumnarStore:
             part = {k: v[sel] for k, v in arrs.items()}
             if t.path is not None:
                 f = t.path / f"p{pid}_{seq0 + i}.npz"
-                np.savez_compressed(f, **part)
+                # object (variable-width string) columns serialize as a
+                # U<part-max> array — npz can't hold object arrays
+                # without pickle, and per-part sizing keeps them
+                # unclipped; load returns them as U<n>, which scan
+                # concatenation promotes freely
+                np.savez_compressed(
+                    f,
+                    **{
+                        k: (v.astype(np.str_) if v.dtype == object else v)
+                        for k, v in part.items()
+                    },
+                )
                 written.append((pid, f))
             else:
                 written.append((pid, part))

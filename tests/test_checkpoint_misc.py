@@ -55,6 +55,51 @@ def test_window_checkpoint_resume_preserves_open_windows(tmp_path):
     assert mass(docs_ckpt) == mass(docs_ref)  # nothing lost or duplicated
 
 
+def test_async_drain_checkpoint_keeps_in_flight_windows(tmp_path):
+    """Regression (r7 review): with async_drain, a mid-stream save must
+    not lose the deferred stats / dispatched flush buffers — their rows
+    have already left the stash. save_window_state settles first and
+    returns the in-flight windows for the caller to emit."""
+    from deepflow_tpu.aggregator.checkpoint import load_window_state, save_window_state
+    from deepflow_tpu.aggregator.pipeline import L4Pipeline, PipelineConfig
+    from deepflow_tpu.aggregator.window import WindowConfig
+    from deepflow_tpu.datamodel.batch import FlowBatch
+    from deepflow_tpu.datamodel.schema import FLOW_METER, TAG_SCHEMA
+    from deepflow_tpu.ingest.replay import SyntheticFlowGen
+
+    cfg = PipelineConfig(
+        window=WindowConfig(capacity=1 << 12, async_drain=True), batch_size=256
+    )
+    stream = [(T0, 100), (T0 + 1, 100), (T0 + 10, 100), (T0 + 11, 50)]
+
+    def run(save_after: int | None):
+        gen = SyntheticFlowGen(num_tuples=40, seed=7)
+        pipe = L4Pipeline(cfg)
+        docs = []
+        for i, (t, n) in enumerate(stream):
+            docs += pipe.ingest(FlowBatch.from_records(gen.records(n, t)))
+            if save_after == i:
+                # the T0+10 batch's stats (which close windows T0/T0+1)
+                # are still deferred here — the in-flight case
+                in_flight = save_window_state(pipe.wm, tmp_path / "wm.ckpt")
+                docs += [pipe._to_docbatch(f) for f in in_flight]
+                pipe = L4Pipeline(cfg)
+                pipe.wm = load_window_state(
+                    tmp_path / "wm.ckpt", TAG_SCHEMA, FLOW_METER
+                )
+        docs += pipe.drain()
+        return docs
+
+    def mass(dbs):
+        c = FLOW_METER.index("packet_tx")
+        return (
+            sum(float(db.meters[:, c].sum()) for db in dbs),
+            sum(db.size for db in dbs),
+        )
+
+    assert mass(run(save_after=2)) == mass(run(save_after=None))
+
+
 # -- segmenttree ---------------------------------------------------------
 
 

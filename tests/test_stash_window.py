@@ -86,8 +86,8 @@ def test_window_manager_flushes_after_delay():
     assert [f.window_idx for f in flushed] == [100]
     f = flushed[0]
     assert f.count == 1  # key 1 merged twice in window 100
-    mask = np.asarray(f.out["mask"])
-    np.testing.assert_array_equal(np.asarray(f.out["meters"]).T[mask][0], [2, 2, 1])
+    assert int(f.key_hi[0]) == 1
+    np.testing.assert_array_equal(f.meters[0], [2, 2, 1])
 
     # late arrival for window 100 is dropped
     assert wm.ingest(*batch([100], [9])) == []
