@@ -118,7 +118,7 @@ def run(n_dev: int, per_dev: int, iters: int) -> dict:
     _sync(wm)
     close_ms = (time.perf_counter() - t0 - fetch_base) / closes * 1e3
 
-    return {
+    row = {
         "n_devices": n_dev,
         "per_device_batch": per_dev,
         "ingest_rec_s": round(ingest_rate, 1),
@@ -128,6 +128,12 @@ def run(n_dev: int, per_dev: int, iters: int) -> dict:
         "close_ms": round(close_ms, 3),
         "fetch_base_ms": round(fetch_base * 1e3, 3),
     }
+    try:  # stage attribution snapshot (ISSUE 3); tolerate its absence
+        row["telemetry"] = wm.telemetry()
+    except Exception as e:
+        row["telemetry"] = None
+        row["telemetry_error"] = repr(e)
+    return row
 
 
 def main():

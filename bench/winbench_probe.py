@@ -54,17 +54,18 @@ def main():
         docs += sum(db.size for db in pipe.ingest(fb))
     docs += sum(db.size for db in pipe.drain())
     elapsed = time.perf_counter() - start
-    print(
-        json.dumps(
-            {
-                "rec_s": round(batch * iters / elapsed, 1),
-                "docs": docs,
-                "batch": batch,
-                "iters": iters,
-            }
-        ),
-        flush=True,
-    )
+    rec = {
+        "rec_s": round(batch * iters / elapsed, 1),
+        "docs": docs,
+        "batch": batch,
+        "iters": iters,
+    }
+    try:  # stage attribution (ISSUE 3): counter block + span summary
+        rec["telemetry"] = pipe.telemetry()
+    except Exception as e:  # pre-telemetry pipeline — record why, not crash
+        rec["telemetry"] = None
+        rec["telemetry_error"] = repr(e)
+    print(json.dumps(rec), flush=True)
 
 
 if __name__ == "__main__":

@@ -51,6 +51,16 @@ def emit(metric, value, unit, vs_baseline, **detail):
     results.append({**line, **detail})
 
 
+def _telemetry(obj):
+    """Counter-block + span-summary snapshot for the aggregate JSON
+    (ISSUE 3). None — never a crash — when the pipeline predates
+    telemetry or the run died before the manager existed."""
+    try:
+        return obj.telemetry()
+    except Exception:
+        return None
+
+
 def config1(quick: bool):
     import jax
     import jax.numpy as jnp
@@ -345,7 +355,8 @@ def config5(quick: bool):
         except Exception as e:
             scaling = [{"error": repr(e)}]
     emit("c5_pod_1m_rollup_mesh", rate, "records/s", rate / NORTH_STAR,
-         n_devices=n_dev, flushed_docs=docs, mesh_scaling=scaling)
+         n_devices=n_dev, flushed_docs=docs, mesh_scaling=scaling,
+         telemetry=_telemetry(wm))
 
 
 def main():

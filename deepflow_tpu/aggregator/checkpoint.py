@@ -83,29 +83,35 @@ def save_window_state(wm: WindowManager, path: str | Path):
     must emit them before treating the checkpoint as the resume point;
     an unsettled snapshot would silently lose those windows' documents.
     Empty list in sync mode."""
-    in_flight = wm.settle()
-    arrays = {"stash_packed": np.asarray(_pack_stash(wm.state))}
-    if wm.acc is not None:
-        arrays["acc_packed"] = np.asarray(_pack_acc(wm.acc))
-    meta = {
-        "version": _VERSION,
-        "num_tags": wm.tag_schema.num_fields,
-        "dropped_overflow": int(np.asarray(wm.state.dropped_overflow)),
-        "fill": wm.fill,
-        "start_window": wm.start_window,
-        "drop_before_window": wm.drop_before_window,
-        "total_docs_in": wm.total_docs_in,
-        "total_flushed": wm.total_flushed,
-        "interval": wm.config.interval,
-        "delay": wm.config.delay,
-        "capacity": wm.config.capacity,
-        "accum_batches": wm.config.accum_batches,
-        "async_drain": wm.config.async_drain,
-    }
-    buf = io.BytesIO()
-    np.savez_compressed(buf, meta=np.frombuffer(json.dumps(meta).encode(), np.uint8),
-                        **arrays)
-    Path(path).write_bytes(buf.getvalue())
+    from ..utils.spans import SPAN_CHECKPOINT_SAVE
+
+    with wm.tracer.span(SPAN_CHECKPOINT_SAVE):
+        in_flight = wm.settle()
+        arrays = {"stash_packed": np.asarray(_pack_stash(wm.state))}
+        if wm.acc is not None:
+            arrays["acc_packed"] = np.asarray(_pack_acc(wm.acc))
+        meta = {
+            "version": _VERSION,
+            "num_tags": wm.tag_schema.num_fields,
+            "dropped_overflow": int(np.asarray(wm.state.dropped_overflow)),
+            "fill": wm.fill,
+            "start_window": wm.start_window,
+            "drop_before_window": wm.drop_before_window,
+            "total_docs_in": wm.total_docs_in,
+            "total_flushed": wm.total_flushed,
+            "aux_count": wm.aux_count,
+            "excess_word_hits": wm.excess_word_hits,
+            "interval": wm.config.interval,
+            "delay": wm.config.delay,
+            "capacity": wm.config.capacity,
+            "accum_batches": wm.config.accum_batches,
+            "async_drain": wm.config.async_drain,
+        }
+        buf = io.BytesIO()
+        np.savez_compressed(
+            buf, meta=np.frombuffer(json.dumps(meta).encode(), np.uint8), **arrays
+        )
+        Path(path).write_bytes(buf.getvalue())
     return in_flight
 
 
@@ -165,4 +171,7 @@ def load_window_state(
         wm.drop_before_window = meta["drop_before_window"]
         wm.total_docs_in = meta["total_docs_in"]
         wm.total_flushed = meta["total_flushed"]
+        # telemetry counters landed after v2 writers; absent = 0
+        wm.aux_count = meta.get("aux_count", 0)
+        wm.excess_word_hits = meta.get("excess_word_hits", 0)
     return wm

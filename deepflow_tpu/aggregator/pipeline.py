@@ -29,9 +29,16 @@ from ..datamodel.batch import DocBatch, FlowBatch
 from ..datamodel.code import DOC_KEY_PACK, RAW_TAG_PACK, DocumentFlag, pack_tag_words
 from ..datamodel.schema import APP_METER, FLOW_METER, TAG_SCHEMA, MeterSchema
 from ..ops.hashing import fingerprint64_words
+from ..utils.spans import JitCacheMonitor
+from ..utils.stats import register_countable
 from .fanout import FANOUT_LANES, FanoutConfig, fanout_l4, fanout_l7
 from .stash import _append_impl
-from .window import FlushedWindow, WindowConfig, WindowManager, batch_stats
+from .window import (
+    FlushedWindow,
+    WindowConfig,
+    WindowManager,
+    batch_counter_block,
+)
 
 _KEY_COLS = np.nonzero(TAG_SCHEMA.key_mask)[0].astype(np.int32)
 # DOC_KEY_PACK covers exactly the TAG_SCHEMA key columns — drift between
@@ -41,14 +48,26 @@ assert set(DOC_KEY_PACK.field_names()) == {
 }, "DOC_KEY_WIDTHS out of sync with TAG_SCHEMA key columns"
 
 
-def _doc_fingerprint(doc_tags):
-    """(hi, lo) over a [T, N] doc tag matrix via the packed-word plan:
-    the key columns are bin-packed into ~22 u32 words built once
+def _doc_fingerprint(doc_tags, with_excess: bool = False):
+    """(hi, lo[, excess]) over a [T, N] doc tag matrix via the packed-word
+    plan: the key columns are bin-packed into ~22 u32 words built once
     (datamodel/code.py), and both murmur seeds fold the words instead
     of 32 raw columns (PERF.md §9d). Row extraction from the
-    column-major matrix is free (contiguous [N] slices)."""
+    column-major matrix is free (contiguous [N] slices).
+
+    With `with_excess`, also returns the packing-guard excess word
+    ([N] u32, zero for rows whose tag values honor the declared
+    DOC_KEY_WIDTHS) so the fused step can count contract violations in
+    the device counter block."""
     cols = {f: doc_tags[TAG_SCHEMA.index(f)] for f in DOC_KEY_PACK.field_names()}
-    return fingerprint64_words(pack_tag_words(cols, DOC_KEY_PACK, jnp))
+    words = pack_tag_words(cols, DOC_KEY_PACK, jnp)
+    hi, lo = fingerprint64_words(words)
+    if with_excess:
+        # the excess word is the last packed word whenever the plan has
+        # narrow fields (pack_tag_words contract)
+        excess = words[-1] if DOC_KEY_PACK.packed else jnp.zeros_like(hi)
+        return hi, lo, excess
+    return hi, lo
 
 
 def batch_prereduce(tags, meters, valid, interval, cap, sum_cols, max_cols):
@@ -158,8 +177,23 @@ class RollupPipeline:
     def __init__(self, config: PipelineConfig = PipelineConfig()):
         self.config = config
         self.wm = WindowManager(config.window, TAG_SCHEMA, self.meter_schema)
+        self.tracer = self.wm.tracer  # host stage spans (utils/spans)
+        self._jit = JitCacheMonitor()  # retrace gate for the fused step
         self._tag_names: tuple | None = None  # fixed on first batch
         self._step = None
+        # self-telemetry registration (reference RegisterCountable stance:
+        # every component registers at construction; weakly held, so
+        # short-lived pipelines deregister themselves)
+        register_countable(
+            "tpu_pipeline", self,
+            kind=type(self).__name__,
+            interval=f"{config.window.interval}s",
+        )
+        register_countable(
+            "tpu_pipeline_spans", self.tracer,
+            kind=type(self).__name__,
+            interval=f"{config.window.interval}s",
+        )
 
     def _build_step(self, names: tuple):
         """One fused device step per batch: [T, N] packed tags → stats +
@@ -172,7 +206,8 @@ class RollupPipeline:
         fanout_cfg = self.config.fanout
         fanout_fn = self.fanout_fn
 
-        def step(acc, offset, start_window, tag_mat, meters, valid):
+        def step(acc, offset, start_window, stash_valid, stash_evict,
+                 tag_mat, meters, valid):
             tags = {k: tag_mat[i] for i, k in enumerate(names)}
             aux = None
             if cap_u is not None:
@@ -182,14 +217,19 @@ class RollupPipeline:
             doc_tags, doc_meters, ts, doc_valid = fanout_fn(
                 tags, meters, valid, fanout_cfg
             )
-            hi, lo = _doc_fingerprint(doc_tags)
-            gated, window, stats = batch_stats(
-                ts, doc_valid, start_window, interval, aux=aux
+            hi, lo, excess = _doc_fingerprint(doc_tags, with_excess=True)
+            # packing-guard hits: doc rows whose tag values overflow the
+            # declared DOC_KEY_WIDTHS contract (datamodel/code.py)
+            excess_hits = jnp.sum((excess != 0) & doc_valid)
+            gated, window, block = batch_counter_block(
+                ts, doc_valid, start_window, interval, aux=aux,
+                excess_hits=excess_hits, stash_valid=stash_valid,
+                stash_evictions=stash_evict, ring_fill=offset,
             )
             acc = _append_impl(
                 acc, window, hi, lo, doc_tags, doc_meters, gated, offset
             )
-            return acc, stats
+            return acc, block
 
         return jax.jit(step, donate_argnums=(0,))
 
@@ -204,6 +244,7 @@ class RollupPipeline:
         if self._tag_names is None:
             self._tag_names = tuple(sorted(batch.tags))
             self._step = self._build_step(self._tag_names)
+            self._jit.attach(self._step)
         # pack the ~37 tag columns into ONE host→device upload
         tag_mat = jnp.asarray(
             np.stack(
@@ -212,6 +253,9 @@ class RollupPipeline:
         )
         meters = jnp.asarray(batch.meters)
         valid = jnp.asarray(batch.valid)
+        self.wm.bytes_uploaded += (
+            tag_mat.nbytes + meters.nbytes + valid.nbytes
+        )
         # with the pre-reduce on, the append writes a FANOUT_LANES×cap_u
         # block (static groupby output) regardless of batch rows
         rows = FANOUT_LANES * (
@@ -219,9 +263,17 @@ class RollupPipeline:
         )
 
         def dispatch(acc, offset, start_window):
-            return self._step(acc, offset, start_window, tag_mat, meters, valid)
+            # stash lanes read at dispatch time (post any fold) — device
+            # handles, no transfer; they fill the counter block's
+            # occupancy/eviction lanes inside the same fused call
+            st = self.wm.state
+            return self._step(
+                acc, offset, start_window, st.valid, st.dropped_overflow,
+                tag_mat, meters, valid,
+            )
 
         flushed = self.wm.ingest_step(dispatch, rows)
+        self._jit.poll()
         return [self._to_docbatch(f) for f in flushed]
 
     def drain(self) -> list[DocBatch]:
@@ -238,13 +290,30 @@ class RollupPipeline:
             meter_schema=self.meter_schema,
         )
 
+    def get_counters(self) -> dict:
+        """Countable face: fetch-free (see WindowManager.get_counters)
+        plus the fused-step jit compile/retrace counters."""
+        out = self.wm.get_counters()
+        out.update(self._jit.get_counters())
+        return out
+
+    def telemetry(self) -> dict:
+        """JSON-able snapshot for bench records: the counter-block-backed
+        counters plus the per-stage span summary (BENCH files carry
+        stage attribution — PERF.md §13)."""
+        return {
+            "counters": self.get_counters(),
+            "spans": self.tracer.summary(),
+        }
+
     @property
     def counters(self) -> dict:
         out = dict(self.wm.counters)
-        if self.config.batch_unique_cap is not None:
-            # shed pre-reduce uniques ride the per-batch stats vector
-            # (stats[4]) — no extra device fetch
-            out["prereduce_dropped"] = self.wm.aux_count
+        out.update(self._jit.get_counters())
+        # legacy name for the CB_PREREDUCE_SHED lane ("prereduce_shed"
+        # in get_counters) — kept as the probe-facing alias, computed
+        # from the same source so the two cannot drift
+        out["prereduce_dropped"] = out.pop("prereduce_shed")
         return out
 
     @property
@@ -293,6 +362,12 @@ class DualGranularityPipeline:
     @property
     def counters(self) -> dict:
         return {"second": self.second.counters, "minute": self.minute.counters}
+
+    def telemetry(self) -> dict:
+        return {
+            "second": self.second.telemetry(),
+            "minute": self.minute.telemetry(),
+        }
 
 
 class L4Pipeline(RollupPipeline):

@@ -18,12 +18,16 @@ output because merges are commutative per window.
 
 Host-sync budget (PERF.md §8: every device→host fetch costs a fixed
 ~150-200 ms round trip on the TPU tunnel): steady-state `ingest` performs
-exactly ONE tiny fetch per batch — a packed stats vector the jitted
-append step computes ([t_max, t_min, n_valid, n_late, aux]) — plus two
-fetches per *window advance* (row count + the packed flush matrix),
-independent of batch size and of how many windows closed. All transfers
-route through `host_fetch` so the CI gate (tests/test_perf_gate.py) can
-count them and trip on a reintroduced per-row or per-window fetch.
+exactly ONE tiny fetch per batch — the versioned on-device COUNTER BLOCK
+the jitted append step computes (late/valid/shed plus stash occupancy &
+evictions, packed-key excess-word hits and ring fill; see
+COUNTER_BLOCK_VERSION / CB_* below) — plus two fetches per *window
+advance* (row count + the packed flush matrix), independent of batch
+size and of how many windows closed. All transfers route through
+`host_fetch` so the CI gate (tests/test_perf_gate.py) can count them and
+trip on a reintroduced per-row or per-window fetch; the managers also
+account fetch count and bytes per direction, and wrap each host stage
+(dispatch / stats fetch / advance / drain) in utils/spans tracer spans.
 """
 
 from __future__ import annotations
@@ -36,6 +40,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..datamodel.schema import FLOW_METER, TAG_SCHEMA, MeterSchema, TagSchema
+from ..utils.spans import (
+    SPAN_FLUSH_DRAIN,
+    SPAN_INGEST_DISPATCH,
+    SPAN_STATS_FETCH,
+    SPAN_WINDOW_ADVANCE,
+    SpanTracer,
+)
 from .stash import (
     AccumState,
     StashState,
@@ -58,6 +69,34 @@ def host_fetch(x) -> np.ndarray:
     perf gate can shim it and assert the per-batch budget; keep new
     fetches behind this seam."""
     return np.asarray(x)
+
+
+# ---------------------------------------------------------------------------
+# Versioned on-device counter block (ISSUE 3). The fused jit step's
+# per-batch download widened from the 5-scalar stats vector into this
+# u32 block — still ONE fetch, same ≤3-fetch budget. Layout is a
+# CONTRACT between the device step and `_process_stats`; bump
+# COUNTER_BLOCK_VERSION when it changes (element 0 carries the version
+# so a stale host parser fails loudly instead of mis-slicing).
+
+COUNTER_BLOCK_VERSION = 1
+(
+    CB_VERSION,  # constant COUNTER_BLOCK_VERSION
+    CB_T_MAX,  # max valid timestamp (pre-gate)
+    CB_T_MIN,  # min valid timestamp (pre-gate)
+    CB_N_VALID,  # valid rows this batch (pre-gate)
+    CB_N_LATE,  # rows dropped by the late-arrival gate
+    CB_PREREDUCE_SHED,  # unique keys shed by batch_prereduce this batch
+    CB_EXCESS_HITS,  # doc rows whose packed-key excess word != 0
+    CB_STASH_OCCUPANCY,  # valid stash rows at dispatch (post-fold)
+    CB_STASH_EVICTIONS,  # cumulative stash overflow drops at dispatch
+    CB_RING_FILL,  # accumulator rows already occupied at dispatch
+) = range(10)
+CB_LEN = 10
+CB_FIELDS = (
+    "version", "t_max", "t_min", "n_valid", "n_late", "prereduce_shed",
+    "excess_word_hits", "stash_occupancy", "stash_evictions", "ring_fill",
+)
 
 
 def batch_stats(timestamp, valid, start_window, interval, aux=None):
@@ -84,13 +123,59 @@ def batch_stats(timestamp, valid, start_window, interval, aux=None):
     return gated, window, stats
 
 
+def batch_counter_block(
+    timestamp,
+    valid,
+    start_window,
+    interval,
+    *,
+    aux=None,
+    excess_hits=None,
+    stash_valid=None,
+    stash_evictions=None,
+    ring_fill=None,
+):
+    """`batch_stats` widened into the versioned counter block (traced).
+
+    Extra lanes ride the SAME single per-batch fetch: packed-key
+    excess-word hits (the datamodel/code.py contract guard), stash
+    occupancy summed from the (device-resident — zero transfer) valid
+    plane, cumulative eviction count, and the accumulator-ring fill at
+    dispatch. All optional inputs default to zero so every caller of
+    the old 5-vector shape can widen incrementally."""
+    gated, window, stats = batch_stats(timestamp, valid, start_window, interval, aux=aux)
+
+    def u32(x):
+        return jnp.uint32(0) if x is None else jnp.asarray(x).astype(jnp.uint32)
+
+    occ = (
+        jnp.uint32(0)
+        if stash_valid is None
+        else jnp.sum(stash_valid).astype(jnp.uint32)
+    )
+    block = jnp.concatenate(
+        [
+            jnp.full((1,), COUNTER_BLOCK_VERSION, dtype=jnp.uint32),
+            stats,
+            jnp.stack([u32(excess_hits), occ, u32(stash_evictions), u32(ring_fill)]),
+        ]
+    )
+    return gated, window, block
+
+
 @partial(jax.jit, donate_argnums=(0,), static_argnames=("interval",))
-def _raw_append_step(acc, offset, start_window, timestamp, key_hi, key_lo,
-                     tags, meters, valid, *, interval):
-    """One jitted call per raw doc batch: late gate + stats + ring append."""
-    gated, window, stats = batch_stats(timestamp, valid, start_window, interval)
+def _raw_append_step(acc, offset, start_window, stash_valid, stash_evict,
+                     timestamp, key_hi, key_lo, tags, meters, valid, *, interval):
+    """One jitted call per raw doc batch: late gate + counter block +
+    ring append. `stash_valid`/`stash_evict` are device-resident stash
+    lanes folded into the block — inputs already on device, no
+    transfer."""
+    gated, window, block = batch_counter_block(
+        timestamp, valid, start_window, interval,
+        stash_valid=stash_valid, stash_evictions=stash_evict, ring_fill=offset,
+    )
     acc = _append_impl(acc, window, key_hi, key_lo, tags, meters, gated, offset)
-    return acc, stats
+    return acc, block
 
 
 @dataclasses.dataclass(frozen=True)
@@ -146,6 +231,8 @@ class WindowManager:
         config: WindowConfig,
         tag_schema: TagSchema = TAG_SCHEMA,
         meter_schema: MeterSchema = FLOW_METER,
+        *,
+        tracer: SpanTracer | None = None,
     ):
         self.config = config
         self.tag_schema = tag_schema
@@ -158,9 +245,29 @@ class WindowManager:
         self.total_docs_in = 0
         self.total_flushed = 0
         self.aux_count = 0  # caller-defined stats[4] accumulator
+        # device counter-block mirror (as of the last stats fetch; the
+        # occupancy/eviction lanes snapshot dispatch time — i.e. the
+        # post-fold, pre-flush stash of that batch)
+        self.excess_word_hits = 0
+        self.stash_occupancy = 0
+        self.stash_evictions = 0
+        self.device_ring_fill = 0
+        self.n_advances = 0
+        # device↔host transfer accounting (the host_fetch seam)
+        self.host_fetches = 0
+        self.bytes_fetched = 0
+        self.bytes_uploaded = 0  # callers add their packed upload sizes
+        self.tracer = tracer if tracer is not None else SpanTracer()
         # async-drain double buffers (device handles, fetched next call)
         self._pending_stats = None
         self._pending_flush: list[tuple] = []
+
+    def _fetch(self, x) -> np.ndarray:
+        """host_fetch + per-manager transfer accounting (count + bytes)."""
+        arr = host_fetch(x)
+        self.host_fetches += 1
+        self.bytes_fetched += arr.nbytes
+        return arr
 
     # -- device→host drains ---------------------------------------------
     def _drain_flush(self, packed, total_dev) -> list[FlushedWindow]:
@@ -168,10 +275,10 @@ class WindowManager:
 
         Two transfers regardless of row/window count: the scalar row
         count, then only the live prefix of the packed matrix."""
-        total = int(host_fetch(total_dev))
+        total = int(self._fetch(total_dev))
         if total == 0:
             return []
-        rows = host_fetch(packed[:total])
+        rows = self._fetch(packed[:total])
         win, key_hi, key_lo, tags, meters = unpack_flush_rows(
             rows, self.tag_schema.num_fields
         )
@@ -194,10 +301,13 @@ class WindowManager:
         return flushed
 
     def _drain_ready(self, ready) -> list[FlushedWindow]:
-        out = []
-        for packed, total_dev in ready:
-            out.extend(self._drain_flush(packed, total_dev))
-        return out
+        if not ready:
+            return []
+        with self.tracer.span(SPAN_FLUSH_DRAIN):
+            out = []
+            for packed, total_dev in ready:
+                out.extend(self._drain_flush(packed, total_dev))
+            return out
 
     def _fold(self):
         if self.fill == 0:
@@ -210,11 +320,27 @@ class WindowManager:
 
     # -- stats processing (the ONE per-batch host sync) ------------------
     def _process_stats(self, stats_dev) -> None:
-        """Fetch one batch's packed stats vector; update host counters,
-        advance the open span and dispatch (not fetch) the range flush."""
-        t_max, t_min, n_valid, n_late, aux = (
-            int(v) for v in host_fetch(stats_dev)
-        )
+        """Fetch one batch's packed counter block; update host counters,
+        advance the open span and dispatch (not fetch) the range flush.
+
+        Accepts both the versioned CB_LEN block (element 0 =
+        COUNTER_BLOCK_VERSION) and the legacy 5-scalar stats vector, so
+        caller-supplied dispatch steps can widen incrementally."""
+        with self.tracer.span(SPAN_STATS_FETCH):
+            vec = [int(v) for v in self._fetch(stats_dev)]
+        if len(vec) == CB_LEN:
+            if vec[CB_VERSION] != COUNTER_BLOCK_VERSION:
+                raise ValueError(
+                    f"counter block version {vec[CB_VERSION]} != "
+                    f"{COUNTER_BLOCK_VERSION} — device/host layout drift"
+                )
+            t_max, t_min, n_valid, n_late, aux = vec[CB_T_MAX:CB_PREREDUCE_SHED + 1]
+            self.excess_word_hits += vec[CB_EXCESS_HITS]
+            self.stash_occupancy = vec[CB_STASH_OCCUPANCY]
+            self.stash_evictions = vec[CB_STASH_EVICTIONS]
+            self.device_ring_fill = vec[CB_RING_FILL]
+        else:  # legacy [t_max, t_min, n_valid, n_late, aux]
+            t_max, t_min, n_valid, n_late, aux = vec
         self.aux_count += aux
         if n_valid == 0:
             return
@@ -238,14 +364,16 @@ class WindowManager:
         # has no rows for them), so a large timestamp gap costs nothing.
         new_start = self.window_of(max(t_max - self.config.delay, 0))
         if self.start_window < new_start:
-            self._fold()  # flushed windows must see every accumulated row
-            self.state, packed, total = stash_flush_range(
-                self.state,
-                np.uint32(self.start_window),
-                np.uint32(new_start),
-            )
-            self._pending_flush.append((packed, total))
-            self.start_window = new_start
+            with self.tracer.span(SPAN_WINDOW_ADVANCE):
+                self._fold()  # flushed windows must see every accumulated row
+                self.state, packed, total = stash_flush_range(
+                    self.state,
+                    np.uint32(self.start_window),
+                    np.uint32(new_start),
+                )
+                self._pending_flush.append((packed, total))
+                self.start_window = new_start
+                self.n_advances += 1
 
     # -- ingest ----------------------------------------------------------
     def ingest(
@@ -267,9 +395,14 @@ class WindowManager:
         interval = self.config.interval
 
         def dispatch(acc, offset, start_window):
+            # read the stash AT DISPATCH time (ingest_step may fold
+            # first) so the block's occupancy lane sees the post-fold
+            # plane; both lanes are device-resident — zero transfer
+            st = self.state
             return _raw_append_step(
-                acc, offset, start_window, timestamp, key_hi, key_lo,
-                tags, meters, valid, interval=interval,
+                acc, offset, start_window, st.valid, st.dropped_overflow,
+                timestamp, key_hi, key_lo, tags, meters, valid,
+                interval=interval,
             )
 
         return self.ingest_step(dispatch, rows)
@@ -305,7 +438,10 @@ class WindowManager:
         elif plan == "fold":
             self._fold()
         sw = 0 if self.start_window is None else self.start_window
-        self.acc, stats_dev = dispatch(self.acc, jnp.int32(self.fill), jnp.uint32(sw))
+        with self.tracer.span(SPAN_INGEST_DISPATCH):
+            self.acc, stats_dev = dispatch(
+                self.acc, jnp.int32(self.fill), jnp.uint32(sw)
+            )
         self.fill += rows
 
         if self.config.async_drain:
@@ -352,15 +488,45 @@ class WindowManager:
             self.start_window = max(self.start_window, f.window_idx + 1)
         return flushed
 
-    @property
-    def counters(self) -> dict:
+    def get_counters(self) -> dict:
+        """Countable face (utils/stats.StatsCollector): host ints and the
+        device counter-block cache ONLY — no device access, so a ticking
+        collector thread can sample mid-ingest without racing a dispatch
+        or burning a host sync. `stash_occupancy`/`stash_evictions` are
+        as of the last fused append dispatch; the `counters` property
+        below fetches the live values when a probe wants them."""
         return {
             "doc_in": self.total_docs_in,
             "flushed_doc": self.total_flushed,
             "drop_before_window": self.drop_before_window,
-            # scalar device reductions fetched on demand — never the full
-            # valid plane (PERF.md §8)
-            "drop_overflow": int(host_fetch(self.state.dropped_overflow)),
-            "occupancy": int(host_fetch(jnp.sum(self.state.valid).astype(jnp.int32))),
+            "prereduce_shed": self.aux_count,
+            "excess_word_hits": self.excess_word_hits,
+            "stash_occupancy": self.stash_occupancy,
+            "stash_evictions": self.stash_evictions,
             "acc_fill": self.fill,  # rows awaiting the next fold
+            # device-reported ring fill at last dispatch — must track
+            # acc_fill minus the in-flight batch; drift = host/device
+            # bookkeeping bug
+            "device_ring_fill": self.device_ring_fill,
+            "window_advances": self.n_advances,
+            "host_fetches": self.host_fetches,
+            "bytes_fetched": self.bytes_fetched,
+            "bytes_uploaded": self.bytes_uploaded,
         }
+
+    @property
+    def counters(self) -> dict:
+        out = self.get_counters()
+        out.update(
+            {
+                # scalar device reductions fetched on demand — never the
+                # full valid plane (PERF.md §8); live values, unlike the
+                # dispatch-time block cache above. Through _fetch: probe
+                # syncs must show up in the transfer accounting too.
+                "drop_overflow": int(self._fetch(self.state.dropped_overflow)),
+                "occupancy": int(
+                    self._fetch(jnp.sum(self.state.valid).astype(jnp.int32))
+                ),
+            }
+        )
+        return out

@@ -1,31 +1,71 @@
-"""Self-telemetry loop closure: StatsCollector → dfstats wire frames.
+"""Self-telemetry loop closure: StatsCollector → dfstats wire frames →
+`deepflow_system` tables.
 
 The reference serializes every component's counters as InfluxDB points
 and ships them into its own ext_metrics pipeline as `deepflow_stats`
-(server/libs/stats/stats.go:89-202). `stats_sink(sender)` is that loop
-for this framework: attach it to a StatsCollector and counter snapshots
-flow over DFSTATS frames into the deepflow_stats tables, queryable with
-the same SQL engine as everything else.
+(server/libs/stats/stats.go:89-202). Two loops live here:
+
+  * `stats_sink(sender)` — the wire loop: snapshots flow over DFSTATS
+    frames into the deepflow_stats tables through the full ingest path
+    (receiver → IntegrationIngester), queryable with the same SQL
+    engine as everything else.
+  * `system_sink(store)` — the dogfood loop (ISSUE 3): snapshots land
+    directly in the store's `deepflow_system.deepflow_system` table in
+    the prometheus-samples shape (time, metric, labels, value), so the
+    framework's own querier answers questions about the framework —
+    SQL (`SELECT value FROM deepflow_system.deepflow_system WHERE
+    metric = 'tpu_pipeline_doc_in'`) and PromQL
+    (`tpu_pipeline_doc_in{kind="L4Pipeline"}` with
+    db="deepflow_system", table="deepflow_system") both work.
+
+Influx line serialization follows the line-protocol typing rules:
+integer fields keep their `{v}i` suffix (the reference's counters are
+int-typed; coercing to float silently loses that), tag values escape
+backslash/comma/equals/space, and non-finite floats are skipped — a
+NaN field would poison the whole line at parse time.
 """
 
 from __future__ import annotations
 
+import math
+import numbers
+import re
+
+import numpy as np
+
 from ..ingest.sender import UniformSender
+from ..storage.store import ColumnSpec, TableSchema
 from ..utils.stats import StatsPoint
+
+
+def _escape_tag(v: str) -> str:
+    """Influx line-protocol tag-value escaping: backslash first, then
+    the three structural characters (`,` `=` space)."""
+    return (
+        v.replace("\\", "\\\\")
+        .replace(",", "\\,")
+        .replace("=", "\\=")
+        .replace(" ", "\\ ")
+    )
 
 
 def points_to_influx(points: list[StatsPoint]) -> str:
     lines = []
     for p in points:
-        tags = "".join(
-            f",{k}={str(v).replace(' ', '_').replace(',', '_')}" for k, v in p.tags
-        )
-        fields = ",".join(
-            f"{k}={float(v)}" for k, v in p.fields.items() if isinstance(v, (int, float))
-        )
-        if not fields:
+        tags = "".join(f",{k}={_escape_tag(str(v))}" for k, v in p.tags)
+        parts = []
+        for k, v in p.fields.items():
+            if isinstance(v, bool):
+                v = int(v)
+            if isinstance(v, numbers.Integral):
+                parts.append(f"{k}={int(v)}i")  # keep influx int typing
+            elif isinstance(v, numbers.Real):
+                f = float(v)
+                if math.isfinite(f):  # NaN/inf poison the line — skip
+                    parts.append(f"{k}={f}")
+        if not parts:
             continue
-        lines.append(f"{p.module}{tags} {fields} {int(p.timestamp * 1e9)}")
+        lines.append(f"{p.module}{tags} {','.join(parts)} {int(p.timestamp * 1e9)}")
     return "\n".join(lines)
 
 
@@ -38,5 +78,92 @@ def stats_sink(sender: UniformSender):
         text = points_to_influx(points)
         if text:
             sender.send([text.encode()])
+
+    return sink
+
+
+# ---------------------------------------------------------------------------
+# deepflow_system: the dogfooded self-telemetry table (ISSUE 3). Same
+# row shape as prometheus.samples so BOTH query engines read it: the
+# SQL engine resolves `deepflow_system.deepflow_system` directly, and
+# promql.query_instant/query_range accept db/table overrides.
+
+DEEPFLOW_SYSTEM_DB = "deepflow_system"
+DEEPFLOW_SYSTEM_TABLE = "deepflow_system"
+# metric/labels are variable-width ("O", the ClickHouse-String analogue
+# the store serializes per-part) — a fixed U<n> would silently clip a
+# long packed label string, possibly mid-escape, and a PromQL selector
+# would then match nothing with no error
+DEEPFLOW_SYSTEM_SCHEMA = TableSchema(
+    DEEPFLOW_SYSTEM_TABLE,
+    (
+        ColumnSpec("time", "u4"),
+        ColumnSpec("metric", "O"),
+        ColumnSpec("labels", "O"),
+        ColumnSpec("value", "f8"),
+    ),
+)
+
+_METRIC_SAN_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def system_metric_name(module: str, field: str) -> str:
+    """`<module>_<field>` sanitized to the PromQL metric charset
+    ([a-zA-Z_:][a-zA-Z0-9_:]*) — span fields like `stats.fetch.count`
+    become `stats_fetch_count`."""
+    return _METRIC_SAN_RE.sub("_", f"{module}_{field}")
+
+
+def points_to_system_columns(points: list[StatsPoint]) -> dict[str, np.ndarray]:
+    """StatsPoints → deepflow_system columns, one row per (point, field).
+
+    Values store as f8 — integer counters up to 2^53 round-trip
+    bit-exactly (the acceptance test pins this). Non-finite and
+    non-numeric fields are skipped, same stance as points_to_influx."""
+    from .formats import pack_tags
+
+    time_col: list[int] = []
+    metric: list[str] = []
+    labels: list[str] = []
+    value: list[float] = []
+    for p in points:
+        packed = pack_tags({k: str(v) for k, v in p.tags})
+        for fname, v in p.fields.items():
+            if isinstance(v, bool):
+                v = int(v)
+            if not isinstance(v, numbers.Real):
+                continue
+            f = float(v)
+            if not math.isfinite(f):
+                continue
+            time_col.append(int(p.timestamp))
+            metric.append(system_metric_name(p.module, fname))
+            labels.append(packed)
+            value.append(f)
+    return {
+        "time": np.asarray(time_col, np.uint32),
+        "metric": np.asarray(metric, dtype=object),
+        "labels": np.asarray(labels, dtype=object),
+        "value": np.asarray(value, np.float64),
+    }
+
+
+def ensure_system_table(store) -> None:
+    store.create_table(DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_SCHEMA)
+
+
+def system_sink(store):
+    """→ a StatsCollector sink writing snapshots straight into the
+    store's deepflow_system table (no wire hop — this is the in-process
+    dogfood path the bench/test stacks use; production stacks keep the
+    DFSTATS wire loop as well)."""
+    ensure_system_table(store)
+
+    def sink(points: list[StatsPoint]) -> None:
+        if not points:
+            return
+        cols = points_to_system_columns(points)
+        if len(cols["time"]):
+            store.insert(DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE, cols)
 
     return sink

@@ -19,6 +19,7 @@ The scaling model (ARCHITECTURE.md §6, SURVEY §2.3):
 from __future__ import annotations
 
 import dataclasses
+import time
 from functools import partial
 
 import jax
@@ -32,8 +33,16 @@ try:  # jax ≥ 0.5 exports shard_map at top level
 except ImportError:  # pragma: no cover - 0.4.x fallback
     from jax.experimental.shard_map import shard_map
 
+from ..aggregator import window as window_mod
 from ..aggregator.fanout import FANOUT_LANES, FanoutConfig
 from ..aggregator.pipeline import make_ingest_step
+from ..utils.spans import (
+    SPAN_FLUSH_DRAIN,
+    SPAN_INGEST_DISPATCH,
+    SPAN_WINDOW_ADVANCE,
+    SpanTracer,
+)
+from ..utils.stats import register_countable
 from ..aggregator.stash import (
     AccumState,
     StashState,
@@ -333,7 +342,8 @@ class ShardedWindowManager:
     DocBatches from the per-device stashes at every window close.
     """
 
-    def __init__(self, pipe: ShardedPipeline, delay: int = 2):
+    def __init__(self, pipe: ShardedPipeline, delay: int = 2,
+                 *, tracer: SpanTracer | None = None):
         self.pipe = pipe
         self.interval = pipe.config.interval
         self.delay = delay
@@ -342,10 +352,59 @@ class ShardedWindowManager:
         self.fill = 0  # host-tracked per-device accumulator rows
         self.start_window: int | None = None
         self.drop_before_window = 0
+        self.total_docs_in = 0
         self.total_flushed = 0
+        self.n_advances = 0
         # merged sketch views of the last closed window (None until one closes)
         self.global_view = None
         self.pod_1m = None
+        # device↔host transfer accounting through the shared host_fetch
+        # seam (aggregator/window.py) — the perf gate shims that seam
+        # and asserts the per-ingest budget on this path too
+        self.host_fetches = 0
+        self.bytes_fetched = 0
+        self.bytes_uploaded = 0
+        self.tracer = tracer if tracer is not None else SpanTracer(
+            service="deepflow_tpu.sharded_pipeline"
+        )
+        register_countable(
+            "tpu_sharded_pipeline", self, devices=str(pipe.n_devices)
+        )
+        register_countable(
+            "tpu_sharded_pipeline_spans", self.tracer,
+            devices=str(pipe.n_devices),
+        )
+
+    def _fetch(self, x) -> np.ndarray:
+        """Every device→host transfer goes through the window module's
+        host_fetch seam (late-bound so the CI shim counts it), with
+        per-manager count + byte accounting on top."""
+        arr = window_mod.host_fetch(x)
+        self.host_fetches += 1
+        self.bytes_fetched += arr.nbytes
+        return arr
+
+    def get_counters(self) -> dict:
+        """Countable face — host ints only, safe from a ticking thread.
+
+        `flow_in` counts PRE-fanout flow rows (the sharded late gate
+        runs on raw flows host-side); the single-chip `doc_in` counts
+        post-fanout doc rows — deliberately different names so the two
+        planes cannot be misread as the same funnel stage."""
+        return {
+            "flow_in": self.total_docs_in,
+            "flushed_doc": self.total_flushed,
+            "drop_before_window": self.drop_before_window,
+            "acc_fill": self.fill,
+            "window_advances": self.n_advances,
+            "host_fetches": self.host_fetches,
+            "bytes_fetched": self.bytes_fetched,
+            "bytes_uploaded": self.bytes_uploaded,
+        }
+
+    def telemetry(self) -> dict:
+        """JSON-able counters + span summary (bench snapshot shape)."""
+        return {"counters": self.get_counters(), "spans": self.tracer.summary()}
 
     def _fold(self):
         if self.fill == 0 or self.acc is None:
@@ -367,11 +426,11 @@ class ShardedWindowManager:
         self.stash, packed, totals = self.pipe.flush_range(
             self.stash, np.uint32(lo), np.uint32(hi)
         )
-        totals_np = np.asarray(totals)  # [D]
+        totals_np = self._fetch(totals)  # [D]
         max_t = int(totals_np.max())
         if max_t == 0:
             return []
-        block = np.asarray(packed[:, :max_t])  # [D, max_t, 3+T+M]
+        block = self._fetch(packed[:, :max_t])  # [D, max_t, 3+T+M]
         per_dev = [
             unpack_flush_rows(block[d, : int(t)], TAG_SCHEMA.num_fields)
             for d, t in enumerate(totals_np)
@@ -411,9 +470,11 @@ class ShardedWindowManager:
 
         window_np = ts_np // self.interval
         late = valid_np & (window_np < self.start_window)
-        if late.any():
-            self.drop_before_window += int(late.sum())
+        n_late = int(late.sum())
+        if n_late:
+            self.drop_before_window += n_late
             valid = np.asarray(valid) & ~late
+        self.total_docs_in += int(valid_np.sum()) - n_late
 
         # Window advance is decided before the merge: the batch at t_max
         # belongs to the new window, so closing sketch planes first keeps
@@ -422,10 +483,18 @@ class ShardedWindowManager:
         # `delay` must land in their window before it flushes).
         new_start = max(t_max - self.delay, 0) // self.interval
         advancing = self.start_window < new_start
+        close_us, adv_wall = 0, 0.0
         if advancing:
-            self.sketches, self.global_view, self.pod_1m = self.pipe.window_close(
-                self.sketches
+            # the advance's work is split around the append (sketch close
+            # BEFORE, fold AFTER) — measured here, emitted below as ONE
+            # window.advance span so counts match `window_advances` and
+            # single-chip attribution
+            adv_wall = time.time()
+            t0 = time.perf_counter()
+            self.sketches, self.global_view, self.pod_1m = (
+                self.pipe.window_close(self.sketches)
             )
+            close_us = int((time.perf_counter() - t0) * 1e6)
 
         per_dev = int(ts_np.shape[0]) // self.pipe.n_devices
         # with the pre-reduce on, every append writes a 4×cap_u block
@@ -440,16 +509,31 @@ class ShardedWindowManager:
             self.fill = 0
         elif plan == "fold":
             self._fold()
-        self.stash, self.acc, self.sketches = self.pipe.step(
-            self.stash, self.acc, self.fill, self.sketches, tags, meters, valid
+        # .nbytes reads metadata only — np.asarray here would force a
+        # device→host transfer per column when callers pass jnp arrays
+        nb = lambda a: getattr(a, "nbytes", 0)
+        self.bytes_uploaded += (
+            sum(nb(v) for v in tags.values()) + nb(meters) + nb(valid)
         )
+        with self.tracer.span(SPAN_INGEST_DISPATCH):
+            self.stash, self.acc, self.sketches = self.pipe.step(
+                self.stash, self.acc, self.fill, self.sketches, tags, meters, valid
+            )
         self.fill += rows_per_device
 
         flushed = []
         if advancing:
+            t0 = time.perf_counter()
             self._fold()  # flushed windows must see every accumulated row
-            flushed = self._drain_range(self.start_window, new_start)
+            self.tracer.record(
+                SPAN_WINDOW_ADVANCE,
+                close_us + int((time.perf_counter() - t0) * 1e6),
+                start_s=adv_wall,
+            )
+            with self.tracer.span(SPAN_FLUSH_DRAIN):
+                flushed = self._drain_range(self.start_window, new_start)
             self.start_window = new_start
+            self.n_advances += 1
         return flushed
 
     def drain(self):
@@ -458,8 +542,12 @@ class ShardedWindowManager:
         re-open and re-emit it (same invariant as WindowManager.flush_all)."""
         from ..ops.segment import SENTINEL_SLOT
 
+        # shutdown fold stays OUTSIDE window.advance: the span count
+        # must equal `window_advances` (cross-path attribution contract;
+        # WindowManager.flush_all behaves the same)
         self._fold()
-        flushed = self._drain_range(0, int(SENTINEL_SLOT))
+        with self.tracer.span(SPAN_FLUSH_DRAIN):
+            flushed = self._drain_range(0, int(SENTINEL_SLOT))
         for db in flushed:
             if self.start_window is not None:
                 w = int(db.timestamp[0]) // self.interval

@@ -60,8 +60,14 @@ def query_instant(
     *,
     lookback_s: int = 300,
     db: str = "prometheus",
+    table: str = "samples",
 ) -> list[dict]:
-    """→ [{"labels": {...}, "value": float}] — instant vector at time t."""
+    """→ [{"labels": {...}, "value": float}] — instant vector at time t.
+
+    `db`/`table` default to the remote-write store; pass
+    db="deepflow_system", table="deepflow_system" to evaluate over the
+    framework's own dogfooded telemetry (integration/dfstats) — the
+    table shares the samples row shape by construction."""
     m = _QUERY_RE.match(query)
     if not m:
         raise PromQLError(f"unsupported query {query!r}")
@@ -77,7 +83,7 @@ def query_instant(
     if is_rate and not m.group("range"):
         raise PromQLError("rate() needs a [range]")
 
-    cols = store.scan(db, "samples", time_range=(t - window, t + 1))
+    cols = store.scan(db, table, time_range=(t - window, t + 1))
     sel = cols["metric"] == m.group("metric")
     labels_packed = cols["labels"]
     rows = np.nonzero(sel)[0]
@@ -151,6 +157,7 @@ def query_range(
     *,
     lookback_s: int = 300,
     db: str = "prometheus",
+    table: str = "samples",
 ) -> list[dict]:
     """Matrix result: [{"labels": {...}, "values": [[t, v], ...]}] — the
     /api/v1/query_range evaluation (each step is an instant evaluation,
@@ -161,7 +168,9 @@ def query_range(
         raise PromQLError("end < start")
     series: dict[tuple, dict] = {}
     for t in range(start, end + 1, step):
-        for row in query_instant(store, query, t, lookback_s=lookback_s, db=db):
+        for row in query_instant(
+            store, query, t, lookback_s=lookback_s, db=db, table=table
+        ):
             key = tuple(sorted(row["labels"].items()))
             s = series.get(key)
             if s is None:

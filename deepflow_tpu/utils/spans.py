@@ -1,0 +1,233 @@
+"""Host stage-span tracer for the pipeline's self-telemetry plane.
+
+The reference attributes latency per pipeline stage by shipping every
+component's counters through its own stats pipeline (stats.go:89-202);
+what it cannot see — and what the TPU build critically needs — is where
+a *host-driven* batch spends its wall time: dispatching the fused jit
+step, blocking on the stats fetch, advancing the window (fold + flush
+dispatch), draining packed flush rows, saving checkpoints. This module
+is that seam: a monotonic-clock span recorder with a fixed vocabulary
+of stage names, cheap enough to stay always-on (two perf_counter calls
+per span), exposing three faces:
+
+  * `summary()` — per-stage count/total/max/last aggregates for bench
+    JSON snapshots (BENCH files carry stage attribution);
+  * `get_counters()` — a flat Countable field map so the tracer
+    registers on `utils/stats.StatsCollector` like any component and
+    its aggregates dogfood into the `deepflow_system` table;
+  * `export_otlp(exporter)` — drains the recent-span ring through the
+    EXISTING OTLP exporter path (server/exporters.OtlpExporter's
+    l7_flow_log traces lane), so pipeline stages show up as spans in
+    whatever trace backend the exporter points at — including our own
+    IntegrationCollector round-trip.
+
+`JitCacheMonitor` rides along: retrace/compile counters for one jitted
+callable, read from the pjit cache size — the CI gate asserts ZERO
+retraces across steady-state same-shape ingest so a shape leak (the
+silent compile-per-batch failure mode) trips loudly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+
+import numpy as np
+
+# The pipeline stage vocabulary (explicit names, ISSUE 3). Everything
+# the window managers emit uses these; ad-hoc names are allowed but the
+# docs/tests pin this set.
+SPAN_INGEST_DISPATCH = "ingest.dispatch"  # fused jit step dispatch (async — host-side cost)
+SPAN_STATS_FETCH = "stats.fetch"  # the ONE per-batch device→host stats sync
+SPAN_WINDOW_ADVANCE = "window.advance"  # fold + flush_range dispatch on window close
+SPAN_FLUSH_DRAIN = "flush.drain"  # packed flush fetch + per-window split
+SPAN_CHECKPOINT_SAVE = "checkpoint.save"  # window-state snapshot to .npz
+
+PIPELINE_SPAN_NAMES = (
+    SPAN_INGEST_DISPATCH,
+    SPAN_STATS_FETCH,
+    SPAN_WINDOW_ADVANCE,
+    SPAN_FLUSH_DRAIN,
+    SPAN_CHECKPOINT_SAVE,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class SpanRecord:
+    name: str
+    start_s: float  # wall-clock epoch seconds (for export timestamps)
+    duration_us: int  # monotonic-clock measured
+
+
+class _Agg:
+    __slots__ = ("count", "total_us", "max_us", "last_us")
+
+    def __init__(self):
+        self.count = 0
+        self.total_us = 0
+        self.max_us = 0
+        self.last_us = 0
+
+    def add(self, dur_us: int) -> None:
+        self.count += 1
+        self.total_us += dur_us
+        self.last_us = dur_us
+        if dur_us > self.max_us:
+            self.max_us = dur_us
+
+
+class SpanTracer:
+    """Monotonic-clock stage spans: aggregates always, ring for export."""
+
+    def __init__(self, service: str = "deepflow_tpu.pipeline", ring_size: int = 2048):
+        self.service = service
+        self._ring: deque[SpanRecord] = deque(maxlen=ring_size)
+        self._agg: dict[str, _Agg] = {}
+        self._lock = threading.Lock()
+        self._seq = 0
+
+    @contextmanager
+    def span(self, name: str):
+        wall = time.time()
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record(name, int((time.perf_counter() - t0) * 1e6), start_s=wall)
+
+    def record(self, name: str, duration_us: int, start_s: float | None = None):
+        """Record a pre-measured span — for stages whose work is split
+        across non-contiguous host sections (e.g. the sharded advance:
+        sketch close before the append, fold after) that must count as
+        ONE logical span so cross-path stage attribution compares."""
+        rec = SpanRecord(name, time.time() if start_s is None else start_s,
+                         int(duration_us))
+        with self._lock:
+            self._ring.append(rec)
+            agg = self._agg.get(name)
+            if agg is None:
+                agg = self._agg[name] = _Agg()
+            agg.add(rec.duration_us)
+
+    # -- read faces -----------------------------------------------------
+    def summary(self) -> dict[str, dict]:
+        """Per-stage aggregates, JSON-able (the bench snapshot shape)."""
+        with self._lock:
+            return {
+                name: {
+                    "count": a.count,
+                    "total_us": a.total_us,
+                    "avg_us": round(a.total_us / a.count, 1) if a.count else 0.0,
+                    "max_us": a.max_us,
+                    "last_us": a.last_us,
+                }
+                for name, a in sorted(self._agg.items())
+            }
+
+    def get_counters(self) -> dict[str, int]:
+        """Countable face: flat `<stage>.count/.total_us/.max_us` fields."""
+        with self._lock:
+            out: dict[str, int] = {}
+            for name, a in sorted(self._agg.items()):
+                out[f"{name}.count"] = a.count
+                out[f"{name}.total_us"] = a.total_us
+                out[f"{name}.max_us"] = a.max_us
+            return out
+
+    def recent(self, name: str | None = None) -> list[SpanRecord]:
+        with self._lock:
+            recs = list(self._ring)
+        if name is not None:
+            recs = [r for r in recs if r.name == name]
+        return recs
+
+    def drain(self) -> list[SpanRecord]:
+        """Pop and return the ring (export-once semantics)."""
+        with self._lock:
+            recs = list(self._ring)
+            self._ring.clear()
+        return recs
+
+    # -- OTLP export ------------------------------------------------------
+    def export_otlp(self, exporter, *, table: str = "l7_flow_log") -> int:
+        """Drain the span ring through an exporter's traces lane.
+
+        Builds l7_flow_log-shaped columns (app_service/endpoint/
+        start_time/response_duration + trace ids) and hands them to
+        `exporter.export(table, cols)` — the same path every other
+        trace row takes (server/exporters.OtlpExporter turns each row
+        into an OTel span). Returns the span count exported."""
+        recs = self.drain()
+        if not recs:
+            return 0
+        with self._lock:
+            seq0 = self._seq
+            self._seq += len(recs)
+        n = len(recs)
+        cols = {
+            "time": np.asarray([int(r.start_s) for r in recs], np.uint32),
+            "start_time": np.asarray([int(r.start_s) for r in recs], np.uint32),
+            "response_duration": np.asarray(
+                [r.duration_us for r in recs], np.uint32
+            ),
+            "app_service": np.asarray([self.service] * n),
+            "endpoint": np.asarray([r.name for r in recs]),
+            "trace_id": np.asarray(
+                [f"{seq0 + i + 1:032x}" for i in range(n)]
+            ),
+            "span_id": np.asarray([f"{seq0 + i + 1:016x}" for i in range(n)]),
+            "parent_span_id": np.asarray([""] * n),
+        }
+        exporter.export(table, cols)
+        return n
+
+
+class JitCacheMonitor:
+    """Compile/retrace counters for ONE jitted callable.
+
+    Reads the pjit executable-cache size (`fn._cache_size()`): the first
+    entry is the expected compile, every further entry is a RETRACE — a
+    shape/dtype/static-arg leak recompiling what steady state should
+    reuse. `poll()` is cheap (no device sync); call it after each
+    dispatch. Degrades to zeros on jax builds without the cache probe.
+    """
+
+    def __init__(self, fn=None):
+        self._fn = fn
+        self._size = 0
+        self.compiles = 0
+        self.retraces = 0
+        # poll() runs from the ingest loop AND a ticking StatsCollector
+        # thread (the pipeline registers itself); the read-modify-write
+        # on _size must not double-count one cache growth
+        self._lock = threading.Lock()
+
+    def attach(self, fn) -> None:
+        """Point at a (new) jitted callable; cumulative counts survive."""
+        with self._lock:
+            self._fn = fn
+            self._size = 0
+
+    def poll(self) -> tuple[int, int]:
+        """→ (compiles, retraces), updated from the current cache size."""
+        with self._lock:
+            if self._fn is not None:
+                try:
+                    size = int(self._fn._cache_size())
+                except Exception:  # pragma: no cover - probe-less jax build
+                    size = self._size
+                grew = size - self._size
+                if grew > 0:
+                    if self._size == 0:
+                        self.compiles += 1
+                        grew -= 1
+                    self.retraces += grew
+                self._size = size
+            return self.compiles, self.retraces
+
+    def get_counters(self) -> dict[str, int]:
+        self.poll()
+        return {"jit_compiles": self.compiles, "jit_retraces": self.retraces}

@@ -17,11 +17,14 @@ tags) with an integer/float field map.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import threading
 import time
 import weakref
 from collections import deque
 from typing import Callable, Mapping, Protocol, runtime_checkable
+
+_log = logging.getLogger(__name__)
 
 
 @runtime_checkable
@@ -40,11 +43,12 @@ class StatsPoint:
 class CounterSource:
     """One registered countable: weakly held, tagged."""
 
-    __slots__ = ("module", "tags", "_ref", "_fn")
+    __slots__ = ("module", "tags", "_ref", "_fn", "failures")
 
     def __init__(self, module: str, tags: dict[str, str], countable):
         self.module = module
         self.tags = tuple(sorted(tags.items()))
+        self.failures = 0  # consecutive get_counters() exceptions
         if callable(countable) and not isinstance(countable, Countable):
             # plain closures can't be weakly bound to a component lifetime;
             # hold them strongly (caller owns deregistration)
@@ -53,6 +57,11 @@ class CounterSource:
         else:
             self._ref = weakref.ref(countable)
             self._fn = None
+
+    def dead(self) -> bool:
+        """Weakly-bound component already collected (callable sources
+        are owner-deregistered, never dead)."""
+        return self._ref is not None and self._ref() is None
 
     def sample(self) -> Mapping[str, int | float] | None:
         if self._fn is not None:
@@ -72,8 +81,12 @@ class StatsCollector:
     counter map (strongly held; `deregister` to remove).
     """
 
+    # consecutive sample failures before a source is dropped (logged once)
+    MAX_SOURCE_FAILURES = 3
+
     def __init__(self, interval_s: float = 10.0, ring_size: int = 4096):
         self.interval_s = interval_s
+        self.n_source_errors = 0  # total get_counters() exceptions seen
         self._sources: list[CounterSource] = []
         self._sinks: list[Callable[[list[StatsPoint]], None]] = []
         self._ring: deque[StatsPoint] = deque(maxlen=ring_size)
@@ -85,6 +98,10 @@ class StatsCollector:
     def register(self, module: str, countable, **tags: str) -> CounterSource:
         src = CounterSource(module, tags, countable)
         with self._lock:
+            # prune dead weakrefs here too: components auto-register at
+            # construction (pipelines, exporters), so a process that
+            # never ticks must not grow the source list unboundedly
+            self._sources = [s for s in self._sources if not s.dead()]
             self._sources.append(src)
         return src
 
@@ -103,7 +120,11 @@ class StatsCollector:
 
         Samples run outside the lock (a callback may register/deregister)
         and are exception-guarded — one broken component must not kill
-        self-telemetry for the rest.
+        self-telemetry for the rest. Failures are COUNTED
+        (`n_source_errors`), and a source that fails
+        MAX_SOURCE_FAILURES times in a row is dropped with one warning
+        log — a permanently broken Countable must not silently eat a
+        slot (or mask everyone else's points) forever.
         """
         now = time.time() if now is None else now
         points: list[StatsPoint] = []
@@ -114,7 +135,19 @@ class StatsCollector:
             try:
                 fields = src.sample()
             except Exception:
+                with self._lock:
+                    self.n_source_errors += 1
+                src.failures += 1
+                if src.failures >= self.MAX_SOURCE_FAILURES:
+                    dead.append(src)
+                    _log.warning(
+                        "stats source %s%s dropped after %d consecutive "
+                        "sample errors",
+                        src.module, dict(src.tags) or "", src.failures,
+                        exc_info=True,
+                    )
                 continue
+            src.failures = 0
             if fields is None:  # component died → auto-deregister
                 dead.append(src)
                 continue

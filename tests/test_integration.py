@@ -205,7 +205,15 @@ def test_points_to_influx_format():
     text = points_to_influx(
         [StatsPoint(float(T0), "writer", (("db", "flow metrics"),), {"rows": 5})]
     )
-    assert text == f"writer,db=flow_metrics rows=5.0 {T0}000000000"
+    # ints keep influx `i` typing; tag values escape, not mangle
+    assert text == f"writer,db=flow\\ metrics rows=5i {T0}000000000"
+    # ...and the frame decodes back to the original tag value + int
+    from deepflow_tpu.integration.formats import parse_influx_lines
+
+    points, errors = parse_influx_lines(text)
+    assert errors == 0
+    assert points[0].tags == {"db": "flow metrics"}
+    assert points[0].fields == {"rows": 5.0}
 
 
 def test_promql_queries():

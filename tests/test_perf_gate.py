@@ -178,6 +178,106 @@ def test_window_ingest_host_sync_budget(monkeypatch):
     before = counts["n"]
     _ = pipe.counters
     assert counts["n"] - before <= 2
+    # the Countable face must be FETCH-FREE (a ticking collector thread
+    # samples it mid-ingest) while still carrying the device counter
+    # block's lanes and the transfer accounting
+    before = counts["n"]
+    c = pipe.get_counters()
+    assert counts["n"] - before == 0
+    for key in ("stash_occupancy", "stash_evictions", "excess_word_hits",
+                "host_fetches", "bytes_fetched", "bytes_uploaded"):
+        assert key in c
+    assert c["host_fetches"] > 0 and c["bytes_fetched"] > 0
+    assert c["bytes_uploaded"] > 0
+
+
+def test_sharded_window_ingest_host_sync_budget(monkeypatch):
+    """The sharded twin of the budget gate: ShardedWindowManager
+    ingest/drain under the same host_fetch shim — the per-ingest fetch
+    count must stay ≤ SYNC_BUDGET regardless of device count (the
+    batched drain fetches ONE [D] totals vector + ONE [D, max_t] row
+    block, never per-shard transfers), and the transfer-byte counter
+    must account every fetched byte."""
+    import deepflow_tpu.aggregator.window as window_mod
+    from deepflow_tpu.ops.histogram import LogHistSpec
+    from deepflow_tpu.parallel.mesh import make_mesh
+    from deepflow_tpu.parallel.sharded import (
+        ShardedConfig,
+        ShardedPipeline,
+        ShardedWindowManager,
+    )
+
+    counts = {"n": 0, "bytes": 0}
+    real_fetch = window_mod.host_fetch
+
+    def counting_fetch(x):
+        counts["n"] += 1
+        arr = real_fetch(x)
+        counts["bytes"] += arr.nbytes
+        return arr
+
+    monkeypatch.setattr(window_mod, "host_fetch", counting_fetch)
+
+    gen = SyntheticFlowGen(num_tuples=200, seed=5)
+    t0 = 1_700_000_000
+    per_ingest: dict[int, list[int]] = {}
+    for n_dev in (1, 4):
+        mesh = make_mesh(n_dev)
+        cfg = ShardedConfig(
+            capacity_per_device=1 << 10, num_services=16, hll_precision=6,
+            hist=LogHistSpec(bins=64, vmin=1.0, gamma=1.3),
+        )
+        wm = ShardedWindowManager(ShardedPipeline(mesh, cfg))
+        n0, b0 = counts["n"], counts["bytes"]
+        fetches = []
+        for t in (t0, t0 + 1, t0 + 4, t0 + 104, t0 + 105):
+            fb = gen.flow_batch(64 * n_dev, t)
+            before = counts["n"]
+            wm.ingest(fb.tags, fb.meters, fb.valid)
+            fetches.append(counts["n"] - before)
+        per_ingest[n_dev] = fetches
+        assert max(fetches) <= SYNC_BUDGET, (n_dev, fetches)
+        before = counts["n"]
+        wm.drain()
+        assert counts["n"] - before <= SYNC_BUDGET
+        # transfer accounting: the manager's counters mirror exactly what
+        # the shim saw for this manager (count AND bytes)
+        c = wm.get_counters()
+        assert c["host_fetches"] == counts["n"] - n0
+        assert c["bytes_fetched"] == counts["bytes"] - b0
+        assert c["bytes_uploaded"] > 0
+    # the budget must not scale with shard count
+    assert max(per_ingest[4]) <= max(per_ingest[1]) + 0
+
+
+def test_jit_retrace_gate():
+    """Steady-state windowed ingest over K same-shape batches must
+    trigger ZERO recompiles of the fused step (the silent
+    compile-per-batch failure mode a shape/weak-type leak reintroduces).
+    Asserted via the pipeline's JitCacheMonitor retrace counter."""
+    from deepflow_tpu.aggregator.pipeline import L4Pipeline, PipelineConfig
+    from deepflow_tpu.aggregator.window import WindowConfig
+    from deepflow_tpu.datamodel.batch import FlowBatch
+
+    pipe = L4Pipeline(
+        PipelineConfig(window=WindowConfig(capacity=1 << 12), batch_size=256)
+    )
+    gen = SyntheticFlowGen(num_tuples=200, seed=7)
+    t0 = 1_700_000_000
+    # warmup: first batch compiles the fused step (counted as a compile)
+    pipe.ingest(FlowBatch.from_records(gen.records(128, t0)))
+    c = pipe.get_counters()
+    assert c["jit_compiles"] == 1, c
+    base_retraces = c["jit_retraces"]
+    # steady state: same shape, advancing timestamps (window closes ride
+    # along) — K batches, zero retraces allowed
+    for i in range(6):
+        pipe.ingest(FlowBatch.from_records(gen.records(128, t0 + 1 + i)))
+    c = pipe.get_counters()
+    assert c["jit_retraces"] == base_retraces == 0, (
+        f"fused step recompiled during steady-state same-shape ingest "
+        f"(retraces={c['jit_retraces']}) — shape leak"
+    )
 
 
 # ---------------------------------------------------------------------------
