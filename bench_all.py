@@ -359,12 +359,38 @@ def config5(quick: bool):
          telemetry=_telemetry(wm))
 
 
+def config6(quick: bool):
+    """Feeder runtime (ISSUE 4): wire-to-window rate through multi-queue
+    fan-in + bucket coalescing + the K-batch counter ring. Runs
+    bench/feeder_probe.py in a clean CPU subprocess (the probe pins
+    JAX_PLATFORMS=cpu; on-chip columns pending, PERF.md §14) and
+    re-emits its record; the vs line is host-fetches-per-batch — the
+    lever this subsystem exists to push below 1."""
+    import os
+    import subprocess
+
+    env = {**os.environ, "FEEDER_ITERS": "16" if quick else "48"}
+    out = subprocess.run(
+        [sys.executable, "bench/feeder_probe.py"],
+        capture_output=True, text=True, timeout=900, env=env,
+    )
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    emit("c6_feeder_wire_to_window", rec["rec_s"], "records/s",
+         rec["fetches_per_batch"], **{
+             k: rec[k] for k in (
+                 "batches", "host_fetches", "stats_ring", "buckets",
+                 "jit_retraces", "jit_compiles", "shed_records", "pad_rows",
+             )
+         }, telemetry=rec.get("telemetry"),
+         feeder_telemetry=rec.get("feeder_telemetry"))
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--cpu", action="store_true")
     p.add_argument("--quick", action="store_true")
     args = p.parse_args()
-    for fn in (config1, config2, config3, config4, config5):
+    for fn in (config1, config2, config3, config4, config5, config6):
         try:
             fn(args.quick)
         except Exception as e:  # one config must not kill the others

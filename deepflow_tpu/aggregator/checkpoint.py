@@ -12,11 +12,11 @@ Format v2: ONE packed u32 matrix per direction — the stash leaves
 single [4+T+M, S] array fetched in one transfer, and restore uploads one
 matrix and splits it back in a single jitted call. v1 paid the PERF.md
 §8 per-leaf transfer tax: 7 stash + 5 accumulator round trips per
-save/restore. v1 checkpoints still load FORMAT-wise — but note v1 files
-predate the r6 packed-word key fingerprint, so their stash keys will
-not merge with freshly-hashed rows for the same logical key (the same
-caveat any pre-r6 in-flight state has); treat a resumed v1 stash as
-flush-only.
+save/restore. The v1 LOAD branch was removed after two rounds of
+v2-only writers (ROADMAP): v1 files also predate the r6 packed-word key
+fingerprint, so their stash keys could never merge with freshly-hashed
+rows anyway — loading one now raises with a re-save instruction instead
+of resuming into silently unmergeable state.
 """
 
 from __future__ import annotations
@@ -101,11 +101,13 @@ def save_window_state(wm: WindowManager, path: str | Path):
             "total_flushed": wm.total_flushed,
             "aux_count": wm.aux_count,
             "excess_word_hits": wm.excess_word_hits,
+            "feeder_shed": wm.feeder_shed,
             "interval": wm.config.interval,
             "delay": wm.config.delay,
             "capacity": wm.config.capacity,
             "accum_batches": wm.config.accum_batches,
             "async_drain": wm.config.async_drain,
+            "stats_ring": wm.config.stats_ring,
         }
         buf = io.BytesIO()
         np.savez_compressed(
@@ -120,7 +122,17 @@ def load_window_state(
 ) -> WindowManager:
     with np.load(io.BytesIO(Path(path).read_bytes())) as z:
         meta = json.loads(bytes(z["meta"]).decode())
-        if meta["version"] not in (1, _VERSION):
+        if meta["version"] == 1:
+            # v1 readers were dropped once two rounds had shipped with
+            # v2-only writers (ROADMAP). No silent fallback: a v1 stash
+            # predates the packed-word key fingerprint and could never
+            # merge with freshly-hashed rows.
+            raise ValueError(
+                "checkpoint format v1 is unsupported (v1 load support was "
+                "removed after v2 writers shipped); re-save the window "
+                "state with a v2 writer"
+            )
+        if meta["version"] != _VERSION:
             raise ValueError(f"checkpoint version {meta['version']} != {_VERSION}")
         cfg = WindowConfig(
             interval=meta["interval"],
@@ -128,10 +140,11 @@ def load_window_state(
             capacity=meta["capacity"],
             accum_batches=meta["accum_batches"],
             async_drain=meta.get("async_drain", False),
+            stats_ring=meta.get("stats_ring", 1),
         )
         wm = WindowManager(cfg, tag_schema, meter_schema)
         t = tag_schema.num_fields
-        if meta["version"] == _VERSION and meta["num_tags"] != t:
+        if meta["num_tags"] != t:
             # the packed split is shape-valid for ANY num_tags — a
             # mismatch would bit-cast misaligned words into meters
             # silently, so schema drift must fail loudly
@@ -139,33 +152,14 @@ def load_window_state(
                 f"checkpoint tag schema width {meta['num_tags']} != "
                 f"{t} ({tag_schema.__class__.__name__}); cannot unpack"
             )
-        if meta["version"] == 1:
-            wm.state = StashState(
-                slot=jnp.asarray(z["stash_slot"]),
-                key_hi=jnp.asarray(z["stash_key_hi"]),
-                key_lo=jnp.asarray(z["stash_key_lo"]),
-                tags=jnp.asarray(z["stash_tags"]),
-                meters=jnp.asarray(z["stash_meters"]),
-                valid=jnp.asarray(z["stash_valid"]),
-                dropped_overflow=jnp.asarray(z["stash_dropped"]),
-            )
-            if "acc_slot" in z:
-                wm.acc = AccumState(
-                    slot=jnp.asarray(z["acc_slot"]),
-                    key_hi=jnp.asarray(z["acc_key_hi"]),
-                    key_lo=jnp.asarray(z["acc_key_lo"]),
-                    tags=jnp.asarray(z["acc_tags"]),
-                    meters=jnp.asarray(z["acc_meters"]),
-                )
-        else:
-            # one upload + one jitted split per direction
-            wm.state = _unpack_stash(
-                jnp.asarray(z["stash_packed"]),
-                np.int32(meta["dropped_overflow"]),
-                num_tags=t,
-            )
-            if "acc_packed" in z:
-                wm.acc = _unpack_acc(jnp.asarray(z["acc_packed"]), num_tags=t)
+        # one upload + one jitted split per direction
+        wm.state = _unpack_stash(
+            jnp.asarray(z["stash_packed"]),
+            np.int32(meta["dropped_overflow"]),
+            num_tags=t,
+        )
+        if "acc_packed" in z:
+            wm.acc = _unpack_acc(jnp.asarray(z["acc_packed"]), num_tags=t)
         wm.fill = meta["fill"]
         wm.start_window = meta["start_window"]
         wm.drop_before_window = meta["drop_before_window"]
@@ -174,4 +168,8 @@ def load_window_state(
         # telemetry counters landed after v2 writers; absent = 0
         wm.aux_count = meta.get("aux_count", 0)
         wm.excess_word_hits = meta.get("excess_word_hits", 0)
+        wm.feeder_shed = meta.get("feeder_shed", 0)
+        # the save settled (ring drained), so the restored host span IS
+        # the device gate state — mirror it back onto the device
+        wm._sync_device_sw()
     return wm

@@ -158,10 +158,38 @@ class PipelineConfig:
     batch_size: int = 4096  # static pad size for flow batches
     # batch-local pre-reduce before fanout (batch_prereduce); None = off
     batch_unique_cap: int | None = None
+    # Shape buckets (ISSUE 4): when set, each ingested batch pads to the
+    # smallest bucket ≥ its row count instead of to batch_size. The
+    # fused step compiles ONCE per bucket (JitCacheMonitor's
+    # expected_compiles budget covers them — anything beyond is still a
+    # retrace), so mixed-size feeder traffic never recompiles in steady
+    # state. Must be sorted unique; batches larger than max(buckets) are
+    # a caller error (the feeder slices to max(buckets)).
+    bucket_sizes: tuple[int, ...] | None = None
+
+    def __post_init__(self):
+        if self.bucket_sizes is not None:
+            bs = tuple(self.bucket_sizes)
+            if not bs or list(bs) != sorted(set(bs)) or bs[0] <= 0:
+                raise ValueError(
+                    f"bucket_sizes must be sorted unique positive ints, got {bs}"
+                )
 
 
 # Back-compat alias (bench/entry scripts predate the L7 pipeline).
 L4PipelineConfig = PipelineConfig
+
+
+@dataclasses.dataclass
+class StagedBatch:
+    """A bucket-padded batch whose device upload has been dispatched
+    (RollupPipeline.stage) but whose fused step has not yet run — the
+    double-buffer unit the feeder runtime holds one of."""
+
+    tag_mat: jnp.ndarray  # [T, B] u32 packed tag matrix (device)
+    meters: jnp.ndarray  # [B, M] f32 (device)
+    valid: jnp.ndarray  # [B] bool (device)
+    padded_rows: int  # B — the bucket this batch padded to
 
 
 class RollupPipeline:
@@ -178,7 +206,11 @@ class RollupPipeline:
         self.config = config
         self.wm = WindowManager(config.window, TAG_SCHEMA, self.meter_schema)
         self.tracer = self.wm.tracer  # host stage spans (utils/spans)
-        self._jit = JitCacheMonitor()  # retrace gate for the fused step
+        # retrace gate for the fused step: one expected compile per
+        # shape bucket; any growth beyond that is a real retrace
+        self._jit = JitCacheMonitor(
+            expected_compiles=len(config.bucket_sizes or ()) or 1
+        )
         self._tag_names: tuple | None = None  # fixed on first batch
         self._step = None
         # self-telemetry registration (reference RegisterCountable stance:
@@ -207,7 +239,7 @@ class RollupPipeline:
         fanout_fn = self.fanout_fn
 
         def step(acc, offset, start_window, stash_valid, stash_evict,
-                 tag_mat, meters, valid):
+                 feeder_shed, tag_mat, meters, valid):
             tags = {k: tag_mat[i] for i, k in enumerate(names)}
             aux = None
             if cap_u is not None:
@@ -225,6 +257,7 @@ class RollupPipeline:
                 ts, doc_valid, start_window, interval, aux=aux,
                 excess_hits=excess_hits, stash_valid=stash_valid,
                 stash_evictions=stash_evict, ring_fill=offset,
+                feeder_shed=feeder_shed,
             )
             acc = _append_impl(
                 acc, window, hi, lo, doc_tags, doc_meters, gated, offset
@@ -233,14 +266,30 @@ class RollupPipeline:
 
         return jax.jit(step, donate_argnums=(0,))
 
-    def ingest(self, batch: FlowBatch) -> list[DocBatch]:
-        """Feed one decoded flow batch; returns any closed windows."""
-        batch = batch.pad_to(self.config.batch_size)
+    def _pad_target(self, rows: int) -> int:
+        """Static pad size for a batch of `rows`: the smallest bucket
+        that fits (bucketed mode) or the fixed batch_size."""
+        buckets = self.config.bucket_sizes
+        if not buckets:
+            return self.config.batch_size
+        for b in buckets:
+            if rows <= b:
+                return b
+        raise ValueError(
+            f"batch of {rows} rows exceeds the largest shape bucket "
+            f"{buckets[-1]}; the feeder must slice to max(bucket_sizes)"
+        )
+
+    def stage(self, batch: FlowBatch) -> "StagedBatch | None":
+        """Pad to the shape bucket and START the host→device upload of
+        the packed tag matrix + meters + valid (JAX device puts are
+        async) WITHOUT dispatching the fused step. The feeder runtime
+        stages batch i+1 while batch i's dispatch is still in flight —
+        the upload overlaps compute, mirroring async_drain on the
+        output side. Returns None for an all-padding batch."""
+        batch = batch.pad_to(self._pad_target(batch.size))
         if not np.any(batch.valid):
-            # idle heartbeat: skip the upload/append (it would burn ring
-            # rows and force empty folds); still settle any deferred
-            # async-drain buffers so closed windows aren't held up
-            return [self._to_docbatch(f) for f in self.wm.settle()]
+            return None
         if self._tag_names is None:
             self._tag_names = tuple(sorted(batch.tags))
             self._step = self._build_step(self._tag_names)
@@ -256,11 +305,34 @@ class RollupPipeline:
         self.wm.bytes_uploaded += (
             tag_mat.nbytes + meters.nbytes + valid.nbytes
         )
+        return StagedBatch(tag_mat=tag_mat, meters=meters, valid=valid,
+                           padded_rows=batch.size)
+
+    def ingest(self, batch: FlowBatch, feeder_shed: int = 0) -> list[DocBatch]:
+        """Feed one decoded flow batch; returns any closed windows."""
+        staged = self.stage(batch)
+        if staged is None:
+            # idle heartbeat: skip the upload/append (it would burn ring
+            # rows and force empty folds); still settle any deferred
+            # async-drain buffers so closed windows aren't held up
+            return [self._to_docbatch(f) for f in self.wm.settle()]
+        return self.ingest_staged(staged, feeder_shed=feeder_shed)
+
+    def ingest_staged(
+        self, staged: "StagedBatch", feeder_shed: int = 0
+    ) -> list[DocBatch]:
+        """Dispatch the fused step for an already-staged batch."""
         # with the pre-reduce on, the append writes a FANOUT_LANES×cap_u
         # block (static groupby output) regardless of batch rows
-        rows = FANOUT_LANES * (
-            self.config.batch_unique_cap or self.config.batch_size
+        cap_u = self.config.batch_unique_cap
+        rows = FANOUT_LANES * (cap_u or staged.padded_rows)
+        # size the accumulator ring for the LARGEST bucket up front so a
+        # small first bucket doesn't build a ring a later one replaces
+        max_rows = FANOUT_LANES * (
+            cap_u
+            or (self.config.bucket_sizes or (self.config.batch_size,))[-1]
         )
+        shed = jnp.uint32(feeder_shed)
 
         def dispatch(acc, offset, start_window):
             # stash lanes read at dispatch time (post any fold) — device
@@ -269,10 +341,10 @@ class RollupPipeline:
             st = self.wm.state
             return self._step(
                 acc, offset, start_window, st.valid, st.dropped_overflow,
-                tag_mat, meters, valid,
+                shed, staged.tag_mat, staged.meters, staged.valid,
             )
 
-        flushed = self.wm.ingest_step(dispatch, rows)
+        flushed = self.wm.ingest_step(dispatch, rows, ring_rows=max_rows)
         self._jit.poll()
         return [self._to_docbatch(f) for f in flushed]
 

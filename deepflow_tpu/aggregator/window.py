@@ -18,12 +18,16 @@ output because merges are commutative per window.
 
 Host-sync budget (PERF.md §8: every device→host fetch costs a fixed
 ~150-200 ms round trip on the TPU tunnel): steady-state `ingest` performs
-exactly ONE tiny fetch per batch — the versioned on-device COUNTER BLOCK
+AT MOST one tiny fetch per batch — the versioned on-device COUNTER BLOCK
 the jitted append step computes (late/valid/shed plus stash occupancy &
-evictions, packed-key excess-word hits and ring fill; see
+evictions, packed-key excess-word hits, ring fill and feeder shed; see
 COUNTER_BLOCK_VERSION / CB_* below) — plus two fetches per *window
 advance* (row count + the packed flush matrix), independent of batch
-size and of how many windows closed. All transfers route through
+size and of how many windows closed. With `WindowConfig.stats_ring = K`
+the blocks accumulate in a device-resident [K, CB_LEN] ring fetched
+once per K dispatches, dropping steady-state syncs to 1/K per batch
+(ISSUE 4; late gating moves to device state so flushed rows stay
+bit-exact vs per-batch fetching). All transfers route through
 `host_fetch` so the CI gate (tests/test_perf_gate.py) can count them and
 trip on a reintroduced per-row or per-window fetch; the managers also
 account fetch count and bytes per direction, and wrap each host stage
@@ -78,8 +82,11 @@ def host_fetch(x) -> np.ndarray:
 # CONTRACT between the device step and `_process_stats`; bump
 # COUNTER_BLOCK_VERSION when it changes (element 0 carries the version
 # so a stale host parser fails loudly instead of mis-slicing).
+# v2 (ISSUE 4): + feeder_shed — records the feeder runtime dropped
+# upstream of this batch's assembly, riding the same fetch so queue
+# pressure is visible in the device counter plane.
 
-COUNTER_BLOCK_VERSION = 1
+COUNTER_BLOCK_VERSION = 2
 (
     CB_VERSION,  # constant COUNTER_BLOCK_VERSION
     CB_T_MAX,  # max valid timestamp (pre-gate)
@@ -91,11 +98,13 @@ COUNTER_BLOCK_VERSION = 1
     CB_STASH_OCCUPANCY,  # valid stash rows at dispatch (post-fold)
     CB_STASH_EVICTIONS,  # cumulative stash overflow drops at dispatch
     CB_RING_FILL,  # accumulator rows already occupied at dispatch
-) = range(10)
-CB_LEN = 10
+    CB_FEEDER_SHED,  # records shed by the feeder before this batch
+) = range(11)
+CB_LEN = 11
 CB_FIELDS = (
     "version", "t_max", "t_min", "n_valid", "n_late", "prereduce_shed",
     "excess_word_hits", "stash_occupancy", "stash_evictions", "ring_fill",
+    "feeder_shed",
 )
 
 
@@ -134,15 +143,17 @@ def batch_counter_block(
     stash_valid=None,
     stash_evictions=None,
     ring_fill=None,
+    feeder_shed=None,
 ):
     """`batch_stats` widened into the versioned counter block (traced).
 
     Extra lanes ride the SAME single per-batch fetch: packed-key
     excess-word hits (the datamodel/code.py contract guard), stash
     occupancy summed from the (device-resident — zero transfer) valid
-    plane, cumulative eviction count, and the accumulator-ring fill at
-    dispatch. All optional inputs default to zero so every caller of
-    the old 5-vector shape can widen incrementally."""
+    plane, cumulative eviction count, the accumulator-ring fill at
+    dispatch, and the feeder's upstream shed count for this batch. All
+    optional inputs default to zero so every caller of the old 5-vector
+    shape can widen incrementally."""
     gated, window, stats = batch_stats(timestamp, valid, start_window, interval, aux=aux)
 
     def u32(x):
@@ -157,7 +168,8 @@ def batch_counter_block(
         [
             jnp.full((1,), COUNTER_BLOCK_VERSION, dtype=jnp.uint32),
             stats,
-            jnp.stack([u32(excess_hits), occ, u32(stash_evictions), u32(ring_fill)]),
+            jnp.stack([u32(excess_hits), occ, u32(stash_evictions),
+                       u32(ring_fill), u32(feeder_shed)]),
         ]
     )
     return gated, window, block
@@ -165,17 +177,53 @@ def batch_counter_block(
 
 @partial(jax.jit, donate_argnums=(0,), static_argnames=("interval",))
 def _raw_append_step(acc, offset, start_window, stash_valid, stash_evict,
-                     timestamp, key_hi, key_lo, tags, meters, valid, *, interval):
+                     feeder_shed, timestamp, key_hi, key_lo, tags, meters,
+                     valid, *, interval):
     """One jitted call per raw doc batch: late gate + counter block +
     ring append. `stash_valid`/`stash_evict` are device-resident stash
     lanes folded into the block — inputs already on device, no
-    transfer."""
+    transfer. `feeder_shed` is the feeder's upstream drop count for
+    this batch (a host scalar riding the upload direction)."""
     gated, window, block = batch_counter_block(
         timestamp, valid, start_window, interval,
         stash_valid=stash_valid, stash_evictions=stash_evict, ring_fill=offset,
+        feeder_shed=feeder_shed,
     )
     acc = _append_impl(acc, window, key_hi, key_lo, tags, meters, gated, offset)
     return acc, block
+
+
+@partial(jax.jit, donate_argnums=(0,), static_argnames=("interval", "delay"))
+def _stats_ring_push(ring, k, sw_state, block, *, interval, delay):
+    """Device side of the K-batch counter ring (ISSUE 4): write one
+    batch's counter block into the [K, CB_LEN] ring at row `k` and
+    advance the DEVICE-RESIDENT window-gate state — all without a host
+    sync, so the host fetches the whole ring once per K dispatches.
+
+    `sw_state` is [start_window, opened] u32. The update replicates
+    `_process_block`'s host bookkeeping exactly: after ANY non-empty
+    block the host span ends at max(previous, (t_max - delay) //
+    interval) — on the opening batch it first opens at
+    max(0, min(t_min, t_max - delay)) but then advances to that same
+    value within the SAME block (open_w ≤ adv_w always), so adv_w is
+    the post-block gate in both cases. The late gate of every deferred
+    batch therefore sees the SAME start_window it would have seen
+    under per-batch fetching — that invariant is what makes the K-ring
+    flush output bit-exact against the per-batch oracle: no row that
+    per-batch mode would late-drop can reach a window the deferred
+    flush later closes."""
+    ring = jax.lax.dynamic_update_slice(
+        ring, block[None, :].astype(jnp.uint32), (k, jnp.int32(0))
+    )
+    t_max = block[CB_T_MAX]
+    has = block[CB_N_VALID] > 0
+    # u32-safe max(0, t_max - delay)
+    t_adj = jnp.where(t_max > jnp.uint32(delay), t_max - jnp.uint32(delay),
+                      jnp.uint32(0))
+    adv_w = t_adj // jnp.uint32(interval)
+    new_sw = jnp.where(has, jnp.maximum(sw_state[0], adv_w), sw_state[0])
+    new_opened = ((sw_state[1] > 0) | has).astype(jnp.uint32)
+    return ring, jnp.stack([new_sw, new_opened])
 
 
 @dataclasses.dataclass(frozen=True)
@@ -199,6 +247,17 @@ class WindowConfig:
     # the flush are late-dropped either way), and counters trail by
     # ≤1 batch. flush_all()/drain()/settle() always settles.
     async_drain: bool = False
+    # K-batch counter ring (ISSUE 4): accumulate K batches' counter
+    # blocks into a device-resident [K, CB_LEN] ring and fetch ONCE per
+    # K dispatches — steady-state host syncs drop to 1/K per batch. The
+    # late gate moves to device-resident state (_stats_ring_push) so
+    # flushed rows stay bit-exact vs per-batch fetching; the cost is
+    # window-close latency of up to K-1 batches (drain-on-advance: any
+    # advance discovered at ring drain flushes immediately during the
+    # replay; drain-on-checkpoint: settle() always drains the partial
+    # ring first). 1 = per-batch fetch (today's behavior). Mutually
+    # exclusive with async_drain — the ring subsumes its deferral.
+    stats_ring: int = 1
 
     @property
     def ring(self) -> int:
@@ -234,6 +293,13 @@ class WindowManager:
         *,
         tracer: SpanTracer | None = None,
     ):
+        if config.stats_ring < 1:
+            raise ValueError("stats_ring must be >= 1")
+        if config.stats_ring > 1 and config.async_drain:
+            raise ValueError(
+                "stats_ring > 1 already defers stats fetches; combining it "
+                "with async_drain would double-defer — pick one"
+            )
         self.config = config
         self.tag_schema = tag_schema
         self.meter_schema = meter_schema
@@ -257,10 +323,22 @@ class WindowManager:
         self.host_fetches = 0
         self.bytes_fetched = 0
         self.bytes_uploaded = 0  # callers add their packed upload sizes
+        self.feeder_shed = 0  # CB_FEEDER_SHED lane mirror
         self.tracer = tracer if tracer is not None else SpanTracer()
         # async-drain double buffers (device handles, fetched next call)
         self._pending_stats = None
         self._pending_flush: list[tuple] = []
+        # K-batch counter ring (stats_ring > 1): device [K, CB_LEN] ring
+        # + device-resident [start_window, opened] gate state; the host
+        # mirror (start_window above) catches up at every ring drain.
+        self._cb_ring = (
+            jnp.zeros((config.stats_ring, CB_LEN), jnp.uint32)
+            if config.stats_ring > 1 else None
+        )
+        self._ring_count = 0  # blocks in the ring awaiting the fetch
+        self._sw_state = (
+            jnp.zeros((2,), jnp.uint32) if config.stats_ring > 1 else None
+        )
 
     def _fetch(self, x) -> np.ndarray:
         """host_fetch + per-manager transfer accounting (count + bytes)."""
@@ -320,14 +398,44 @@ class WindowManager:
 
     # -- stats processing (the ONE per-batch host sync) ------------------
     def _process_stats(self, stats_dev) -> None:
-        """Fetch one batch's packed counter block; update host counters,
-        advance the open span and dispatch (not fetch) the range flush.
+        """Fetch one batch's packed counter block and replay it through
+        the host bookkeeping (`_process_block`)."""
+        with self.tracer.span(SPAN_STATS_FETCH):
+            vec = [int(v) for v in self._fetch(stats_dev)]
+        self._process_block(vec)
+
+    def _drain_stats_ring(self) -> None:
+        """Fetch the filled prefix of the counter ring in ONE transfer
+        and replay every block in dispatch order — window advances land
+        exactly where per-batch fetching would have put them, just
+        discovered (and flushed) at the drain instead of mid-ring."""
+        if self._ring_count == 0:
+            return
+        with self.tracer.span(SPAN_STATS_FETCH):
+            rows = self._fetch(self._cb_ring[: self._ring_count])
+        self._ring_count = 0
+        for row in rows:
+            self._process_block([int(v) for v in row])
+
+    def _sync_device_sw(self) -> None:
+        """Reset the device gate state to the host span (checkpoint
+        restore / external start_window mutation). Only meaningful with
+        stats_ring > 1; requires a drained ring."""
+        if self._sw_state is None:
+            return
+        if self._ring_count:
+            raise RuntimeError("cannot resync device gate over a filled ring")
+        sw = 0 if self.start_window is None else self.start_window
+        opened = 0 if self.start_window is None else 1
+        self._sw_state = jnp.asarray([sw, opened], dtype=jnp.uint32)
+
+    def _process_block(self, vec: list[int]) -> None:
+        """One batch's counter block → host counters, open-span advance
+        and the (dispatched, not fetched) range flush.
 
         Accepts both the versioned CB_LEN block (element 0 =
         COUNTER_BLOCK_VERSION) and the legacy 5-scalar stats vector, so
         caller-supplied dispatch steps can widen incrementally."""
-        with self.tracer.span(SPAN_STATS_FETCH):
-            vec = [int(v) for v in self._fetch(stats_dev)]
         if len(vec) == CB_LEN:
             if vec[CB_VERSION] != COUNTER_BLOCK_VERSION:
                 raise ValueError(
@@ -339,8 +447,15 @@ class WindowManager:
             self.stash_occupancy = vec[CB_STASH_OCCUPANCY]
             self.stash_evictions = vec[CB_STASH_EVICTIONS]
             self.device_ring_fill = vec[CB_RING_FILL]
-        else:  # legacy [t_max, t_min, n_valid, n_late, aux]
+            self.feeder_shed += vec[CB_FEEDER_SHED]
+        elif len(vec) == 5:  # legacy [t_max, t_min, n_valid, n_late, aux]
             t_max, t_min, n_valid, n_late, aux = vec
+        else:
+            raise ValueError(
+                f"counter block of {len(vec)} lanes is neither the "
+                f"v{COUNTER_BLOCK_VERSION} CB_LEN={CB_LEN} block nor the "
+                "legacy 5-vector — device/host layout drift"
+            )
         self.aux_count += aux
         if n_valid == 0:
             return
@@ -384,12 +499,15 @@ class WindowManager:
         tags,
         meters,
         valid,
+        feeder_shed: int = 0,
     ) -> list[FlushedWindow]:
         """Merge a doc batch; advance and flush any windows that closed.
 
         Returns flushed windows in order (possibly empty). With
         `async_drain`, returns the windows closed by the *previous*
-        batch instead (double-buffered — see WindowConfig)."""
+        batch instead (double-buffered — see WindowConfig).
+        `feeder_shed` rides into the counter block's CB_FEEDER_SHED
+        lane (upstream drop accounting, ISSUE 4)."""
         timestamp = jnp.asarray(timestamp, dtype=jnp.uint32)
         rows = int(timestamp.shape[0])
         interval = self.config.interval
@@ -401,20 +519,26 @@ class WindowManager:
             st = self.state
             return _raw_append_step(
                 acc, offset, start_window, st.valid, st.dropped_overflow,
+                jnp.uint32(feeder_shed),
                 timestamp, key_hi, key_lo, tags, meters, valid,
                 interval=interval,
             )
 
         return self.ingest_step(dispatch, rows)
 
-    def ingest_step(self, dispatch, rows: int) -> list[FlushedWindow]:
+    def ingest_step(
+        self, dispatch, rows: int, ring_rows: int | None = None
+    ) -> list[FlushedWindow]:
         """Window protocol around a caller-supplied jitted append step.
 
         `dispatch(acc, offset, start_window)` must return (new_acc,
         stats[5]) with stats as produced by `batch_stats` — pipelines use
         this to fuse fanout/fingerprint/pre-reduce into the same single
         device call (aggregator/pipeline.py). `rows` is the static number
-        of accumulator rows the step appends."""
+        of accumulator rows the step appends; `ring_rows` (≥ rows) sizes
+        the accumulator ring when bucketed callers know a larger batch
+        shape is coming, so a small first bucket doesn't build a ring a
+        later big bucket immediately replaces."""
         if rows == 0:
             return self._settle_ready()
 
@@ -430,21 +554,38 @@ class WindowManager:
         plan = plan_append(self.fill, self.acc.capacity if self.acc else None, rows)
         if plan == "init":
             self._fold()  # pending rows must reach the stash before the ring is replaced
+            base = max(ring_rows or rows, rows)
             self.acc = accum_init(
-                max(self.config.accum_batches * rows, rows),
+                max(self.config.accum_batches * base, rows),
                 self.tag_schema,
                 self.meter_schema,
             )
         elif plan == "fold":
             self._fold()
-        sw = 0 if self.start_window is None else self.start_window
-        with self.tracer.span(SPAN_INGEST_DISPATCH):
-            self.acc, stats_dev = dispatch(
-                self.acc, jnp.int32(self.fill), jnp.uint32(sw)
+        K = self.config.stats_ring
+        if K > 1:
+            # the gate state is DEVICE-resident between ring drains —
+            # the host span may lag by up to K-1 batches, but the gate
+            # each batch sees matches per-batch mode exactly
+            sw_arg = self._sw_state[0]
+        else:
+            sw_arg = jnp.uint32(
+                0 if self.start_window is None else self.start_window
             )
+        with self.tracer.span(SPAN_INGEST_DISPATCH):
+            self.acc, stats_dev = dispatch(self.acc, jnp.int32(self.fill), sw_arg)
         self.fill += rows
 
-        if self.config.async_drain:
+        if K > 1:
+            self._cb_ring, self._sw_state = _stats_ring_push(
+                self._cb_ring, jnp.int32(self._ring_count), self._sw_state,
+                stats_dev,
+                interval=self.config.interval, delay=self.config.delay,
+            )
+            self._ring_count += 1
+            if self._ring_count >= K:
+                self._drain_stats_ring()
+        elif self.config.async_drain:
             # defer only the STATS fetch: the host returns before this
             # batch's compute finishes, and the previous batch's flush
             # (dispatched above, before this append) is fetched below —
@@ -463,15 +604,28 @@ class WindowManager:
         return self._drain_ready(ready)
 
     def settle(self) -> list[FlushedWindow]:
-        """Fetch every deferred async-drain buffer (pending stats +
-        dispatched flushes) so host counters/span are consistent with
-        the device. Returns the windows that were in flight — callers
-        that snapshot state (checkpoint.save_window_state) MUST emit
-        them, since their rows have already left the stash."""
+        """Fetch every deferred buffer (counter-ring blocks, pending
+        async stats, dispatched flushes) so host counters/span are
+        consistent with the device — the drain-on-checkpoint rule.
+        Returns the windows that were in flight — callers that snapshot
+        state (checkpoint.save_window_state) MUST emit them, since
+        their rows have already left the stash."""
+        self._drain_stats_ring()
         if self._pending_stats is not None:
             stats, self._pending_stats = self._pending_stats, None
             self._process_stats(stats)
         return self._settle_ready()
+
+    def make_feeder(self, queues, bucket_sizes, config=None, **kw):
+        """Wire this manager behind a feeder runtime: METRICS pb frames
+        from `queues` decode via ingest/codec.py and coalesce into
+        bucket-shaped doc appends (feeder/runtime.WindowManagerFeedSink)."""
+        from ..feeder import FeederConfig, FeederRuntime, WindowManagerFeedSink
+
+        return FeederRuntime(
+            queues, WindowManagerFeedSink(self, bucket_sizes),
+            config or FeederConfig(), **kw,
+        )
 
     def flush_all(self) -> list[FlushedWindow]:
         """Drain every open window (shutdown path)."""
@@ -486,6 +640,11 @@ class WindowManager:
         flushed += self._settle_ready()
         for f in flushed:
             self.start_window = max(self.start_window, f.window_idx + 1)
+        # the host span just jumped past every drained window; with a
+        # counter ring the DEVICE gate must follow, or a straggler
+        # ingest re-admits rows into already-emitted windows (the ring
+        # is drained — settle() above — so the resync is legal)
+        self._sync_device_sw()
         return flushed
 
     def get_counters(self) -> dict:
@@ -512,6 +671,11 @@ class WindowManager:
             "host_fetches": self.host_fetches,
             "bytes_fetched": self.bytes_fetched,
             "bytes_uploaded": self.bytes_uploaded,
+            # feeder-pressure lane + counter-ring occupancy (ISSUE 4);
+            # blocks awaiting the 1/K fetch mean host counters may trail
+            # the device by up to stats_ring_pending batches
+            "feeder_shed": self.feeder_shed,
+            "stats_ring_pending": self._ring_count,
         }
 
     @property

@@ -112,6 +112,27 @@ class FlowBatch:
         valid = np.concatenate([self.valid, np.zeros(pad, dtype=bool)])
         return FlowBatch(tags=tags, meters=meters, valid=valid)
 
+    def slice(self, start: int, stop: int) -> "FlowBatch":
+        """Row-range view (the feeder splits decoded chunks across
+        bucket boundaries; numpy basic slicing keeps this copy-free)."""
+        return FlowBatch(
+            tags={k: v[start:stop] for k, v in self.tags.items()},
+            meters=self.meters[start:stop],
+            valid=self.valid[start:stop],
+        )
+
+    @classmethod
+    def concat(cls, parts: list["FlowBatch"]) -> "FlowBatch":
+        """Row-wise concatenation of same-schema batches."""
+        if len(parts) == 1:
+            return parts[0]
+        keys = parts[0].tags.keys()
+        return cls(
+            tags={k: np.concatenate([p.tags[k] for p in parts]) for k in keys},
+            meters=np.concatenate([p.meters for p in parts]),
+            valid=np.concatenate([p.valid for p in parts]),
+        )
+
 
 @dataclasses.dataclass
 class DocBatch:

@@ -1,0 +1,33 @@
+"""Feeder runtime — multi-queue fan-in, shape-bucketed coalescing and
+deterministic shedding between the receiver's overwrite queues and the
+fused windowed step (ISSUE 4; see runtime.py for the design)."""
+
+from .flowframe import (
+    decode_flowframe_body,
+    encode_flowbatch_body,
+    encode_flowbatch_frames,
+    peek_rows,
+)
+from .runtime import (
+    DocChunk,
+    FeederConfig,
+    FeederRuntime,
+    FlowChunk,
+    PipelineFeedSink,
+    ShardedFeedSink,
+    WindowManagerFeedSink,
+)
+
+__all__ = [
+    "DocChunk",
+    "FeederConfig",
+    "FeederRuntime",
+    "FlowChunk",
+    "PipelineFeedSink",
+    "ShardedFeedSink",
+    "WindowManagerFeedSink",
+    "decode_flowframe_body",
+    "encode_flowbatch_body",
+    "encode_flowbatch_frames",
+    "peek_rows",
+]

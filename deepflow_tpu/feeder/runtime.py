@@ -1,0 +1,489 @@
+"""Feeder runtime — multi-queue fan-in for the fused windowed step.
+
+The fused per-batch jit step (aggregator/pipeline.py) runs at device
+rate, but nothing upstream could feed it at rate: the receiver fans
+frames into bare OverwriteQueues and every caller hand-rolled its own
+batch assembly, so the device idled between host-side decode bursts.
+The FPGA sketch-acceleration literature hits the same wall — the sketch
+core only reaches line rate once a dedicated feed stage owns
+coalescing, padding and result drain-out (arXiv:2504.16896,
+arXiv:2503.13515). This module is that stage:
+
+  * **fan-in**: drain N overwrite queues round-robin (optionally
+    weighted), rotating the start queue each pump so no queue starves;
+  * **shape-bucketed coalescing**: decoded records accumulate in a
+    pending buffer and emit as fixed-shape batches from a small set of
+    buckets (pad-to-bucket) — the fused step compiles once per bucket
+    and NEVER retraces across mixed traffic (JitCacheMonitor's
+    expected_compiles budget covers the bucket set);
+  * **backpressure + deterministic shedding**: per-queue high/low
+    watermarks with hysteresis; a queue above its high watermark gets a
+    doubled drain budget but only the NEWEST half is admitted — the
+    oldest frames are shed WHOLE (never partial batches), counted
+    per-frame via a header peek (no decode), and accounted both in the
+    feeder's Countable counters (→ deepflow_system via the stats
+    sinks) and in the device counter block's CB_FEEDER_SHED lane on
+    the next dispatched batch;
+  * **double-buffered upload**: the pipeline sink stages batch i+1's
+    packed tag matrix (async device put) before dispatching batch i,
+    mirroring `async_drain` on the output side.
+
+Sinks adapt the record plane to each window controller:
+`PipelineFeedSink` (flow records → RollupPipeline's fused step),
+`WindowManagerFeedSink` (pb Documents via ingest/codec.py → the
+doc-level WindowManager append), `ShardedFeedSink` (flow records → one
+ShardedWindowManager per shard group; run one FeederRuntime per group).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from ..datamodel.batch import FlowBatch
+from ..ingest.framing import HEADER_LEN, FlowHeader, MessageType, split_message_spans
+from ..utils.spans import (
+    SPAN_FEEDER_COALESCE,
+    SPAN_FEEDER_DISPATCH,
+    SPAN_FEEDER_DRAIN,
+    SpanTracer,
+)
+from ..utils.stats import register_countable
+from .flowframe import decode_flowframe_body, peek_rows
+
+# ---------------------------------------------------------------------------
+# record chunks — what decoded frames become inside the pending buffer
+
+
+@dataclasses.dataclass
+class FlowChunk:
+    """Flow records (pre-fanout), wrapping a FlowBatch."""
+
+    fb: FlowBatch
+
+    @property
+    def rows(self) -> int:
+        return self.fb.size
+
+    def split(self, n: int) -> tuple["FlowChunk", "FlowChunk"]:
+        return FlowChunk(self.fb.slice(0, n)), FlowChunk(self.fb.slice(n, self.fb.size))
+
+
+@dataclasses.dataclass
+class DocChunk:
+    """Decoded Documents (post-fanout) for the doc-level append path."""
+
+    timestamp: np.ndarray  # [n] u32
+    tags: np.ndarray  # [n, T] u32 (TAG_SCHEMA order)
+    meters: np.ndarray  # [n, M] f32
+
+    @property
+    def rows(self) -> int:
+        return int(self.timestamp.shape[0])
+
+    def split(self, n: int) -> tuple["DocChunk", "DocChunk"]:
+        a = DocChunk(self.timestamp[:n], self.tags[:n], self.meters[:n])
+        b = DocChunk(self.timestamp[n:], self.tags[n:], self.meters[n:])
+        return a, b
+
+
+# ---------------------------------------------------------------------------
+# sinks
+
+
+class _FlowFrameCodec:
+    """Shared decode face for sinks that eat flowframe (TAGGEDFLOW)
+    frames."""
+
+    def count_records(self, raw: bytes) -> int:
+        body = raw[HEADER_LEN:]
+        return sum(peek_rows(body[o : o + ln]) for o, ln in split_message_spans(body))
+
+    def decode_frame(self, raw: bytes) -> FlowChunk | None:
+        header = FlowHeader.parse(raw[:HEADER_LEN])
+        if header.msg_type != int(MessageType.TAGGEDFLOW):
+            raise ValueError(f"flow sink got msg_type {header.msg_type}")
+        body = raw[HEADER_LEN:]
+        parts = [
+            decode_flowframe_body(body[o : o + ln])
+            for o, ln in split_message_spans(body)
+        ]
+        if not parts:
+            return None
+        return FlowChunk(FlowBatch.concat(parts))
+
+
+class PipelineFeedSink(_FlowFrameCodec):
+    """Flow records → RollupPipeline (the fused windowed step), with the
+    double-buffered upload: `emit` STAGES the new batch (async device
+    put) and dispatches the PREVIOUSLY staged one, so the tag-matrix
+    transfer of batch i+1 overlaps batch i's in-flight compute. Outputs
+    therefore trail by one emitted batch until flush()."""
+
+    def __init__(self, pipeline, *, double_buffer: bool = True):
+        if not pipeline.config.bucket_sizes:
+            raise ValueError(
+                "PipelineFeedSink needs PipelineConfig.bucket_sizes — the "
+                "feeder's pad-to-bucket contract is what keeps the fused "
+                "step from retracing"
+            )
+        self.pipeline = pipeline
+        self.double_buffer = double_buffer
+        self.bucket_sizes = tuple(pipeline.config.bucket_sizes)
+        self._held = None  # (StagedBatch, shed) awaiting dispatch
+        self._shed_carry = 0  # shed count whose batch had no valid rows
+
+    def emit(self, chunks: list[FlowChunk], rows: int, bucket: int, shed: int) -> list:
+        fb = FlowBatch.concat([c.fb for c in chunks])
+        assert fb.size == rows
+        shed += self._shed_carry
+        self._shed_carry = 0
+        staged = self.pipeline.stage(fb)  # pads to `bucket`, starts upload
+        out = self.flush()  # dispatch the previously staged batch
+        if staged is None:  # all-padding emit — carry its shed forward
+            self._shed_carry = shed
+        elif self.double_buffer:
+            self._held = (staged, shed)
+        else:
+            out += self.pipeline.ingest_staged(staged, feeder_shed=shed)
+        return out
+
+    def flush(self) -> list:
+        """Dispatch the held double-buffered batch, if any."""
+        if self._held is None:
+            return []
+        held, held_shed = self._held
+        self._held = None
+        return self.pipeline.ingest_staged(held, feeder_shed=held_shed)
+
+
+class ShardedFeedSink(_FlowFrameCodec):
+    """Flow records → ShardedWindowManager (one feeder per shard
+    group). Buckets must be divisible by the mesh's device count — the
+    sharded step splits the leading dim evenly across devices."""
+
+    def __init__(self, swm, bucket_sizes: tuple[int, ...]):
+        d = swm.pipe.n_devices
+        bad = [b for b in bucket_sizes if b % d]
+        if bad:
+            raise ValueError(
+                f"bucket sizes {bad} not divisible by device count {d}"
+            )
+        self.swm = swm
+        self.bucket_sizes = tuple(bucket_sizes)
+        self.feeder_shed = 0  # sharded path has no device counter block
+
+    def emit(self, chunks: list[FlowChunk], rows: int, bucket: int, shed: int) -> list:
+        fb = FlowBatch.concat([c.fb for c in chunks]).pad_to(bucket)
+        self.feeder_shed += shed
+        return self.swm.ingest(fb.tags, fb.meters, fb.valid)
+
+    def flush(self) -> list:
+        return []
+
+
+class WindowManagerFeedSink:
+    """pb Documents (METRICS lane, ingest/codec.py) → the doc-level
+    WindowManager append. Keys are the packed-word fingerprints
+    computed host-side with the SAME plan the device uses
+    (DOC_KEY_PACK + fingerprint64_words), so feeder-fed rows merge with
+    device-fingerprinted rows for the same logical key."""
+
+    def __init__(self, wm, bucket_sizes: tuple[int, ...], *, meter_id=None, decoder=None):
+        from ..datamodel.code import MeterId
+        from ..ingest.codec import DocumentDecoder
+
+        self.wm = wm
+        self.bucket_sizes = tuple(bucket_sizes)
+        self.meter_id = int(MeterId.FLOW if meter_id is None else meter_id)
+        self.decoder = decoder if decoder is not None else DocumentDecoder()
+        self.other_meter_rows = 0  # decoded docs of non-target meter types
+
+    def count_records(self, raw: bytes) -> int:
+        return len(split_message_spans(raw[HEADER_LEN:]))
+
+    def decode_frame(self, raw: bytes) -> DocChunk | None:
+        body = raw[HEADER_LEN:]
+        spans = split_message_spans(body)
+        batches = self.decoder.decode_parts([(body, spans)])
+        chunk = None
+        for meter_id, db in batches.items():
+            if meter_id != self.meter_id:
+                self.other_meter_rows += db.tags.shape[0]
+                continue
+            chunk = DocChunk(db.timestamp, db.tags, db.meters)
+        return chunk
+
+    def emit(self, chunks: list[DocChunk], rows: int, bucket: int, shed: int) -> list:
+        from ..datamodel.code import DOC_KEY_PACK, pack_tag_words
+        from ..datamodel.schema import TAG_SCHEMA
+        from ..ops.hashing import fingerprint64_words
+
+        ts = np.zeros(bucket, dtype=np.uint32)
+        tags = np.zeros((bucket, TAG_SCHEMA.num_fields), dtype=np.uint32)
+        meters = np.zeros((bucket, self.wm.meter_schema.num_fields), dtype=np.float32)
+        valid = np.zeros(bucket, dtype=bool)
+        off = 0
+        for c in chunks:
+            n = c.rows
+            ts[off : off + n] = c.timestamp
+            tags[off : off + n] = c.tags
+            meters[off : off + n] = c.meters
+            valid[off : off + n] = True
+            off += n
+        assert off == rows
+        cols = {
+            f: tags[:, TAG_SCHEMA.index(f)] for f in DOC_KEY_PACK.field_names()
+        }
+        hi, lo = fingerprint64_words(pack_tag_words(cols, DOC_KEY_PACK, np), xp=np)
+        return self.wm.ingest(
+            ts, hi.astype(np.uint32), lo.astype(np.uint32),
+            np.ascontiguousarray(tags.T), np.ascontiguousarray(meters.T),
+            valid, feeder_shed=shed,
+        )
+
+    def flush(self) -> list:
+        return []
+
+
+# ---------------------------------------------------------------------------
+# the runtime
+
+
+@dataclasses.dataclass(frozen=True)
+class FeederConfig:
+    # frames a queue may contribute per visit (scaled by its weight)
+    frames_per_queue: int = 16
+    # queue visits per pump() = rounds × len(queues)
+    rounds_per_pump: int = 4
+    # per-queue depth watermarks, as a fraction of queue capacity, with
+    # hysteresis: ≥ high enters pressure (doubled drain budget, oldest
+    # half shed), ≤ low leaves it
+    high_watermark: float = 0.75
+    low_watermark: float = 0.25
+    # relative drain weights per queue (None = equal); a weight-2 queue
+    # contributes 2× frames_per_queue per visit
+    weights: tuple[int, ...] | None = None
+    # emit the sub-bucket tail at the end of each pump (freshness) —
+    # off, records wait for a full max-size bucket (efficiency)
+    emit_partial: bool = True
+
+
+class FeederRuntime:
+    """Drains N overwrite queues into shape-bucketed batches for one
+    windowed sink. Drive it explicitly with `pump()` (bench/tests) or
+    via the `serve()` polling thread."""
+
+    def __init__(
+        self,
+        queues: list,
+        sink,
+        config: FeederConfig = FeederConfig(),
+        *,
+        name: str = "feeder",
+        tracer: SpanTracer | None = None,
+    ):
+        if not queues:
+            raise ValueError("need at least one queue")
+        if config.weights is not None and len(config.weights) != len(queues):
+            raise ValueError(
+                f"{len(config.weights)} weights for {len(queues)} queues"
+            )
+        if not getattr(sink, "bucket_sizes", None):
+            raise ValueError("sink must declare bucket_sizes")
+        self.queues = list(queues)
+        self.sink = sink
+        self.config = config
+        self.buckets = tuple(sorted(sink.bucket_sizes))
+        self.name = name
+        self.tracer = tracer if tracer is not None else SpanTracer(
+            service="deepflow_tpu.feeder"
+        )
+        self._weights = config.weights or (1,) * len(queues)
+        self._pressure = [False] * len(queues)
+        self._chunks: deque = deque()
+        self._rows = 0
+        self._shed_pending = 0  # records shed since the last emit
+        self._rr = 0  # rotating first-queue index (starvation-proof)
+        self._lock = threading.Lock()
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self.counters = {
+            "frames_in": 0,
+            "records_in": 0,
+            "bad_frames": 0,
+            "batches_out": 0,
+            "records_out": 0,
+            "pad_rows": 0,
+            "shed_frames": 0,
+            "shed_records": 0,
+            "pressure_events": 0,
+        }
+        register_countable("tpu_feeder", self, name=name)
+        register_countable("tpu_feeder_spans", self.tracer, name=name)
+
+    # -- countable face --------------------------------------------------
+    def get_counters(self) -> dict:
+        with self._lock:
+            out = dict(self.counters)
+        out["pending_rows"] = self._rows
+        out["queue_overwritten"] = sum(
+            int(getattr(q, "overwritten", 0)) for q in self.queues
+        )
+        out["queues_in_pressure"] = sum(self._pressure)
+        return out
+
+    def _count(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self.counters[key] += n
+
+    # -- drain + shed ----------------------------------------------------
+    def _visit(self, i: int, admit: list) -> int:
+        """Drain queue i once; append admitted frames, shed the rest.
+        Returns frames drained. Deterministic: the decision depends
+        only on queue depth at visit time and the configured
+        watermarks (the shed-policy test pins this)."""
+        q = self.queues[i]
+        budget = self._weights[i] * self.config.frames_per_queue
+        cap = int(getattr(q, "capacity", 0) or 0)
+        if cap:
+            depth = len(q)
+            if not self._pressure[i] and depth >= self.config.high_watermark * cap:
+                self._pressure[i] = True
+                self._count("pressure_events")
+            elif self._pressure[i] and depth <= self.config.low_watermark * cap:
+                self._pressure[i] = False
+        if self._pressure[i]:
+            # pressure: drain twice the budget to burn the backlog down,
+            # admit only the NEWEST `budget` frames, shed the oldest
+            # WHOLE (the OverwriteQueue stance — freshest data wins) and
+            # account every dropped record via the header peek
+            drained = q.gets(2 * budget, timeout_ms=0)
+            cut = max(len(drained) - budget, 0)
+            for raw in drained[:cut]:
+                self._count("shed_frames")
+                n = self.sink.count_records(raw)
+                self._count("shed_records", n)
+                with self._lock:
+                    self._shed_pending += n
+            admit.extend(drained[cut:])
+            return len(drained)
+        drained = q.gets(budget, timeout_ms=0)
+        admit.extend(drained)
+        return len(drained)
+
+    # -- coalescing ------------------------------------------------------
+    def _take(self, n: int) -> list:
+        """Pop exactly n rows of chunks from the pending buffer."""
+        out = []
+        need = n
+        while need > 0:
+            c = self._chunks.popleft()
+            if c.rows <= need:
+                out.append(c)
+                need -= c.rows
+            else:
+                head, tail = c.split(need)
+                out.append(head)
+                self._chunks.appendleft(tail)
+                need = 0
+        self._rows -= n
+        return out
+
+    def _emit(self, rows: int, bucket: int) -> list:
+        chunks = self._take(rows)
+        with self._lock:
+            shed, self._shed_pending = self._shed_pending, 0
+        self._count("batches_out")
+        self._count("records_out", rows)
+        self._count("pad_rows", bucket - rows)
+        with self.tracer.span(SPAN_FEEDER_DISPATCH):
+            return self.sink.emit(chunks, rows, bucket, shed)
+
+    def _admit(self, chunk, out: list) -> None:
+        self._chunks.append(chunk)
+        self._rows += chunk.rows
+        max_b = self.buckets[-1]
+        while self._rows >= max_b:
+            out.extend(self._emit(max_b, max_b))
+
+    def _bucket_for(self, rows: int) -> int:
+        for b in self.buckets:
+            if rows <= b:
+                return b
+        return self.buckets[-1]
+
+    # -- the pump --------------------------------------------------------
+    def pump(self) -> list:
+        """One fan-in cycle: drain every queue (rounds_per_pump visits
+        each, rotating the start index), decode + coalesce into bucket
+        batches, emit them into the sink, and — with emit_partial —
+        flush the sub-bucket tail padded to its smallest bucket.
+        Returns whatever the sink's window controller flushed."""
+        out: list = []
+        nq = len(self.queues)
+        for _ in range(self.config.rounds_per_pump):
+            admit: list = []
+            with self.tracer.span(SPAN_FEEDER_DRAIN):
+                drained = 0
+                for j in range(nq):
+                    drained += self._visit((self._rr + j) % nq, admit)
+            self._rr = (self._rr + 1) % nq
+            if not admit and not drained:
+                break
+            with self.tracer.span(SPAN_FEEDER_COALESCE):
+                for raw in admit:
+                    try:
+                        chunk = self.sink.decode_frame(raw)
+                    except ValueError:
+                        self._count("bad_frames")
+                        continue
+                    self._count("frames_in")
+                    if chunk is None or chunk.rows == 0:
+                        continue
+                    self._count("records_in", chunk.rows)
+                    self._admit(chunk, out)
+        if self.config.emit_partial and self._rows > 0:
+            out.extend(self._emit(self._rows, self._bucket_for(self._rows)))
+        return out
+
+    def flush(self) -> list:
+        """Emit every pending record (tail bucket) and push anything the
+        sink holds (the double-buffered staged batch); does NOT drain
+        the sink's open windows — that stays the owner's shutdown call."""
+        out: list = []
+        if self._rows > 0:
+            out.extend(self._emit(self._rows, self._bucket_for(self._rows)))
+        with self.tracer.span(SPAN_FEEDER_DISPATCH):
+            out.extend(self.sink.flush())
+        return out
+
+    # -- thread ----------------------------------------------------------
+    def serve(self, poll_ms: int = 20, on_flush=None) -> None:
+        """Background pump loop; `on_flush(outputs)` receives every
+        non-empty result (flushed windows must not be dropped on the
+        floor by a fire-and-forget loop)."""
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def run():
+            while not self._stop.is_set():
+                got = self.pump()
+                if got and on_flush is not None:
+                    on_flush(got)
+                if not got:
+                    time.sleep(poll_ms / 1000.0)
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=timeout)

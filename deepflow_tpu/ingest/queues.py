@@ -31,15 +31,20 @@ class PyOverwriteQueue:
         self._overwritten = 0
         self._closed = False
 
-    def put(self, item: bytes) -> None:
+    def put(self, item: bytes) -> bool:
+        """Enqueue (overwriting oldest on overflow). Returns False when
+        the queue is already closed — the item was NOT accepted; the
+        producer (Receiver._dispatch) counts that instead of silently
+        losing the frame in the check-then-put race."""
         with self._cond:
             if self._closed:
-                return
+                return False
             if len(self._dq) >= self.capacity:
                 self._dq.popleft()
                 self._overwritten += 1
             self._dq.append(bytes(item))
             self._cond.notify()
+            return True
 
     def gets(self, max_items: int, timeout_ms: int = -1) -> list[bytes]:
         """Block until ≥1 item (or timeout/close); pop up to max_items."""
@@ -58,6 +63,11 @@ class PyOverwriteQueue:
             self._cond.notify_all()
 
     @property
+    def closed(self) -> bool:
+        with self._lock:
+            return self._closed
+
+    @property
     def overwritten(self) -> int:
         with self._lock:
             return self._overwritten
@@ -66,9 +76,37 @@ class PyOverwriteQueue:
         with self._lock:
             return len(self._dq)
 
+    def get_counters(self) -> dict:
+        """Countable face (utils/stats.StatsCollector): queue overruns
+        were previously discarded unless a caller polled `overwritten`;
+        registering queues makes them queryable like every other
+        counter (deepflow_system tables via the system sink)."""
+        with self._lock:
+            return {
+                "depth": len(self._dq),
+                "capacity": self.capacity,
+                "overwritten": self._overwritten,
+                "closed": int(self._closed),
+            }
+
 
 def new_queue(capacity: int, prefer_native: bool = True):
     """OverwriteQueue factory: native C++ ring when built, else Python."""
     if prefer_native and native.native_available():
         return native.OverwriteQueue(capacity)
     return PyOverwriteQueue(capacity)
+
+
+def register_queue_stats(module: str, queues, **tags: str):
+    """Register every queue on the default StatsCollector, one source
+    per queue (tagged with its index) — the RegisterCountable stance:
+    overwrite drops become visible the moment the queue exists, not
+    only when an owner remembers to poll. Queues are weakly held, so a
+    dropped handler's queues deregister themselves. Returns the
+    CounterSource list (callers may deregister explicitly)."""
+    from ..utils.stats import register_countable
+
+    return [
+        register_countable(module, q, queue=str(i), **tags)
+        for i, q in enumerate(queues)
+    ]

@@ -64,9 +64,11 @@ class Receiver:
             "rx_bytes": 0,
             "bad_frames": 0,
             "no_handler": 0,
+            "queue_closed": 0,
             "udp_frames": 0,
             "tcp_conns": 0,
         }
+        self._queue_stat_sources: list = []
 
     def agent_list(self) -> list[AgentStatus]:
         """Snapshot for observers (REST/debug) — .agents mutates under
@@ -79,6 +81,14 @@ class Receiver:
         if not queues:
             raise ValueError("need at least one queue")
         self._handlers[int(msg_type)] = list(queues)
+        # surface each queue's depth/overrun counters on the default
+        # stats collector — overwrite drops were previously invisible
+        # unless an owner polled .overwritten (ISSUE 4 satellite)
+        from .queues import register_queue_stats
+
+        self._queue_stat_sources += register_queue_stats(
+            "ingest_queue", queues, msg_type=str(int(msg_type))
+        )
 
     # -- lifecycle ------------------------------------------------------
     def start(self) -> None:
@@ -159,7 +169,21 @@ class Receiver:
         if not queues:
             self._count("no_handler")
             return
-        queues[header.agent_id % len(queues)].put(raw_frame)
+        q = queues[header.agent_id % len(queues)]
+        # a handler shutting down mid-stream closes its queues; frames
+        # racing that close are counted and skipped — never raised into
+        # the conn/UDP loop (which would tear down the whole connection
+        # for every agent sharing it). put() returning False covers the
+        # check-then-put race (queues.py); the pre-check stays as the
+        # fast path and for queue impls whose put has no return signal.
+        if getattr(q, "closed", False):
+            self._count("queue_closed")
+            return
+        try:
+            if q.put(raw_frame) is False:
+                self._count("queue_closed")
+        except Exception:
+            self._count("queue_closed")
 
     # -- TCP ------------------------------------------------------------
     def _accept_loop(self) -> None:

@@ -162,6 +162,7 @@ class OverwriteQueue:
             raise RuntimeError(f"native runtime unavailable: {_build_error}")
         self._q = _lib.dfq_new(capacity)
         self.capacity = capacity
+        self._closed = False
 
     def put(self, blob: bytes):
         _lib.dfq_put(self._q, blob, len(blob))
@@ -177,14 +178,31 @@ class OverwriteQueue:
         return out
 
     def close(self):
+        self._closed = True
         _lib.dfq_close(self._q)
 
     def __len__(self) -> int:
         return _lib.dfq_len(self._q)
 
     @property
+    def closed(self) -> bool:
+        # host-side flag: close() is a host decision and the C ring
+        # keeps serving gets() after close — same API face as the
+        # Python twin (ingest/queues.py)
+        return self._closed
+
+    @property
     def overwritten(self) -> int:
         return _lib.dfq_overwritten(self._q)
+
+    def get_counters(self) -> dict:
+        """Countable face — mirrors PyOverwriteQueue.get_counters."""
+        return {
+            "depth": len(self),
+            "capacity": self.capacity,
+            "overwritten": self.overwritten,
+            "closed": int(self._closed),
+        }
 
     def __del__(self):
         if _lib is not None and getattr(self, "_q", None):

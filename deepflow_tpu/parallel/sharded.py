@@ -536,6 +536,18 @@ class ShardedWindowManager:
             self.n_advances += 1
         return flushed
 
+    def make_feeder(self, queues, bucket_sizes, config=None, **kw):
+        """Wire this shard group behind a feeder runtime (ISSUE 4: one
+        feeder per shard group): TAGGEDFLOW flowframes from `queues`
+        coalesce into bucket-shaped flow batches whose sizes divide the
+        mesh's device count (feeder/runtime.ShardedFeedSink)."""
+        from ..feeder import FeederConfig, FeederRuntime, ShardedFeedSink
+
+        return FeederRuntime(
+            queues, ShardedFeedSink(self, bucket_sizes),
+            config or FeederConfig(), **kw,
+        )
+
     def drain(self):
         """Flush every open window (shutdown path). Advances the open
         span past each drained window so a straggler ingest cannot

@@ -46,6 +46,13 @@ SPAN_WINDOW_ADVANCE = "window.advance"  # fold + flush_range dispatch on window 
 SPAN_FLUSH_DRAIN = "flush.drain"  # packed flush fetch + per-window split
 SPAN_CHECKPOINT_SAVE = "checkpoint.save"  # window-state snapshot to .npz
 
+# Feeder-runtime stages (ISSUE 4) — emitted by feeder/runtime.py on its
+# own tracer; NOT in PIPELINE_SPAN_NAMES (a pipeline can run feederless,
+# and the pinned vocabulary must stay satisfiable by a bare pipeline).
+SPAN_FEEDER_DRAIN = "feeder.drain"  # queue gets + frame decode
+SPAN_FEEDER_COALESCE = "feeder.coalesce"  # bucket assembly + pad
+SPAN_FEEDER_DISPATCH = "feeder.dispatch"  # staged batch → sink ingest
+
 PIPELINE_SPAN_NAMES = (
     SPAN_INGEST_DISPATCH,
     SPAN_STATS_FETCH,
@@ -189,15 +196,18 @@ class JitCacheMonitor:
     """Compile/retrace counters for ONE jitted callable.
 
     Reads the pjit executable-cache size (`fn._cache_size()`): the first
-    entry is the expected compile, every further entry is a RETRACE — a
+    `expected_compiles` entries are expected compiles (one per declared
+    input shape — a shape-bucketed feeder legitimately compiles the
+    fused step once per bucket), every further entry is a RETRACE — a
     shape/dtype/static-arg leak recompiling what steady state should
     reuse. `poll()` is cheap (no device sync); call it after each
     dispatch. Degrades to zeros on jax builds without the cache probe.
     """
 
-    def __init__(self, fn=None):
+    def __init__(self, fn=None, expected_compiles: int = 1):
         self._fn = fn
         self._size = 0
+        self.expected_compiles = max(1, int(expected_compiles))
         self.compiles = 0
         self.retraces = 0
         # poll() runs from the ingest loop AND a ticking StatsCollector
@@ -220,10 +230,10 @@ class JitCacheMonitor:
                 except Exception:  # pragma: no cover - probe-less jax build
                     size = self._size
                 grew = size - self._size
+                while grew > 0 and self.compiles < self.expected_compiles:
+                    self.compiles += 1
+                    grew -= 1
                 if grew > 0:
-                    if self._size == 0:
-                        self.compiles += 1
-                        grew -= 1
                     self.retraces += grew
                 self._size = size
             return self.compiles, self.retraces

@@ -81,18 +81,25 @@ def test_counter_block_rejects_version_drift():
 
 
 def test_counter_block_layout_constants():
-    from deepflow_tpu.aggregator.window import CB_FIELDS, CB_RING_FILL
+    from deepflow_tpu.aggregator.window import (
+        CB_FEEDER_SHED,
+        CB_FIELDS,
+        CB_RING_FILL,
+    )
 
     # layout drift between the device builder and the host parser must
-    # fail here, not silently mis-slice
-    assert CB_VERSION == 0 and CB_LEN == 10
-    assert COUNTER_BLOCK_VERSION == 1
+    # fail here, not silently mis-slice (v2 appended the feeder_shed
+    # lane, ISSUE 4)
+    assert CB_VERSION == 0 and CB_LEN == 11
+    assert COUNTER_BLOCK_VERSION == 2
     assert CB_STASH_OCCUPANCY == 7
+    assert CB_FEEDER_SHED == 10
     # the documented field-name table mirrors the index constants
     assert len(CB_FIELDS) == CB_LEN
     assert CB_FIELDS[CB_VERSION] == "version"
     assert CB_FIELDS[CB_STASH_OCCUPANCY] == "stash_occupancy"
     assert CB_FIELDS[CB_RING_FILL] == "ring_fill"
+    assert CB_FIELDS[CB_FEEDER_SHED] == "feeder_shed"
 
 
 # ---------------------------------------------------------------------------
