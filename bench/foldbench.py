@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Fold-stage microbench: full-sort fold vs incremental merge-fold
+(ISSUE 5), the §7a chained-sync recipe.
+
+The windowed advance is fold-dominated on the mesh path (PERF.md §12
+drain_ms); the merge-fold replaces the O((S+A) log(S+A)) 3-key re-sort
+of the whole stash+accumulator concat with an O(A log A) accumulator
+sort + a rank-merge against the standing stash order
+(aggregator/stash.py). This harness times three variants over the SAME
+state at {stash_rows} × {acc_rows} grid points, threading the stash
+through K iterations (chained — no host round trip inside the loop,
+one measured fetch subtracted):
+
+  full        _fold_impl          — the shipped full-sort oracle
+  merge       _merge_fold_impl    — full-set rank-merge (capacity folds)
+  merge_span  _merge_fold_impl hi — span-bounded advance fold (~1/4 of
+                                    the acc's windows close)
+
+Knobs: FOLDBENCH_SHAPES="S:A,S:A,..." (default
+65536:8192,65536:65536,262144:8192,262144:65536,589824:8192,589824:65536,
+2097152:8192,2097152:65536 — the ISSUE 5 grid), FOLDBENCH_ITERS (4),
+DEEPFLOW_MERGE_SCATTER=1 for the scatter merged-order A/B (on-chip).
+
+Prints ONE JSON line {"rows": [...]}; on failure a partial-but-
+parseable record (bench.py convention). Full production schema
+(TAG_SCHEMA × FLOW_METER) — the real fold payload widths.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from deepflow_tpu.aggregator.stash import (  # noqa: E402
+    AccumState,
+    _fold_impl,
+    _merge_fold_impl,
+    stash_fold,
+    stash_init,
+)
+from deepflow_tpu.datamodel.schema import FLOW_METER, TAG_SCHEMA  # noqa: E402
+from deepflow_tpu.ops.segment import SENTINEL_SLOT  # noqa: E402
+
+SUM_COLS = tuple(int(i) for i in np.nonzero(FLOW_METER.sum_mask)[0])
+MAX_COLS = tuple(int(i) for i in np.nonzero(FLOW_METER.max_mask)[0])
+N_WINDOWS = 8  # live windows the synthetic stash spans
+
+
+def _synthetic_acc(rng, cap, fill, key_space, t_cols, m_cols) -> AccumState:
+    slot = np.full(cap, SENTINEL_SLOT, np.uint32)
+    hi = np.zeros(cap, np.uint32)
+    lo = np.zeros(cap, np.uint32)
+    keys = rng.integers(0, key_space, fill).astype(np.uint64)
+    slot[:fill] = (1 + keys % N_WINDOWS).astype(np.uint32)
+    # spread keys over both 32-bit lanes like the real fingerprint
+    hi[:fill] = (keys * np.uint64(2654435761) >> np.uint64(13)).astype(np.uint32)
+    lo[:fill] = (keys * np.uint64(40503) + np.uint64(7)).astype(np.uint32)
+    tags = np.zeros((t_cols, cap), np.uint32)
+    tags[0, :fill] = keys.astype(np.uint32)
+    meters = np.zeros((m_cols, cap), np.float32)
+    meters[:, :fill] = rng.normal(size=(m_cols, fill)).astype(np.float32)
+    return AccumState(
+        slot=jnp.asarray(slot),
+        key_hi=jnp.asarray(hi),
+        key_lo=jnp.asarray(lo),
+        tags=jnp.asarray(tags),
+        meters=jnp.asarray(meters),
+    )
+
+
+def _chained(name, fn, state, acc, iters):
+    t0 = time.perf_counter()
+    state = fn(state, acc)
+    _ = np.asarray(state.slot[:1])
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    _ = np.asarray(state.slot[:1])
+    fetch = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _i in range(iters):
+        state = fn(state, acc)
+    _ = np.asarray(state.slot[:1])
+    ms = (time.perf_counter() - t0 - fetch) / iters * 1e3
+    print(
+        f"  {name:12s} compile {compile_s:6.1f}s  steady {ms:9.2f} ms",
+        file=sys.stderr, flush=True,
+    )
+    return ms
+
+
+def run_shape(s_rows: int, a_rows: int, iters: int) -> dict:
+    rng = np.random.default_rng(5)
+    t_cols = TAG_SCHEMA.num_fields
+    m_cols = FLOW_METER.num_fields
+    # canonical stash at ~85% occupancy: one oracle fold of unique keys
+    live = int(s_rows * 0.85)
+    state = stash_init(s_rows, TAG_SCHEMA, FLOW_METER)
+    seed_acc = _synthetic_acc(
+        rng, live, live, key_space=live * 4, t_cols=t_cols, m_cols=m_cols
+    )
+    state, _ = stash_fold(state, seed_acc, FLOW_METER)
+    # the benched acc: half its keys collide with stash keys
+    acc = _synthetic_acc(
+        rng, a_rows, a_rows, key_space=live * 4, t_cols=t_cols, m_cols=m_cols
+    )
+
+    # no donation: the SAME acc re-folds every iteration (steady-state
+    # work — after the first fold the stash key set is stationary)
+    full = jax.jit(lambda st, ac: _fold_impl(st, ac, SUM_COLS, MAX_COLS)[0])
+    merge = jax.jit(
+        lambda st, ac: _merge_fold_impl(
+            st, ac, jnp.uint32(SENTINEL_SLOT), SUM_COLS, MAX_COLS
+        )[0]
+    )
+    span_hi = jnp.uint32(1 + N_WINDOWS // 4)  # ~1/4 of windows close
+    merge_span = jax.jit(
+        lambda st, ac: _merge_fold_impl(st, ac, span_hi, SUM_COLS, MAX_COLS)[0]
+    )
+
+    print(f"stash={s_rows} acc={a_rows}", file=sys.stderr, flush=True)
+    full_ms = _chained("full", full, state, acc, iters)
+    merge_ms = _chained("merge", merge, state, acc, iters)
+    span_ms = _chained("merge_span", merge_span, state, acc, iters)
+    return {
+        "stash_rows": s_rows,
+        "acc_rows": a_rows,
+        "live_rows": live,
+        "iters": iters,
+        "full_ms": round(full_ms, 3),
+        "merge_ms": round(merge_ms, 3),
+        "merge_span_ms": round(span_ms, 3),
+        "speedup_full_vs_merge": round(full_ms / max(merge_ms, 1e-9), 3),
+        "speedup_full_vs_span": round(full_ms / max(span_ms, 1e-9), 3),
+        "merge_scatter": os.environ.get("DEEPFLOW_MERGE_SCATTER", "0") == "1",
+    }
+
+
+def main():
+    default = (
+        "65536:8192,65536:65536,262144:8192,262144:65536,"
+        "589824:8192,589824:65536,2097152:8192,2097152:65536"
+    )
+    shapes = [
+        tuple(int(v) for v in part.split(":"))
+        for part in os.environ.get("FOLDBENCH_SHAPES", default).split(",")
+        if part
+    ]
+    iters = int(os.environ.get("FOLDBENCH_ITERS", 4))
+    rows = []
+    try:
+        for s_rows, a_rows in shapes:
+            rows.append(run_shape(s_rows, a_rows, iters))
+        print(json.dumps({"rows": rows, "device": str(jax.devices()[0])}), flush=True)
+    except Exception as e:  # parseable partial record, never a traceback
+        print(
+            json.dumps({"rows": rows, "partial": True, "error": repr(e)}),
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -60,7 +60,7 @@ def _sync(wm):
     return np.asarray(wm.sketches.hll.ravel()[:1])
 
 
-def run(n_dev: int, per_dev: int, iters: int) -> dict:
+def run(n_dev: int, per_dev: int, iters: int, fold_mode: str = "full") -> dict:
     mesh = make_mesh(n_dev, n_hosts=2 if n_dev >= 2 else 1)
     cfg = ShardedConfig(
         capacity_per_device=1 << 12,
@@ -68,6 +68,7 @@ def run(n_dev: int, per_dev: int, iters: int) -> dict:
         hll_precision=10,
         hist=LogHistSpec(bins=256, vmin=1.0, gamma=1.08),
         batch_unique_cap=1 << 13,
+        fold_mode=fold_mode,
     )
     pipe = ShardedPipeline(mesh, cfg)
     wm = ShardedWindowManager(pipe)
@@ -120,6 +121,7 @@ def run(n_dev: int, per_dev: int, iters: int) -> dict:
 
     row = {
         "n_devices": n_dev,
+        "fold_mode": fold_mode,
         "per_device_batch": per_dev,
         "ingest_rec_s": round(ingest_rate, 1),
         "windowed_rec_s": round(windowed_rate, 1),
@@ -139,10 +141,19 @@ def run(n_dev: int, per_dev: int, iters: int) -> dict:
 def main():
     per_dev = int(os.environ.get("MESH_PER_DEV", 1 << 13))
     iters = int(os.environ.get("MESH_ITERS", 8))
+    # fold-mode A/B (ISSUE 5): the windowed cadence's drain_ms is what
+    # the incremental merge-fold attacks — emit before/after rows
+    modes = [
+        m for m in os.environ.get("MESH_FOLD_MODES", "full,merge").split(",") if m
+    ]
+    devices = [
+        int(d) for d in os.environ.get("MESH_DEVICES", "1,2,4,8").split(",") if d
+    ]
     rows = []
     try:
-        for n in (1, 2, 4, 8):
-            rows.append(run(n, per_dev, iters))
+        for mode in modes:
+            for n in devices:
+                rows.append(run(n, per_dev, iters, fold_mode=mode))
         print(json.dumps({"rows": rows}), flush=True)
     except Exception as e:  # parseable partial record, never a traceback
         print(

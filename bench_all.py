@@ -345,8 +345,12 @@ def config5(quick: bool):
             out = subprocess.run(
                 [sys.executable, "bench/mesh_scaling.py"],
                 capture_output=True, text=True, timeout=900,
+                # fold-mode A/B at two device counts keeps the run inside
+                # the timeout; the standalone tool defaults to the full
+                # 1/2/4/8 × full/merge matrix
                 env={**__import__("os").environ, "MESH_PER_DEV": str(1 << 13),
-                     "MESH_ITERS": "8"},
+                     "MESH_ITERS": "8", "MESH_DEVICES": "1,4",
+                     "MESH_FOLD_MODES": "full,merge"},
             )
             rec = json.loads(out.stdout.strip().splitlines()[-1])
             scaling = rec["rows"]
@@ -385,12 +389,45 @@ def config6(quick: bool):
          feeder_telemetry=rec.get("feeder_telemetry"))
 
 
+def config7(quick: bool):
+    """Fold stage A/B (ISSUE 5): full-sort fold vs incremental
+    merge-fold via bench/foldbench.py (chained-sync §7a recipe, real
+    TAG_SCHEMA × FLOW_METER payload widths). The vs line is the
+    full/merge speedup at the largest shape run; the span-bounded
+    advance variant rides in the detail rows. Quick mode trims to one
+    small shape; the full on-chip grid is the foldbench default
+    (PERF.md §15)."""
+    import os
+    import subprocess
+
+    shapes = (
+        "65536:8192" if quick
+        else "65536:8192,65536:65536,262144:8192,262144:65536"
+    )
+    env = {**os.environ, "FOLDBENCH_SHAPES": shapes,
+           "FOLDBENCH_ITERS": "2" if quick else "4"}
+    out = subprocess.run(
+        [sys.executable, "bench/foldbench.py"],
+        capture_output=True, text=True, timeout=900, env=env,
+    )
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    rows = rec["rows"]
+    if not rows:
+        emit("c7_fold_full_vs_merge", 0, "error", 0,
+             error=rec.get("error", "no rows"))
+        return
+    last = rows[-1]
+    emit("c7_fold_full_vs_merge", last["merge_ms"], "ms/fold",
+         last["speedup_full_vs_merge"], rows=rows,
+         partial=rec.get("partial", False), error=rec.get("error"))
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--cpu", action="store_true")
     p.add_argument("--quick", action="store_true")
     args = p.parse_args()
-    for fn in (config1, config2, config3, config4, config5, config6):
+    for fn in (config1, config2, config3, config4, config5, config6, config7):
         try:
             fn(args.quick)
         except Exception as e:  # one config must not kill the others

@@ -2,8 +2,11 @@ from .stash import (
     StashState,
     stash_flush,
     stash_flush_range,
+    stash_fold,
+    stash_fold_counted,
     stash_init,
     stash_merge,
+    stash_merge_fold,
     unpack_flush_rows,
 )
 from .window import WindowConfig, WindowManager
@@ -12,6 +15,9 @@ __all__ = [
     "StashState",
     "stash_init",
     "stash_merge",
+    "stash_fold",
+    "stash_fold_counted",
+    "stash_merge_fold",
     "stash_flush",
     "stash_flush_range",
     "unpack_flush_rows",

@@ -108,6 +108,11 @@ def save_window_state(wm: WindowManager, path: str | Path):
             "accum_batches": wm.config.accum_batches,
             "async_drain": wm.config.async_drain,
             "stats_ring": wm.config.stats_ring,
+            # fold strategy rides the checkpoint: a merge-mode stash is
+            # canonical (live sorted prefix) and must resume merge-mode;
+            # a full-mode stash may hold per-window flush holes and must
+            # NOT resume into the rank-merge
+            "fold_mode": wm.config.fold_mode,
         }
         buf = io.BytesIO()
         np.savez_compressed(
@@ -141,6 +146,7 @@ def load_window_state(
             accum_batches=meta["accum_batches"],
             async_drain=meta.get("async_drain", False),
             stats_ring=meta.get("stats_ring", 1),
+            fold_mode=meta.get("fold_mode", "full"),
         )
         wm = WindowManager(cfg, tag_schema, meter_schema)
         t = tag_schema.num_fields

@@ -102,7 +102,7 @@ def batch_prereduce(tags, meters, valid, interval, cap, sum_cols, max_cols):
 
 
 def make_ingest_step(fanout_config: FanoutConfig, interval: int = 1, app: bool = False,
-                     batch_unique_cap: int | None = None):
+                     batch_unique_cap: int | None = None, fold_mode: str = "full"):
     """Build the pure device step pair: FlowBatch columns → stash.
 
     Returns (append, fold):
@@ -119,7 +119,10 @@ def make_ingest_step(fanout_config: FanoutConfig, interval: int = 1, app: bool =
     times the (append ×K, fold ×1) cycle; RollupPipeline drives the same
     functions from WindowManager. `app` selects the L7 path (fanout_l7 +
     APP_METER) — fanout and meter schema are coupled by construction so
-    they cannot drift apart.
+    they cannot drift apart. `fold_mode` ("full" | "merge") picks the
+    fold kernel: the full [S+A] re-sort or the incremental rank-merge
+    (stash.py — bit-exact, fold-sort work scales with the ring instead
+    of the stash).
     """
     fanout_fn = fanout_l7 if app else fanout_l4
     meter_schema = APP_METER if app else FLOW_METER
@@ -128,7 +131,15 @@ def make_ingest_step(fanout_config: FanoutConfig, interval: int = 1, app: bool =
     sum_cols_np = np.asarray(sum_cols, np.int32)
     max_cols_np = np.asarray(max_cols, np.int32)
 
-    from .stash import _append_impl, _fold_impl
+    from ..ops.segment import SENTINEL_SLOT
+    from .stash import (
+        _append_impl,
+        _fold_impl,
+        _merge_fold_impl,
+        check_fold_mode,
+    )
+
+    check_fold_mode(fold_mode)
 
     def append(stash, acc, offset, tags, meters, valid):
         if batch_unique_cap is not None:
@@ -145,8 +156,15 @@ def make_ingest_step(fanout_config: FanoutConfig, interval: int = 1, app: bool =
         acc = _append_impl(acc, window, hi, lo, doc_tags, doc_meters, doc_valid, offset)
         return stash, acc
 
-    def fold(stash, acc):
-        return _fold_impl(stash, acc, sum_cols, max_cols)
+    if fold_mode == "merge":
+        def fold(stash, acc):
+            new_stash, new_acc, _fold_rows = _merge_fold_impl(
+                stash, acc, jnp.uint32(SENTINEL_SLOT), sum_cols, max_cols
+            )
+            return new_stash, new_acc
+    else:
+        def fold(stash, acc):
+            return _fold_impl(stash, acc, sum_cols, max_cols)
 
     return append, fold
 
@@ -239,7 +257,7 @@ class RollupPipeline:
         fanout_fn = self.fanout_fn
 
         def step(acc, offset, start_window, stash_valid, stash_evict,
-                 feeder_shed, tag_mat, meters, valid):
+                 feeder_shed, fold_rows, tag_mat, meters, valid):
             tags = {k: tag_mat[i] for i, k in enumerate(names)}
             aux = None
             if cap_u is not None:
@@ -257,7 +275,7 @@ class RollupPipeline:
                 ts, doc_valid, start_window, interval, aux=aux,
                 excess_hits=excess_hits, stash_valid=stash_valid,
                 stash_evictions=stash_evict, ring_fill=offset,
-                feeder_shed=feeder_shed,
+                feeder_shed=feeder_shed, fold_rows=fold_rows,
             )
             acc = _append_impl(
                 acc, window, hi, lo, doc_tags, doc_meters, gated, offset
@@ -337,11 +355,13 @@ class RollupPipeline:
         def dispatch(acc, offset, start_window):
             # stash lanes read at dispatch time (post any fold) — device
             # handles, no transfer; they fill the counter block's
-            # occupancy/eviction lanes inside the same fused call
+            # occupancy/eviction/fold_rows lanes inside the same fused
+            # call
             st = self.wm.state
             return self._step(
                 acc, offset, start_window, st.valid, st.dropped_overflow,
-                shed, staged.tag_mat, staged.meters, staged.valid,
+                shed, self.wm._fold_rows_dev,
+                staged.tag_mat, staged.meters, staged.valid,
             )
 
         flushed = self.wm.ingest_step(dispatch, rows, ring_rows=max_rows)

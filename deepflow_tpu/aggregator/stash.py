@@ -15,6 +15,13 @@ on the *newest* window's keys — older windows (about to flush) are never
 evicted. This mirrors the reference's backpressure stance of shedding
 newest data under overload (OverwriteQueue, libs/queue/queue.go:139)
 while protecting closing windows.
+
+Two fold strategies share this file (ARCHITECTURE.md "Fold strategies"):
+the full-sort fold (`_fold_impl` — re-sorts the [S+A] concat, the
+oracle) and the incremental merge-fold (`_merge_fold_impl` — sorts only
+the accumulator and rank-merges it against the standing stash order,
+optionally span-bounded for window advances). `WindowConfig.fold_mode`
+picks one; they are pinned bit-exact against each other.
 """
 
 from __future__ import annotations
@@ -26,8 +33,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from jax import lax
+
 from ..datamodel.schema import MeterSchema, TagSchema
-from ..ops.segment import SENTINEL_SLOT, groupby_reduce
+from ..ops.segment import (
+    SENTINEL_SLOT,
+    groupby_reduce,
+    groupby_reduce_sorted,
+    merge_order,
+    merge_ranks,
+)
+
+_U32_MAX = np.uint32(0xFFFFFFFF)
 
 
 @jax.tree_util.register_dataclass
@@ -203,6 +220,160 @@ def stash_fold(
     return collector_fold(state, acc, sum_cols, max_cols)
 
 
+# ---------------------------------------------------------------------------
+# Incremental merge-fold (ISSUE 5). The full-sort fold above re-sorts
+# the whole [S+A] stash+accumulator concatenation on every trigger even
+# though the stash is ALREADY sorted by (slot, key) — the fold-dominated
+# windowed advance (PERF.md §12 drain_ms) pays O((S+A) log(S+A)) 3-key
+# compare-exchange for state it holds sorted. The merge-fold sorts only
+# the accumulator's [A] rows, rank-merges them against the stash
+# (ops/segment.merge_ranks — searchsorted-based merge ranks, then one
+# single-key sort or scatter), and feeds the merged run to the SAME
+# segment reduce, so it is bit-exact vs `_fold_impl` including the
+# overflow stance (tests/test_merge_fold.py).
+#
+# It requires the CANONICAL stash layout: live rows form a positional,
+# (slot, key)-ascending prefix; dead rows (sentinel slot) fill the tail.
+# Every producer preserves it — `groupby_reduce` emits segments that
+# way, `stash_init` starts empty, and `stash_flush_range(compact=True)`
+# re-establishes it after punching out a closed-window prefix. The
+# per-window `stash_flush` oracle does NOT (it leaves holes in place);
+# fold_mode="merge" managers only ever drain through the compacting
+# range flush.
+
+
+def check_fold_mode(mode: str) -> str:
+    """THE fold_mode membership check — every config/entry point shares
+    it so a third mode lands everywhere at once."""
+    if mode not in ("full", "merge"):
+        raise ValueError(f"fold_mode must be 'full' or 'merge', got {mode!r}")
+    return mode
+
+
+def _fold_counted_impl(state: StashState, acc: AccumState, sum_cols_t, max_cols_t):
+    """`_fold_impl` + the fold_rows telemetry scalar: live rows the
+    fold's keyed sort touched (whole stash + whole accumulator — the
+    full-sort fold re-sorts everything). Rides the device counter
+    block's CB_FOLD_ROWS lane, zero extra host syncs."""
+    fold_rows = (
+        jnp.sum(state.valid) + jnp.sum(acc.slot != jnp.uint32(SENTINEL_SLOT))
+    ).astype(jnp.uint32)
+    new_state, new_acc = _fold_impl(state, acc, sum_cols_t, max_cols_t)
+    return new_state, new_acc, fold_rows
+
+
+collector_fold_counted = partial(
+    jax.jit, static_argnames=("sum_cols_t", "max_cols_t"), donate_argnums=(0, 1)
+)(_fold_counted_impl)
+
+
+def stash_fold_counted(
+    state: StashState, acc: AccumState, meter_schema: MeterSchema
+) -> tuple[StashState, AccumState, jnp.ndarray]:
+    """Schema-keyed `collector_fold_counted` → (state, acc, fold_rows)."""
+    sum_cols = tuple(int(i) for i in np.nonzero(meter_schema.sum_mask)[0])
+    max_cols = tuple(int(i) for i in np.nonzero(meter_schema.max_mask)[0])
+    return collector_fold_counted(state, acc, sum_cols, max_cols)
+
+
+def _merge_fold_impl(state: StashState, acc: AccumState, hi_window, sum_cols_t, max_cols_t):
+    """Rank-merge fold: sort [A], merge against the sorted [S] stash,
+    reduce the merged run — no full keyed re-sort of the stash lanes.
+
+    `hi_window` bounds the fold span: only acc rows with slot <
+    hi_window fold (sentinel-keyed rows never do — SENTINEL ≥ any hi);
+    the rest stay accumulated in the ring, untouched. Pass
+    SENTINEL_SLOT for the full-set fold (every live row folds, the ring
+    empties — same contract as `_fold_impl`). Requires the canonical
+    stash layout (see the section comment above); returns
+    (new_state, new_acc, fold_rows) where fold_rows counts the acc rows
+    this fold's keyed sort actually touched."""
+    s = state.capacity
+    a = acc.capacity
+    hi_window = jnp.asarray(hi_window, dtype=jnp.uint32)
+
+    fold_mask = acc.slot < hi_window
+    # normalized acc keys: out-of-span / invalid rows sort last, exactly
+    # like groupby_reduce's invalid-row re-keying in the full-sort fold
+    na_sl = jnp.where(fold_mask, acc.slot, jnp.uint32(SENTINEL_SLOT))
+    na_hi = jnp.where(fold_mask, acc.key_hi, jnp.uint32(_U32_MAX))
+    na_lo = jnp.where(fold_mask, acc.key_lo, jnp.uint32(_U32_MAX))
+    a_iota = jnp.arange(a, dtype=jnp.int32)
+    a_sl, a_hi, a_lo, a_perm = lax.sort((na_sl, na_hi, na_lo, a_iota), num_keys=3)
+
+    # normalized stash keys — already sorted by the canonical invariant
+    ns_sl = jnp.where(state.valid, state.slot, jnp.uint32(SENTINEL_SLOT))
+    ns_hi = jnp.where(state.valid, state.key_hi, jnp.uint32(_U32_MAX))
+    ns_lo = jnp.where(state.valid, state.key_lo, jnp.uint32(_U32_MAX))
+
+    rank_s, rank_a = merge_ranks((ns_sl, ns_hi, ns_lo), (a_sl, a_hi, a_lo))
+    # order maps merged position → concat([stash, acc]) row; the acc
+    # payload routes through a_perm so downstream gathers hit original
+    # ring rows (the reduce's tag/meter payloads are never pre-sorted)
+    order = merge_order(
+        rank_s, rank_a, jnp.arange(s, dtype=jnp.int32), s + a_perm
+    )
+
+    cat_sl = jnp.concatenate([ns_sl, na_sl])
+    cat_hi = jnp.concatenate([ns_hi, na_hi])
+    cat_lo = jnp.concatenate([ns_lo, na_lo])
+    cat_tags = jnp.concatenate([state.tags, acc.tags], axis=1)
+    # same transpose-at-fold stance as _merge_impl (module layout note)
+    cat_meters = jnp.transpose(jnp.concatenate([state.meters, acc.meters], axis=1))
+
+    g = groupby_reduce_sorted(
+        jnp.take(cat_sl, order),
+        jnp.take(cat_hi, order),
+        jnp.take(cat_lo, order),
+        order,
+        cat_tags,
+        cat_meters,
+        np.asarray(sum_cols_t, dtype=np.int32),
+        np.asarray(max_cols_t, dtype=np.int32),
+        out_capacity=s,
+    )
+
+    dropped = jnp.maximum(g.num_segments - s, 0)
+    new_state = StashState(
+        slot=g.slot,
+        key_hi=g.key_hi,
+        key_lo=g.key_lo,
+        tags=g.tags,
+        meters=g.meters,
+        valid=g.seg_valid,
+        dropped_overflow=state.dropped_overflow + dropped,
+    )
+    # consumed rows turn sentinel in place; out-of-span rows stay. Their
+    # ring slots are reclaimed when the next FULL fold resets the host
+    # fill cursor (plan_append cadence), not here.
+    new_acc = dataclasses.replace(
+        acc, slot=jnp.where(fold_mask, jnp.uint32(SENTINEL_SLOT), acc.slot)
+    )
+    fold_rows = jnp.sum(fold_mask).astype(jnp.uint32)
+    return new_state, new_acc, fold_rows
+
+
+collector_merge_fold = partial(
+    jax.jit, static_argnames=("sum_cols_t", "max_cols_t"), donate_argnums=(0, 1)
+)(_merge_fold_impl)
+
+
+def stash_merge_fold(
+    state: StashState,
+    acc: AccumState,
+    meter_schema: MeterSchema,
+    hi_window=None,
+) -> tuple[StashState, AccumState, jnp.ndarray]:
+    """Schema-keyed merge-fold → (state, acc, fold_rows). `hi_window`
+    None = full-set fold (ring empties — callers reset their fill
+    cursor); otherwise only acc rows with slot < hi_window fold (the
+    span-bounded window advance — callers must NOT reset fill)."""
+    sum_cols = tuple(int(i) for i in np.nonzero(meter_schema.sum_mask)[0])
+    max_cols = tuple(int(i) for i in np.nonzero(meter_schema.max_mask)[0])
+    hi = SENTINEL_SLOT if hi_window is None else np.uint32(hi_window)
+    return collector_merge_fold(state, acc, jnp.uint32(hi), sum_cols, max_cols)
+
+
 def plan_append(fill: int, capacity: int | None, rows: int) -> str:
     """Host-side accumulator decision shared by the window managers:
     'init' — no ring yet or one too small for this batch (caller must
@@ -267,7 +438,7 @@ def pack_u32_columns(slot, key_hi, key_lo, tags, meters, valid=None):
     )
 
 
-def _flush_range_impl(state: StashState, lo_window, hi_window):
+def _flush_range_impl(state: StashState, lo_window, hi_window, *, compact: bool = False):
     """Close every window in [lo_window, hi_window): compact their rows
     to the front of ONE row-major [S, 3+T+M] u32 matrix (window-id,
     key, tags, bit-cast meters per row) and reclaim their slots.
@@ -277,7 +448,16 @@ def _flush_range_impl(state: StashState, lo_window, hi_window):
     paths are bit-identical (pinned by tests/test_flush_range.py). The
     host fetches the row count, then only `packed[:total]` — two
     transfers per window advance, independent of how many windows closed.
-    """
+
+    `compact` (static) re-establishes the CANONICAL layout the
+    merge-fold requires (live rows = sorted positional prefix): on a
+    canonical input every flushed row sits in the positional prefix
+    [0, total) — the closing windows hold the smallest live slots — so
+    one roll of every lane by `total` moves the surviving run to the
+    front and the freshly-dead prefix behind the tail. Requires
+    lo_window ≤ every live slot (the window managers' advance protocol
+    guarantees it: older windows were flushed by earlier advances).
+    The flushed OUTPUT is identical either way."""
     lo = jnp.asarray(lo_window, dtype=jnp.uint32)
     hi = jnp.asarray(hi_window, dtype=jnp.uint32)
     mask = state.valid & (state.slot >= lo) & (state.slot < hi)
@@ -292,15 +472,27 @@ def _flush_range_impl(state: StashState, lo_window, hi_window):
     )  # [3+T+M, S]
     packed = jnp.take(cols, order, axis=1).T  # row-major [S, 3+T+M]
     total = jnp.sum(mask.astype(jnp.int32))
-    new_state = dataclasses.replace(
-        state,
-        slot=jnp.where(mask, jnp.uint32(SENTINEL_SLOT), state.slot),
-        valid=state.valid & ~mask,
-    )
+    new_slot = jnp.where(mask, jnp.uint32(SENTINEL_SLOT), state.slot)
+    new_valid = state.valid & ~mask
+    if compact:
+        idx = (iota + total) % state.capacity
+        new_state = StashState(
+            slot=jnp.take(new_slot, idx),
+            key_hi=jnp.take(state.key_hi, idx),
+            key_lo=jnp.take(state.key_lo, idx),
+            tags=jnp.take(state.tags, idx, axis=1),
+            meters=jnp.take(state.meters, idx, axis=1),
+            valid=jnp.take(new_valid, idx),
+            dropped_overflow=state.dropped_overflow,
+        )
+    else:
+        new_state = dataclasses.replace(state, slot=new_slot, valid=new_valid)
     return new_state, packed, total
 
 
-stash_flush_range = jax.jit(_flush_range_impl, donate_argnums=(0,))
+stash_flush_range = jax.jit(
+    _flush_range_impl, donate_argnums=(0,), static_argnames=("compact",)
+)
 
 
 def unpack_flush_rows(rows: np.ndarray, num_tags: int):

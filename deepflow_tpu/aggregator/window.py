@@ -49,6 +49,7 @@ from ..utils.spans import (
     SPAN_INGEST_DISPATCH,
     SPAN_STATS_FETCH,
     SPAN_WINDOW_ADVANCE,
+    SPAN_WINDOW_FOLD,
     SpanTracer,
 )
 from .stash import (
@@ -56,10 +57,12 @@ from .stash import (
     StashState,
     _append_impl,
     accum_init,
+    check_fold_mode,
     plan_append,
     stash_flush_range,
-    stash_fold,
+    stash_fold_counted,
     stash_init,
+    stash_merge_fold,
     unpack_flush_rows,
 )
 
@@ -85,8 +88,12 @@ def host_fetch(x) -> np.ndarray:
 # v2 (ISSUE 4): + feeder_shed — records the feeder runtime dropped
 # upstream of this batch's assembly, riding the same fetch so queue
 # pressure is visible in the device counter plane.
+# v3 (ISSUE 5): + fold_rows — rows the LAST fold's keyed sort touched
+# (full-sort mode: whole live stash + ring; merge mode: only the acc
+# rows that folded, span-bounded on advances), so the merge-fold's row
+# savings are visible in deepflow_system without a new fetch.
 
-COUNTER_BLOCK_VERSION = 2
+COUNTER_BLOCK_VERSION = 3
 (
     CB_VERSION,  # constant COUNTER_BLOCK_VERSION
     CB_T_MAX,  # max valid timestamp (pre-gate)
@@ -99,12 +106,13 @@ COUNTER_BLOCK_VERSION = 2
     CB_STASH_EVICTIONS,  # cumulative stash overflow drops at dispatch
     CB_RING_FILL,  # accumulator rows already occupied at dispatch
     CB_FEEDER_SHED,  # records shed by the feeder before this batch
-) = range(11)
-CB_LEN = 11
+    CB_FOLD_ROWS,  # rows the last fold's keyed sort touched
+) = range(12)
+CB_LEN = 12
 CB_FIELDS = (
     "version", "t_max", "t_min", "n_valid", "n_late", "prereduce_shed",
     "excess_word_hits", "stash_occupancy", "stash_evictions", "ring_fill",
-    "feeder_shed",
+    "feeder_shed", "fold_rows",
 )
 
 
@@ -144,6 +152,7 @@ def batch_counter_block(
     stash_evictions=None,
     ring_fill=None,
     feeder_shed=None,
+    fold_rows=None,
 ):
     """`batch_stats` widened into the versioned counter block (traced).
 
@@ -151,9 +160,10 @@ def batch_counter_block(
     excess-word hits (the datamodel/code.py contract guard), stash
     occupancy summed from the (device-resident — zero transfer) valid
     plane, cumulative eviction count, the accumulator-ring fill at
-    dispatch, and the feeder's upstream shed count for this batch. All
-    optional inputs default to zero so every caller of the old 5-vector
-    shape can widen incrementally."""
+    dispatch, the feeder's upstream shed count for this batch, and the
+    last fold's touched-row count (a device scalar the fold kernels
+    return — ISSUE 5). All optional inputs default to zero so every
+    caller of the old 5-vector shape can widen incrementally."""
     gated, window, stats = batch_stats(timestamp, valid, start_window, interval, aux=aux)
 
     def u32(x):
@@ -169,7 +179,7 @@ def batch_counter_block(
             jnp.full((1,), COUNTER_BLOCK_VERSION, dtype=jnp.uint32),
             stats,
             jnp.stack([u32(excess_hits), occ, u32(stash_evictions),
-                       u32(ring_fill), u32(feeder_shed)]),
+                       u32(ring_fill), u32(feeder_shed), u32(fold_rows)]),
         ]
     )
     return gated, window, block
@@ -177,17 +187,17 @@ def batch_counter_block(
 
 @partial(jax.jit, donate_argnums=(0,), static_argnames=("interval",))
 def _raw_append_step(acc, offset, start_window, stash_valid, stash_evict,
-                     feeder_shed, timestamp, key_hi, key_lo, tags, meters,
-                     valid, *, interval):
+                     feeder_shed, fold_rows, timestamp, key_hi, key_lo, tags,
+                     meters, valid, *, interval):
     """One jitted call per raw doc batch: late gate + counter block +
-    ring append. `stash_valid`/`stash_evict` are device-resident stash
-    lanes folded into the block — inputs already on device, no
-    transfer. `feeder_shed` is the feeder's upstream drop count for
-    this batch (a host scalar riding the upload direction)."""
+    ring append. `stash_valid`/`stash_evict`/`fold_rows` are
+    device-resident lanes folded into the block — inputs already on
+    device, no transfer. `feeder_shed` is the feeder's upstream drop
+    count for this batch (a host scalar riding the upload direction)."""
     gated, window, block = batch_counter_block(
         timestamp, valid, start_window, interval,
         stash_valid=stash_valid, stash_evictions=stash_evict, ring_fill=offset,
-        feeder_shed=feeder_shed,
+        feeder_shed=feeder_shed, fold_rows=fold_rows,
     )
     acc = _append_impl(acc, window, key_hi, key_lo, tags, meters, gated, offset)
     return acc, block
@@ -258,6 +268,21 @@ class WindowConfig:
     # ring first). 1 = per-batch fetch (today's behavior). Mutually
     # exclusive with async_drain — the ring subsumes its deferral.
     stats_ring: int = 1
+    # Fold strategy (ISSUE 5). "full": every fold re-sorts the whole
+    # [S+A] stash+accumulator concat (the oracle). "merge": exploit the
+    # stash's standing (slot, key) sort — sort only the accumulator and
+    # rank-merge it in (stash.stash_merge_fold); window advances fold
+    # ONLY the acc rows of the closing span and flushes re-canonicalize
+    # via the compacting range flush. Bit-exact vs "full" (flushed rows,
+    # drop counters — tests/test_merge_fold.py) whenever the stash
+    # capacity holds the live segments; under stash OVERFLOW "merge"
+    # may defer shedding (open-window rows still in the ring are not
+    # eviction candidates until folded), never shed more. Default stays
+    # "full" until on-chip numbers land (PERF.md §15).
+    fold_mode: str = "full"
+
+    def __post_init__(self):
+        check_fold_mode(self.fold_mode)
 
     @property
     def ring(self) -> int:
@@ -318,6 +343,13 @@ class WindowManager:
         self.stash_occupancy = 0
         self.stash_evictions = 0
         self.device_ring_fill = 0
+        self.fold_rows = 0  # CB_FOLD_ROWS mirror: last fold's sorted rows
+        # device scalar the fold kernels return; rides into the next
+        # dispatch's counter block like the stash lanes (zero transfer)
+        self._fold_rows_dev = jnp.zeros((), jnp.uint32)
+        # merge mode drains through the compacting range flush so the
+        # stash keeps the canonical layout the rank-merge requires
+        self._flush_compact = config.fold_mode == "merge"
         self.n_advances = 0
         # device↔host transfer accounting (the host_fetch seam)
         self.host_fetches = 0
@@ -388,10 +420,35 @@ class WindowManager:
             return out
 
     def _fold(self):
+        """Full-set fold: every accumulated row reaches the stash and
+        the ring resets. fold_mode picks the kernel — the full [S+A]
+        re-sort or the rank merge — but both consume the whole ring."""
         if self.fill == 0:
             return
-        self.state, self.acc = stash_fold(self.state, self.acc, self.meter_schema)
+        with self.tracer.span(SPAN_WINDOW_FOLD):
+            if self.config.fold_mode == "merge":
+                self.state, self.acc, self._fold_rows_dev = stash_merge_fold(
+                    self.state, self.acc, self.meter_schema
+                )
+            else:
+                self.state, self.acc, self._fold_rows_dev = stash_fold_counted(
+                    self.state, self.acc, self.meter_schema
+                )
         self.fill = 0
+
+    def _fold_span(self, hi_window: int):
+        """Span-bounded advance fold (fold_mode="merge"): merge ONLY the
+        acc rows with slot < hi_window — the windows about to flush —
+        and leave the rest accumulated. `fill` stays put: consumed rows
+        turn sentinel in place and their ring slots are reclaimed by the
+        next full fold (plan_append cadence)."""
+        if self.fill == 0:
+            return
+        with self.tracer.span(SPAN_WINDOW_FOLD):
+            self.state, self.acc, self._fold_rows_dev = stash_merge_fold(
+                self.state, self.acc, self.meter_schema,
+                hi_window=np.uint32(hi_window),
+            )
 
     def window_of(self, timestamp):
         return timestamp // self.config.interval
@@ -448,6 +505,7 @@ class WindowManager:
             self.stash_evictions = vec[CB_STASH_EVICTIONS]
             self.device_ring_fill = vec[CB_RING_FILL]
             self.feeder_shed += vec[CB_FEEDER_SHED]
+            self.fold_rows = vec[CB_FOLD_ROWS]
         elif len(vec) == 5:  # legacy [t_max, t_min, n_valid, n_late, aux]
             t_max, t_min, n_valid, n_late, aux = vec
         else:
@@ -480,11 +538,18 @@ class WindowManager:
         new_start = self.window_of(max(t_max - self.config.delay, 0))
         if self.start_window < new_start:
             with self.tracer.span(SPAN_WINDOW_ADVANCE):
-                self._fold()  # flushed windows must see every accumulated row
+                # flushed windows must see every accumulated row of the
+                # closing span; merge mode folds ONLY that span and
+                # leaves open windows' rows in the ring
+                if self.config.fold_mode == "merge":
+                    self._fold_span(new_start)
+                else:
+                    self._fold()
                 self.state, packed, total = stash_flush_range(
                     self.state,
                     np.uint32(self.start_window),
                     np.uint32(new_start),
+                    compact=self._flush_compact,
                 )
                 self._pending_flush.append((packed, total))
                 self.start_window = new_start
@@ -514,12 +579,13 @@ class WindowManager:
 
         def dispatch(acc, offset, start_window):
             # read the stash AT DISPATCH time (ingest_step may fold
-            # first) so the block's occupancy lane sees the post-fold
-            # plane; both lanes are device-resident — zero transfer
+            # first) so the block's occupancy/fold_rows lanes see the
+            # post-fold plane; all lanes are device-resident — zero
+            # transfer
             st = self.state
             return _raw_append_step(
                 acc, offset, start_window, st.valid, st.dropped_overflow,
-                jnp.uint32(feeder_shed),
+                jnp.uint32(feeder_shed), self._fold_rows_dev,
                 timestamp, key_hi, key_lo, tags, meters, valid,
                 interval=interval,
             )
@@ -554,6 +620,16 @@ class WindowManager:
         plan = plan_append(self.fill, self.acc.capacity if self.acc else None, rows)
         if plan == "init":
             self._fold()  # pending rows must reach the stash before the ring is replaced
+            if self.fill:
+                # the plan_append docstring warns that replacing a ring
+                # with pending rows silently loses them — make that
+                # failure LOUD if a refactor ever bypasses the fold
+                # (e.g. wires a span-bounded fold in here)
+                raise AssertionError(
+                    f"accumulator ring re-init with {self.fill} pending "
+                    "rows — they would be silently lost (plan_append "
+                    "'init' contract: fold before replacing the ring)"
+                )
             base = max(ring_rows or rows, rows)
             self.acc = accum_init(
                 max(self.config.accum_batches * base, rows),
@@ -634,7 +710,7 @@ class WindowManager:
             return flushed
         self._fold()
         self.state, packed, total = stash_flush_range(
-            self.state, np.uint32(0), _U32_MAX
+            self.state, np.uint32(0), _U32_MAX, compact=self._flush_compact
         )
         self._pending_flush.append((packed, total))
         flushed += self._settle_ready()
@@ -667,6 +743,11 @@ class WindowManager:
             # acc_fill minus the in-flight batch; drift = host/device
             # bookkeeping bug
             "device_ring_fill": self.device_ring_fill,
+            # rows the last fold's keyed sort touched (CB_FOLD_ROWS, as
+            # of the last fetched block): full-sort mode counts the
+            # whole live stash + ring, merge mode only the folded acc
+            # rows — the lane the fold-work perf gate watches (ISSUE 5)
+            "fold_rows": self.fold_rows,
             "window_advances": self.n_advances,
             "host_fetches": self.host_fetches,
             "bytes_fetched": self.bytes_fetched,

@@ -73,6 +73,19 @@ def _use_fused_gather() -> bool:
     variant for on-chip A/B runs."""
     return os.environ.get("DEEPFLOW_FUSED_GATHER", "1") != "0"
 
+
+def _use_merge_scatter() -> bool:
+    """Merged-order construction for the incremental merge-fold
+    (aggregator/stash.py): default is a single-key `lax.sort` over the
+    precomputed merge ranks (2 lanes, 1 u32 key — ~a third of the
+    compare work of the 3-key fold sort it replaces, and the primitive
+    this repo trusts on TPU). DEEPFLOW_MERGE_SCATTER=1 switches to the
+    truly-linear one-scatter construction for on-chip A/B — scatter
+    lowers poorly on TPU historically (module docstring), but this one
+    is a plain unique-index i32 scatter, not a scatter-add, so it is
+    worth measuring."""
+    return os.environ.get("DEEPFLOW_MERGE_SCATTER", "0") == "1"
+
 _U32_MAX = np.uint32(0xFFFFFFFF)
 
 
@@ -118,10 +131,6 @@ def groupby_reduce(
         in num_segments so callers can account overflow. Defaults to N.
     """
     n = slot.shape[0]
-    m = meters_rows.shape[1]
-    cap = int(out_capacity) if out_capacity is not None else n
-    sum_cols = np.asarray(sum_cols, np.int32)
-    max_cols = np.asarray(max_cols, np.int32)
 
     slot = jnp.where(valid, slot, jnp.uint32(SENTINEL_SLOT))
     key_hi = jnp.where(valid, key_hi, jnp.uint32(_U32_MAX))
@@ -129,6 +138,42 @@ def groupby_reduce(
 
     iota = jnp.arange(n, dtype=jnp.int32)
     s_slot, s_hi, s_lo, perm = lax.sort((slot, key_hi, key_lo, iota), num_keys=3)
+    return groupby_reduce_sorted(
+        s_slot, s_hi, s_lo, perm, tags_t, meters_rows,
+        sum_cols, max_cols, out_capacity=out_capacity,
+    )
+
+
+def groupby_reduce_sorted(
+    s_slot,
+    s_hi,
+    s_lo,
+    perm,
+    tags_t,
+    meters_rows,
+    sum_cols: np.ndarray,
+    max_cols: np.ndarray,
+    out_capacity: int | None = None,
+) -> Grouped:
+    """The post-sort phase of `groupby_reduce`, for callers that already
+    hold the key lanes in sorted order — the incremental merge-fold
+    (aggregator/stash.py) constructs them with a rank-merge instead of a
+    full keyed re-sort, then reuses this exact reduce so the two fold
+    paths cannot drift.
+
+    Args:
+      s_slot/s_hi/s_lo: [N] u32 key lanes in ascending (slot, hi, lo)
+        order, PRE-normalized — invalid rows keyed
+        (SENTINEL_SLOT, U32_MAX, U32_MAX) so they sort last.
+      perm: [N] i32 mapping sorted position → original row index into
+        tags_t ([T, N]) / meters_rows ([N, M]), exactly what `lax.sort`
+        with an iota payload produces.
+    """
+    n = s_slot.shape[0]
+    m = meters_rows.shape[1]
+    cap = int(out_capacity) if out_capacity is not None else n
+    sum_cols = np.asarray(sum_cols, np.int32)
+    max_cols = np.asarray(max_cols, np.int32)
 
     head = jnp.concatenate(
         [
@@ -233,3 +278,95 @@ def groupby_reduce(
         seg_valid=seg_valid,
         num_segments=num_seg,
     )
+
+
+# ---------------------------------------------------------------------------
+# Rank-merge primitives for the incremental merge-fold (ISSUE 5).
+#
+# Two sequences already sorted by the same lexicographic (slot, hi, lo)
+# u32 triple merge in O(A log S + S log A) comparisons: each element's
+# merged position ("merge rank") is its own index plus the count of
+# other-sequence elements before it, found by a vectorized binary
+# search. Ranks are a permutation of [0, S+A) by construction, so the
+# merged order follows from one cheap single-key sort (or one scatter —
+# `_use_merge_scatter`), never a full keyed re-sort of both sequences.
+
+
+def _lex_less(a_sl, a_hi, a_lo, b_sl, b_hi, b_lo):
+    """Elementwise lexicographic (slot, hi, lo) u32 triple compare."""
+    return (a_sl < b_sl) | (
+        (a_sl == b_sl) & ((a_hi < b_hi) | ((a_hi == b_hi) & (a_lo < b_lo)))
+    )
+
+
+def lex_searchsorted(keys, queries, *, side: str):
+    """`jnp.searchsorted` generalized to a lexicographic u32 triple.
+
+    keys: (slot, hi, lo) arrays [N], ascending under `_lex_less`.
+    queries: (slot, hi, lo) arrays [Q]. Returns [Q] i32 insertion
+    points (side="left": count of keys strictly less; side="right":
+    count of keys less-or-equal). Vectorized binary search — a static
+    ceil(log2(N+1)) unroll of one 3-lane gather + compare per step, so
+    Q queries cost O(Q log N) instead of packing 96-bit keys into a
+    scalar the 32-bit lanes cannot hold.
+    """
+    if side not in ("left", "right"):
+        raise ValueError(f"side must be 'left' or 'right', got {side!r}")
+    k_sl, k_hi, k_lo = keys
+    q_sl, q_hi, q_lo = queries
+    n = int(k_sl.shape[0])
+    lo = jnp.zeros(q_sl.shape, jnp.int32)
+    if n == 0:
+        return lo
+    hi = jnp.full(q_sl.shape, n, jnp.int32)
+    for _ in range(n.bit_length()):
+        mid = (lo + hi) >> 1
+        m_sl = jnp.take(k_sl, mid)
+        m_hi = jnp.take(k_hi, mid)
+        m_lo = jnp.take(k_lo, mid)
+        if side == "left":
+            go_right = _lex_less(m_sl, m_hi, m_lo, q_sl, q_hi, q_lo)
+        else:
+            go_right = ~_lex_less(q_sl, q_hi, q_lo, m_sl, m_hi, m_lo)
+        active = lo < hi
+        lo = jnp.where(active & go_right, mid + 1, lo)
+        hi = jnp.where(active & ~go_right, mid, hi)
+    return lo
+
+
+def merge_ranks(first, second):
+    """Merged positions for two key-sorted (slot, hi, lo) sequences.
+
+    Tie-break: `first` elements precede equal `second` elements, and
+    each sequence keeps its internal order — exactly the order a STABLE
+    `lax.sort` over their concatenation (first then second) produces,
+    which is what makes the merge-fold bit-exact against the full-sort
+    fold. Returns (rank_first [S], rank_second [A]), together a
+    permutation of [0, S+A).
+    """
+    nf = int(first[0].shape[0])
+    ns = int(second[0].shape[0])
+    rank_f = jnp.arange(nf, dtype=jnp.int32) + lex_searchsorted(
+        second, first, side="left"
+    )
+    rank_s = jnp.arange(ns, dtype=jnp.int32) + lex_searchsorted(
+        first, second, side="right"
+    )
+    return rank_f, rank_s
+
+
+def merge_order(rank_f, rank_s, payload_f, payload_s):
+    """Invert merge ranks into a gather order: returns [S+A] i32 where
+    position p holds the payload of the element whose merged rank is p.
+    Default: single-u32-key 2-lane sort; DEEPFLOW_MERGE_SCATTER=1 uses
+    the linear unique-index scatter instead (on-chip A/B knob)."""
+    rank = jnp.concatenate([rank_f, rank_s])
+    payload = jnp.concatenate([payload_f, payload_s]).astype(jnp.int32)
+    if _use_merge_scatter():
+        return (
+            jnp.zeros((rank.shape[0],), jnp.int32)
+            .at[rank]
+            .set(payload, unique_indices=True)
+        )
+    _, order = lax.sort((rank.astype(jnp.uint32), payload), num_keys=1)
+    return order

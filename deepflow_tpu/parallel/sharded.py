@@ -40,13 +40,17 @@ from ..utils.spans import (
     SPAN_FLUSH_DRAIN,
     SPAN_INGEST_DISPATCH,
     SPAN_WINDOW_ADVANCE,
+    SPAN_WINDOW_FOLD,
     SpanTracer,
 )
 from ..utils.stats import register_countable
 from ..aggregator.stash import (
     AccumState,
     StashState,
+    _fold_counted_impl,
+    _merge_fold_impl,
     accum_init,
+    check_fold_mode,
     plan_append,
     stash_init,
 )
@@ -84,6 +88,14 @@ class ShardedConfig:
     # None = off. Bounds each batch's unique raw keys; overflow is shed
     # and counted in the device stash's overflow counter.
     batch_unique_cap: int | None = None
+    # fold strategy (ISSUE 5) — same contract as WindowConfig.fold_mode:
+    # "full" re-sorts the [S+A] concat per device, "merge" rank-merges
+    # the sorted accumulator against the standing stash order and
+    # span-bounds the advance fold. Bit-exact (tests/test_merge_fold.py).
+    fold_mode: str = "full"
+
+    def __post_init__(self):
+        check_fold_mode(self.fold_mode)
 
 
 class ShardedPipeline:
@@ -133,7 +145,9 @@ class ShardedPipeline:
     # -- step -----------------------------------------------------------
     def _build_step(self):
         c = self.config
-        base_append, self._base_fold = make_ingest_step(
+        # only the append half is driven here — _build_fold assembles the
+        # modal fold kernels directly (it needs the fold_rows scalar)
+        base_append, _ = make_ingest_step(
             c.fanout, c.interval, batch_unique_cap=c.batch_unique_cap
         )
         t_idx = TAG_SCHEMA.index
@@ -190,19 +204,36 @@ class ShardedPipeline:
         return jax.jit(mapped, donate_argnums=(0, 1, 3))
 
     def _build_fold(self):
-        def device_fold(stash, acc):
+        sum_cols = tuple(int(i) for i in np.nonzero(FLOW_METER.sum_mask)[0])
+        max_cols = tuple(int(i) for i in np.nonzero(FLOW_METER.max_mask)[0])
+        merge = self.config.fold_mode == "merge"
+
+        def device_fold(stash, acc, hi_window):
             stash1 = jax.tree.map(lambda x: x[0], stash)
             acc1 = jax.tree.map(lambda x: x[0], acc)
-            new_stash, new_acc = self._base_fold(stash1, acc1)
+            if merge:
+                new_stash, new_acc, rows = _merge_fold_impl(
+                    stash1, acc1, hi_window, sum_cols, max_cols
+                )
+            else:
+                # full mode ignores the span bound (the managers never
+                # span-fold in full mode — host-side guard)
+                new_stash, new_acc, rows = _fold_counted_impl(
+                    stash1, acc1, sum_cols, max_cols
+                )
             expand = lambda x: x[None]
-            return jax.tree.map(expand, new_stash), jax.tree.map(expand, new_acc)
+            return (
+                jax.tree.map(expand, new_stash),
+                jax.tree.map(expand, new_acc),
+                rows[None],
+            )
 
         pspec = P(self.axes)
         mapped = shard_map(
             device_fold,
             mesh=self.mesh,
-            in_specs=(pspec, pspec),
-            out_specs=(pspec, pspec),
+            in_specs=(pspec, pspec, P()),
+            out_specs=(pspec, pspec, pspec),
         )
         return jax.jit(mapped, donate_argnums=(0, 1))
 
@@ -230,10 +261,19 @@ class ShardedPipeline:
         valid = shard_batch(jnp.asarray(valid))
         return self._step(stash, acc, jnp.int32(offset), sketches, tag_mat, meters, valid)
 
-    def fold(self, stash, acc):
-        """Amortized per-device sort+reduce of accumulated rows into the
-        stash (host fires it at accum_batches cadence and before flushes)."""
-        return self._fold(stash, acc)
+    def fold(self, stash, acc, hi_window=None):
+        """Amortized per-device fold of accumulated rows into the stash
+        (host fires it at accum_batches cadence and before flushes).
+        Returns (stash, acc, fold_rows [D] u32 — rows each device's fold
+        keyed-sort touched). `hi_window` (fold_mode="merge" only)
+        span-bounds the fold to acc rows with slot < hi_window; the rest
+        stay accumulated — callers must NOT reset their fill cursor."""
+        if hi_window is not None and self.config.fold_mode != "merge":
+            raise ValueError("span-bounded fold requires fold_mode='merge'")
+        from ..ops.segment import SENTINEL_SLOT
+
+        hi = jnp.uint32(SENTINEL_SLOT if hi_window is None else hi_window)
+        return self._fold(stash, acc, hi)
 
     # -- window close ---------------------------------------------------
     def _build_window_close(self):
@@ -302,14 +342,28 @@ class ShardedPipeline:
         This is the per-window oracle shape; the production drain is
         `flush_range` (all closed windows in one call — PERF.md §8).
         """
+        if self.config.fold_mode == "merge":
+            # stash_flush punches sentinel holes mid-prefix, silently
+            # breaking the canonical layout the rank-merge binary-search
+            # requires — merge mode must drain through flush_range
+            raise ValueError(
+                "flush_window (per-window oracle) breaks the canonical "
+                "stash layout fold_mode='merge' requires; use flush_range"
+            )
         return self._flush(stash, jnp.asarray(window_idx, dtype=jnp.uint32))
 
     def _build_flush_range(self):
         from ..aggregator.stash import _flush_range_impl
 
+        # merge mode drains through the compacting flush so each device
+        # stash keeps the canonical layout the rank-merge requires
+        compact = self.config.fold_mode == "merge"
+
         def fr(stash, lo, hi):
             stash1 = jax.tree.map(lambda x: x[0], stash)
-            new_state, packed, total = _flush_range_impl(stash1, lo, hi)
+            new_state, packed, total = _flush_range_impl(
+                stash1, lo, hi, compact=compact
+            )
             expand = lambda x: x[None]
             return jax.tree.map(expand, new_state), packed[None], total[None]
 
@@ -355,6 +409,11 @@ class ShardedWindowManager:
         self.total_docs_in = 0
         self.total_flushed = 0
         self.n_advances = 0
+        # last fold's keyed-sort row count: device [D] handle updated by
+        # every fold, host mirror refreshed by the advance drain's
+        # EXISTING totals fetch (bundled — no new steady-state sync)
+        self.fold_rows = 0
+        self._fold_rows_dev = None
         # merged sketch views of the last closed window (None until one closes)
         self.global_view = None
         self.pod_1m = None
@@ -397,6 +456,12 @@ class ShardedWindowManager:
             "drop_before_window": self.drop_before_window,
             "acc_fill": self.fill,
             "window_advances": self.n_advances,
+            # summed-over-devices rows the last DRAINED fold keyed-sort
+            # touched (full mode: live stash + ring; merge mode: folded
+            # acc rows only). Mirrored at advance drains — capacity
+            # folds between advances update it at the next drain, never
+            # with an extra fetch (fetch-free Countable contract).
+            "fold_rows": self.fold_rows,
             "host_fetches": self.host_fetches,
             "bytes_fetched": self.bytes_fetched,
             "bytes_uploaded": self.bytes_uploaded,
@@ -407,10 +472,26 @@ class ShardedWindowManager:
         return {"counters": self.get_counters(), "spans": self.tracer.summary()}
 
     def _fold(self):
+        """Full-set fold (kernel per pipe.config.fold_mode): the ring
+        empties and the fill cursor resets."""
         if self.fill == 0 or self.acc is None:
             return
-        self.stash, self.acc = self.pipe.fold(self.stash, self.acc)
+        with self.tracer.span(SPAN_WINDOW_FOLD):
+            self.stash, self.acc, self._fold_rows_dev = self.pipe.fold(
+                self.stash, self.acc
+            )
         self.fill = 0
+
+    def _fold_span(self, hi_window: int):
+        """Span-bounded advance fold (fold_mode="merge"): fold only acc
+        rows with slot < hi_window; `fill` stays put (consumed rows turn
+        sentinel in place — the next full fold reclaims the ring)."""
+        if self.fill == 0 or self.acc is None:
+            return
+        with self.tracer.span(SPAN_WINDOW_FOLD):
+            self.stash, self.acc, self._fold_rows_dev = self.pipe.fold(
+                self.stash, self.acc, hi_window=np.uint32(hi_window)
+            )
 
     def _drain_range(self, lo: int, hi: int):
         """Flush [lo, hi) from every device stash in one fused call and
@@ -426,7 +507,17 @@ class ShardedWindowManager:
         self.stash, packed, totals = self.pipe.flush_range(
             self.stash, np.uint32(lo), np.uint32(hi)
         )
-        totals_np = self._fetch(totals)  # [D]
+        d = self.pipe.n_devices
+        # the fold_rows mirror rides the totals fetch — one [2D] scalar
+        # vector instead of [D], zero additional host syncs
+        fr_dev = self._fold_rows_dev
+        if fr_dev is None:
+            fr_dev = jnp.zeros((d,), jnp.uint32)
+        bundled = self._fetch(
+            jnp.concatenate([totals, fr_dev.astype(jnp.int32)])
+        )  # [2D]
+        totals_np = bundled[:d]
+        self.fold_rows = int(bundled[d:].sum())
         max_t = int(totals_np.max())
         if max_t == 0:
             return []
@@ -505,6 +596,14 @@ class ShardedWindowManager:
         plan = plan_append(self.fill, cap, rows_per_device)
         if plan == "init":
             self._fold()  # pending rows must reach the stash before the ring is replaced
+            if self.fill:
+                # plan_append 'init' contract (stash.py): replacing a
+                # ring with pending rows silently loses them — trip
+                # loudly if a refactor ever bypasses the full fold here
+                raise AssertionError(
+                    f"accumulator ring re-init with {self.fill} pending "
+                    "per-device rows — fold before replacing the ring"
+                )
             self.acc = self.pipe.init_acc(max(rows_per_device, 1))
             self.fill = 0
         elif plan == "fold":
@@ -524,7 +623,12 @@ class ShardedWindowManager:
         flushed = []
         if advancing:
             t0 = time.perf_counter()
-            self._fold()  # flushed windows must see every accumulated row
+            # flushed windows must see every accumulated row of the
+            # closing span; merge mode folds ONLY that span
+            if self.pipe.config.fold_mode == "merge":
+                self._fold_span(new_start)
+            else:
+                self._fold()
             self.tracer.record(
                 SPAN_WINDOW_ADVANCE,
                 close_us + int((time.perf_counter() - t0) * 1e6),

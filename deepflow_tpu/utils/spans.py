@@ -43,6 +43,11 @@ import numpy as np
 SPAN_INGEST_DISPATCH = "ingest.dispatch"  # fused jit step dispatch (async — host-side cost)
 SPAN_STATS_FETCH = "stats.fetch"  # the ONE per-batch device→host stats sync
 SPAN_WINDOW_ADVANCE = "window.advance"  # fold + flush_range dispatch on window close
+# fold dispatch alone (capacity-triggered AND the advance's span fold) —
+# nested inside window.advance when the advance fires it, so the
+# fold-dominated share of drain_ms is attributable on its own (ISSUE 5;
+# this is the lane the merge-fold exists to shrink)
+SPAN_WINDOW_FOLD = "window.fold"
 SPAN_FLUSH_DRAIN = "flush.drain"  # packed flush fetch + per-window split
 SPAN_CHECKPOINT_SAVE = "checkpoint.save"  # window-state snapshot to .npz
 
@@ -57,6 +62,7 @@ PIPELINE_SPAN_NAMES = (
     SPAN_INGEST_DISPATCH,
     SPAN_STATS_FETCH,
     SPAN_WINDOW_ADVANCE,
+    SPAN_WINDOW_FOLD,
     SPAN_FLUSH_DRAIN,
     SPAN_CHECKPOINT_SAVE,
 )

@@ -164,7 +164,7 @@ def test_sharded_flush_range_matches_per_window_loop():
         stash, acc, sketches = pipe.step(
             stash, acc, i * 4 * 64, sketches, fb.tags, fb.meters, fb.valid
         )
-    stash, acc = pipe.fold(stash, acc)
+    stash, acc, _fold_rows = pipe.fold(stash, acc)
 
     lo, hi = 9000, 9003
     T = TAG_SCHEMA.num_fields

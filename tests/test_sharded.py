@@ -33,7 +33,7 @@ def test_sharded_step_runs_and_counts_docs():
     fb = _batch_for(pipe, 128)
     acc = pipe.init_acc(4 * 128)
     stash, acc, sketches = pipe.step(stash, acc, 0, sketches, fb.tags, fb.meters, fb.valid)
-    stash, acc = pipe.fold(stash, acc)
+    stash, acc, _fold_rows = pipe.fold(stash, acc)
 
     # every shard should now hold some valid stash rows
     valid = np.asarray(stash.valid)
@@ -59,7 +59,7 @@ def test_sharded_total_meters_match_input():
 
     acc = pipe.init_acc(4 * 64)
     stash, acc, sketches = pipe.step(stash, acc, 0, sketches, fb.tags, fb.meters, fb.valid)
-    stash, acc = pipe.fold(stash, acc)
+    stash, acc, _fold_rows = pipe.fold(stash, acc)
 
     valid = np.asarray(stash.valid)
     # stash payloads are column-major [D, M, S] / [D, T, S]
