@@ -422,12 +422,38 @@ def config7(quick: bool):
          partial=rec.get("partial", False), error=rec.get("error"))
 
 
+def config8(quick: bool):
+    """Journal overhead A/B (ISSUE 6): the config6 feeder workload run
+    journal-off vs journal-on (vs journal-on+fsync) via
+    bench/journal_probe.py — the vs line is the buffered-journal
+    overhead in percent (the crash-safety tax on steady-state ingest;
+    protocol + committed numbers in PERF.md §16)."""
+    import os
+    import subprocess
+
+    env = {**os.environ, "JOURNAL_ITERS": "16" if quick else "48"}
+    out = subprocess.run(
+        [sys.executable, "bench/journal_probe.py"],
+        capture_output=True, text=True, timeout=1200, env=env,
+    )
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    if rec.get("partial"):
+        emit("c8_journal_overhead", 0, "error", 0, error=rec.get("error"))
+        return
+    emit("c8_journal_overhead", rec["journal_on"]["rec_s"], "records/s",
+         rec["overhead_pct"],
+         overhead_fsync_pct=rec["overhead_fsync_pct"],
+         journal_off=rec["journal_off"], journal_on=rec["journal_on"],
+         journal_on_fsync=rec["journal_on_fsync"], buckets=rec["buckets"])
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--cpu", action="store_true")
     p.add_argument("--quick", action="store_true")
     args = p.parse_args()
-    for fn in (config1, config2, config3, config4, config5, config6, config7):
+    for fn in (config1, config2, config3, config4, config5, config6, config7,
+               config8):
         try:
             fn(args.quick)
         except Exception as e:  # one config must not kill the others

@@ -7,22 +7,36 @@ the state a preempted chip loses. These helpers serialize a
 WindowManager to one .npz so an evicted worker resumes mid-window
 instead of dropping every open window's partial aggregates.
 
-Format v2: ONE packed u32 matrix per direction — the stash leaves
-(slot/keys/valid/tags/bit-cast meters) concatenate on device into a
-single [4+T+M, S] array fetched in one transfer, and restore uploads one
-matrix and splits it back in a single jitted call. v1 paid the PERF.md
-§8 per-leaf transfer tax: 7 stash + 5 accumulator round trips per
-save/restore. The v1 LOAD branch was removed after two rounds of
-v2-only writers (ROADMAP): v1 files also predate the r6 packed-word key
-fingerprint, so their stash keys could never merge with freshly-hashed
-rows anyway — loading one now raises with a re-save instruction instead
-of resuming into silently unmergeable state.
+Format v3 (ISSUE 6): v2's one-packed-u32-matrix-per-direction layout
+plus crash-safety — the file is written to a temp name and
+`os.replace`d into place (a mid-write kill leaves the PREVIOUS
+checkpoint intact, never a torn file), meta embeds a sha256 content
+digest over every array, and the loader fails LOUDLY (a ValueError
+naming the file and the failure class, not a numpy/zipfile traceback)
+on truncation or digest mismatch. Meta also carries the feeder's
+journal barrier (epoch, offset) when saved through
+`FeederRuntime.checkpoint`, closing the journal+snapshot recovery
+loop. v2 files (pre-digest) still load; the v1 LOAD branch was removed
+after two rounds of v2-only writers (ROADMAP): v1 files also predate
+the r6 packed-word key fingerprint, so their stash keys could never
+merge with freshly-hashed rows anyway — loading one now raises with a
+re-save instruction instead of resuming into silently unmergeable
+state.
+
+`save_sharded_state` / `restore_sharded_state` are the
+ShardedWindowManager twins (same file family, kind="sharded"): the
+per-device stash packs via a vmapped pack into one [D, 4+T+M, S]
+array, sketch planes ride alongside, and restore re-shards onto the
+manager's mesh — the missing piece for kill-and-recover on the mesh
+path.
 """
 
 from __future__ import annotations
 
+import hashlib
 import io
 import json
+import os
 from functools import partial
 from pathlib import Path
 
@@ -30,11 +44,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import chaos
 from ..datamodel.schema import MeterSchema, TagSchema
 from .stash import AccumState, StashState, pack_u32_columns
 from .window import WindowConfig, WindowManager
 
-_VERSION = 2
+_VERSION = 3
+_MIN_READ_VERSION = 2  # v2 = pre-digest layout, still loadable
 
 
 @jax.jit
@@ -46,8 +62,7 @@ def _pack_stash(state: StashState) -> jnp.ndarray:
     )
 
 
-@partial(jax.jit, static_argnames=("num_tags",))
-def _unpack_stash(mat, dropped, *, num_tags: int) -> StashState:
+def _unpack_stash_impl(mat, dropped, num_tags: int) -> StashState:
     return StashState(
         slot=mat[0],
         key_hi=mat[1],
@@ -57,6 +72,11 @@ def _unpack_stash(mat, dropped, *, num_tags: int) -> StashState:
         meters=jax.lax.bitcast_convert_type(mat[4 + num_tags :], jnp.float32),
         dropped_overflow=jnp.asarray(dropped, dtype=jnp.int32),
     )
+
+
+@partial(jax.jit, static_argnames=("num_tags",))
+def _unpack_stash(mat, dropped, *, num_tags: int) -> StashState:
+    return _unpack_stash_impl(mat, dropped, num_tags)
 
 
 @jax.jit
@@ -76,13 +96,147 @@ def _unpack_acc(mat, *, num_tags: int) -> AccumState:
     )
 
 
-def save_window_state(wm: WindowManager, path: str | Path):
-    """Snapshot `wm` to one .npz. Returns the FlushedWindows that were
-    still in flight in async_drain mode (deferred stats / dispatched
-    flushes) — their rows have already left the stash, so the CALLER
-    must emit them before treating the checkpoint as the resume point;
-    an unsettled snapshot would silently lose those windows' documents.
-    Empty list in sync mode."""
+# the sharded twins: vmap the same pack/unpack over the device dim so
+# one transfer per direction still covers the whole mesh
+_pack_stash_sharded = jax.jit(
+    jax.vmap(
+        lambda s: pack_u32_columns(
+            s.slot, s.key_hi, s.key_lo, s.tags, s.meters, valid=s.valid
+        )
+    )
+)
+
+
+@partial(jax.jit, static_argnames=("num_tags",))
+def _unpack_stash_sharded(mats, dropped, *, num_tags: int) -> StashState:
+    return jax.vmap(lambda m, d: _unpack_stash_impl(m, d, num_tags))(mats, dropped)
+
+
+# ---------------------------------------------------------------------------
+# crash-safe file layer (shared by both checkpoint kinds)
+
+
+def _digest(arrays: dict[str, np.ndarray]) -> str:
+    """sha256 over every array's (name, dtype, shape, bytes) — the
+    content digest the loader verifies."""
+    h = hashlib.sha256()
+    for name in sorted(arrays):
+        a = np.ascontiguousarray(arrays[name])
+        h.update(name.encode())
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def _write_checkpoint(path: str | Path, meta: dict, arrays: dict) -> None:
+    """Serialize + ATOMICALLY replace: a kill at any point leaves
+    either the previous checkpoint or the new one, never a torn file."""
+    meta = dict(meta)
+    meta["digest"] = _digest(arrays)
+    buf = io.BytesIO()
+    np.savez_compressed(
+        buf, meta=np.frombuffer(json.dumps(meta).encode(), np.uint8), **arrays
+    )
+    path = Path(path)
+    tmp = path.with_name(path.name + ".tmp")
+    chaos.maybe_fail(chaos.SITE_CHECKPOINT_IO)
+    with open(tmp, "wb") as f:
+        f.write(buf.getvalue())
+        f.flush()
+        # without the fsync a power loss after the rename can still
+        # surface a renamed-but-empty file — the torn artifact the
+        # atomic writer exists to rule out
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    try:
+        dfd = os.open(path.parent, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass  # directory fsync is best-effort (not supported everywhere)
+
+
+def _read_checkpoint(path: str | Path) -> tuple[dict, dict]:
+    """→ (meta, arrays), with the loud-failure contract: truncation,
+    corruption or a digest mismatch raise a ValueError naming the file
+    and the failure — never a bare numpy/zipfile traceback. A missing
+    file still raises FileNotFoundError (that is an operator error,
+    not corruption)."""
+    raw = Path(path).read_bytes()
+    try:
+        with np.load(io.BytesIO(raw)) as z:
+            meta = json.loads(bytes(z["meta"]).decode())
+            arrays = {k: np.asarray(z[k]) for k in z.files if k != "meta"}
+    except Exception as e:
+        raise ValueError(
+            f"checkpoint {path} is truncated or corrupt ({type(e).__name__}: "
+            f"{e}); restore from the previous checkpoint — the atomic "
+            "writer never produces such a file, so this one was torn by "
+            "an outside force (partial copy, disk fault)"
+        ) from e
+    want = meta.get("digest")
+    if want is not None and want != _digest(arrays):
+        raise ValueError(
+            f"checkpoint {path} content digest mismatch — arrays were "
+            "modified or corrupted after the save; refusing to resume "
+            "from it"
+        )
+    return meta, arrays
+
+
+def read_checkpoint_meta(path: str | Path) -> dict:
+    """Meta dict only — reads just the meta member, no array
+    decompression and no digest pass (the actual state load verifies
+    the digest): recovery calls this on the startup critical path to
+    read the journal barrier (journal_epoch/journal_offset) before
+    deciding what to replay."""
+    try:
+        with np.load(Path(path)) as z:
+            return json.loads(bytes(z["meta"]).decode())
+    except FileNotFoundError:
+        raise  # missing file = cold start / operator error, not corruption
+    except Exception as e:
+        raise ValueError(
+            f"checkpoint {path} is truncated or corrupt ({type(e).__name__}: "
+            f"{e}); restore from the previous checkpoint"
+        ) from e
+
+
+def _check_version(meta: dict, path) -> None:
+    v = meta.get("version")
+    if v == 1:
+        # v1 readers were dropped once two rounds had shipped with
+        # v2-only writers (ROADMAP). No silent fallback: a v1 stash
+        # predates the packed-word key fingerprint and could never
+        # merge with freshly-hashed rows.
+        raise ValueError(
+            "checkpoint format v1 is unsupported (v1 load support was "
+            "removed after v2 writers shipped); re-save the window "
+            "state with a current writer"
+        )
+    if not (_MIN_READ_VERSION <= (v or 0) <= _VERSION):
+        raise ValueError(
+            f"checkpoint {path} version {v} not in "
+            f"[{_MIN_READ_VERSION}, {_VERSION}]"
+        )
+
+
+# ---------------------------------------------------------------------------
+# single-chip WindowManager
+
+
+def save_window_state(wm: WindowManager, path: str | Path, *, extra_meta=None):
+    """Snapshot `wm` to one .npz (atomic + digested). Returns the
+    FlushedWindows that were still in flight in async_drain mode
+    (deferred stats / dispatched flushes) — their rows have already
+    left the stash, so the CALLER must emit them before treating the
+    checkpoint as the resume point; an unsettled snapshot would
+    silently lose those windows' documents. Empty list in sync mode.
+    `extra_meta` (e.g. the feeder's journal barrier) merges into meta
+    and comes back from `read_checkpoint_meta`."""
     from ..utils.spans import SPAN_CHECKPOINT_SAVE
 
     with wm.tracer.span(SPAN_CHECKPOINT_SAVE):
@@ -92,6 +246,7 @@ def save_window_state(wm: WindowManager, path: str | Path):
             arrays["acc_packed"] = np.asarray(_pack_acc(wm.acc))
         meta = {
             "version": _VERSION,
+            "kind": "window",
             "num_tags": wm.tag_schema.num_fields,
             "dropped_overflow": int(np.asarray(wm.state.dropped_overflow)),
             "fill": wm.fill,
@@ -99,6 +254,7 @@ def save_window_state(wm: WindowManager, path: str | Path):
             "drop_before_window": wm.drop_before_window,
             "total_docs_in": wm.total_docs_in,
             "total_flushed": wm.total_flushed,
+            "n_advances": wm.n_advances,
             "aux_count": wm.aux_count,
             "excess_word_hits": wm.excess_word_hits,
             "feeder_shed": wm.feeder_shed,
@@ -114,68 +270,179 @@ def save_window_state(wm: WindowManager, path: str | Path):
             # NOT resume into the rank-merge
             "fold_mode": wm.config.fold_mode,
         }
-        buf = io.BytesIO()
-        np.savez_compressed(
-            buf, meta=np.frombuffer(json.dumps(meta).encode(), np.uint8), **arrays
-        )
-        Path(path).write_bytes(buf.getvalue())
+        if extra_meta:
+            meta.update(extra_meta)
+        _write_checkpoint(path, meta, arrays)
     return in_flight
 
 
 def load_window_state(
     path: str | Path, tag_schema: TagSchema, meter_schema: MeterSchema
 ) -> WindowManager:
-    with np.load(io.BytesIO(Path(path).read_bytes())) as z:
-        meta = json.loads(bytes(z["meta"]).decode())
-        if meta["version"] == 1:
-            # v1 readers were dropped once two rounds had shipped with
-            # v2-only writers (ROADMAP). No silent fallback: a v1 stash
-            # predates the packed-word key fingerprint and could never
-            # merge with freshly-hashed rows.
-            raise ValueError(
-                "checkpoint format v1 is unsupported (v1 load support was "
-                "removed after v2 writers shipped); re-save the window "
-                "state with a v2 writer"
-            )
-        if meta["version"] != _VERSION:
-            raise ValueError(f"checkpoint version {meta['version']} != {_VERSION}")
-        cfg = WindowConfig(
-            interval=meta["interval"],
-            delay=meta["delay"],
-            capacity=meta["capacity"],
-            accum_batches=meta["accum_batches"],
-            async_drain=meta.get("async_drain", False),
-            stats_ring=meta.get("stats_ring", 1),
-            fold_mode=meta.get("fold_mode", "full"),
+    meta, arrays = _read_checkpoint(path)
+    _check_version(meta, path)
+    if meta.get("kind", "window") != "window":
+        raise ValueError(
+            f"checkpoint {path} is kind={meta.get('kind')!r}, not a "
+            "single-chip window checkpoint (restore_sharded_state loads "
+            "sharded ones)"
         )
-        wm = WindowManager(cfg, tag_schema, meter_schema)
-        t = tag_schema.num_fields
-        if meta["num_tags"] != t:
-            # the packed split is shape-valid for ANY num_tags — a
-            # mismatch would bit-cast misaligned words into meters
-            # silently, so schema drift must fail loudly
-            raise ValueError(
-                f"checkpoint tag schema width {meta['num_tags']} != "
-                f"{t} ({tag_schema.__class__.__name__}); cannot unpack"
-            )
-        # one upload + one jitted split per direction
-        wm.state = _unpack_stash(
-            jnp.asarray(z["stash_packed"]),
-            np.int32(meta["dropped_overflow"]),
-            num_tags=t,
+    cfg = WindowConfig(
+        interval=meta["interval"],
+        delay=meta["delay"],
+        capacity=meta["capacity"],
+        accum_batches=meta["accum_batches"],
+        async_drain=meta.get("async_drain", False),
+        stats_ring=meta.get("stats_ring", 1),
+        fold_mode=meta.get("fold_mode", "full"),
+    )
+    wm = WindowManager(cfg, tag_schema, meter_schema)
+    t = tag_schema.num_fields
+    if meta["num_tags"] != t:
+        # the packed split is shape-valid for ANY num_tags — a
+        # mismatch would bit-cast misaligned words into meters
+        # silently, so schema drift must fail loudly
+        raise ValueError(
+            f"checkpoint tag schema width {meta['num_tags']} != "
+            f"{t} ({tag_schema.__class__.__name__}); cannot unpack"
         )
-        if "acc_packed" in z:
-            wm.acc = _unpack_acc(jnp.asarray(z["acc_packed"]), num_tags=t)
-        wm.fill = meta["fill"]
-        wm.start_window = meta["start_window"]
-        wm.drop_before_window = meta["drop_before_window"]
-        wm.total_docs_in = meta["total_docs_in"]
-        wm.total_flushed = meta["total_flushed"]
-        # telemetry counters landed after v2 writers; absent = 0
-        wm.aux_count = meta.get("aux_count", 0)
-        wm.excess_word_hits = meta.get("excess_word_hits", 0)
-        wm.feeder_shed = meta.get("feeder_shed", 0)
-        # the save settled (ring drained), so the restored host span IS
-        # the device gate state — mirror it back onto the device
-        wm._sync_device_sw()
+    # one upload + one jitted split per direction
+    wm.state = _unpack_stash(
+        jnp.asarray(arrays["stash_packed"]),
+        np.int32(meta["dropped_overflow"]),
+        num_tags=t,
+    )
+    if "acc_packed" in arrays:
+        wm.acc = _unpack_acc(jnp.asarray(arrays["acc_packed"]), num_tags=t)
+    wm.fill = meta["fill"]
+    wm.start_window = meta["start_window"]
+    wm.drop_before_window = meta["drop_before_window"]
+    wm.total_docs_in = meta["total_docs_in"]
+    wm.total_flushed = meta["total_flushed"]
+    # telemetry counters landed after v2 writers; absent = 0
+    wm.n_advances = meta.get("n_advances", 0)
+    wm.aux_count = meta.get("aux_count", 0)
+    wm.excess_word_hits = meta.get("excess_word_hits", 0)
+    wm.feeder_shed = meta.get("feeder_shed", 0)
+    # the save settled (ring drained), so the restored host span IS
+    # the device gate state — mirror it back onto the device
+    wm._sync_device_sw()
     return wm
+
+
+# ---------------------------------------------------------------------------
+# sharded ShardedWindowManager
+
+
+def save_sharded_state(swm, path: str | Path, *, extra_meta=None) -> list:
+    """Snapshot a ShardedWindowManager (kind="sharded"). Folds the
+    accumulator ring first (sharded flushes are synchronous, so unlike
+    async_drain nothing else is deferred), packs every device stash in
+    one vmapped call, and writes sketch planes alongside. Returns []
+    for signature symmetry with save_window_state."""
+    from ..utils.spans import SPAN_CHECKPOINT_SAVE
+
+    with swm.tracer.span(SPAN_CHECKPOINT_SAVE):
+        swm._fold()  # ring rows must reach the stash before the snapshot
+        arrays = {
+            "stash_packed": np.asarray(_pack_stash_sharded(swm.stash)),
+            "dropped": np.asarray(swm.stash.dropped_overflow),
+            "hll": np.asarray(swm.sketches.hll),
+            "cms": np.asarray(swm.sketches.cms),
+            "hist": np.asarray(swm.sketches.hist),
+        }
+        c = swm.pipe.config
+        meta = {
+            "version": _VERSION,
+            "kind": "sharded",
+            "num_tags": int(arrays["stash_packed"].shape[1]) - 4
+            - int(swm.stash.meters.shape[1]),
+            "n_devices": swm.pipe.n_devices,
+            "capacity_per_device": c.capacity_per_device,
+            "interval": swm.interval,
+            "delay": swm.delay,
+            "fold_mode": c.fold_mode,
+            "start_window": swm.start_window,
+            "drop_before_window": swm.drop_before_window,
+            "total_docs_in": swm.total_docs_in,
+            "total_flushed": swm.total_flushed,
+            "n_advances": swm.n_advances,
+        }
+        if extra_meta:
+            meta.update(extra_meta)
+        _write_checkpoint(path, meta, arrays)
+    return []
+
+
+def restore_sharded_state(swm, path: str | Path):
+    """Load a sharded checkpoint INTO a freshly-built
+    ShardedWindowManager (the caller owns mesh construction — a
+    checkpoint cannot rebuild a Mesh). Validates device count, schema
+    width and fold mode loudly; re-shards every plane onto the
+    manager's mesh."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..datamodel.schema import TAG_SCHEMA
+    from ..parallel.sharded import SketchPlanes
+
+    meta, arrays = _read_checkpoint(path)
+    _check_version(meta, path)
+    if meta.get("kind") != "sharded":
+        raise ValueError(
+            f"checkpoint {path} is kind={meta.get('kind')!r}, not a "
+            "sharded checkpoint (load_window_state loads single-chip ones)"
+        )
+    if meta["n_devices"] != swm.pipe.n_devices:
+        raise ValueError(
+            f"checkpoint {path} was saved on {meta['n_devices']} devices; "
+            f"this mesh has {swm.pipe.n_devices} — per-device stashes "
+            "cannot be re-split"
+        )
+    t = TAG_SCHEMA.num_fields
+    if meta["num_tags"] != t:
+        raise ValueError(
+            f"checkpoint tag schema width {meta['num_tags']} != {t}; "
+            "cannot unpack"
+        )
+    if meta.get("fold_mode", "full") != swm.pipe.config.fold_mode:
+        raise ValueError(
+            f"checkpoint fold_mode={meta.get('fold_mode')!r} != pipeline "
+            f"fold_mode={swm.pipe.config.fold_mode!r} — the stash layout "
+            "contract differs between modes (canonical prefix vs holes)"
+        )
+    if meta["capacity_per_device"] != swm.pipe.config.capacity_per_device:
+        raise ValueError(
+            f"checkpoint capacity_per_device={meta['capacity_per_device']} "
+            f"!= pipeline {swm.pipe.config.capacity_per_device} — stash "
+            "shape disagrees with the compiled config"
+        )
+    if meta["interval"] != swm.interval or meta["delay"] != swm.delay:
+        raise ValueError(
+            f"checkpoint window timing (interval={meta['interval']}, "
+            f"delay={meta['delay']}) != manager (interval={swm.interval}, "
+            f"delay={swm.delay}) — start_window/drop_before_window are "
+            "window indices in units of interval and would be silently "
+            "reinterpreted"
+        )
+    stash = _unpack_stash_sharded(
+        jnp.asarray(arrays["stash_packed"]),
+        jnp.asarray(arrays["dropped"], dtype=jnp.int32),
+        num_tags=t,
+    )
+    sketches = SketchPlanes(
+        hll=jnp.asarray(arrays["hll"]),
+        cms=jnp.asarray(arrays["cms"]),
+        hist=jnp.asarray(arrays["hist"]),
+    )
+    spec = NamedSharding(swm.pipe.mesh, P(swm.pipe.axes))
+    swm.stash = jax.tree.map(lambda x: jax.device_put(x, spec), stash)
+    swm.sketches = jax.tree.map(lambda x: jax.device_put(x, spec), sketches)
+    swm.acc = None  # re-sized on the first post-restore batch
+    swm.fill = 0
+    swm._fold_rows_dev = None
+    swm.start_window = meta["start_window"]
+    swm.drop_before_window = meta["drop_before_window"]
+    swm.total_docs_in = meta["total_docs_in"]
+    swm.total_flushed = meta["total_flushed"]
+    swm.n_advances = meta.get("n_advances", 0)
+    return swm

@@ -43,7 +43,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import chaos
 from ..datamodel.schema import FLOW_METER, TAG_SCHEMA, MeterSchema, TagSchema
+from ..utils.retry import (
+    RetryPolicy,
+    decorrelated_rng,
+    is_dispatch_transient,
+    retry_call,
+)
 from ..utils.spans import (
     SPAN_FLUSH_DRAIN,
     SPAN_INGEST_DISPATCH,
@@ -356,6 +363,17 @@ class WindowManager:
         self.bytes_fetched = 0
         self.bytes_uploaded = 0  # callers add their packed upload sizes
         self.feeder_shed = 0  # CB_FEEDER_SHED lane mirror
+        # transient-failure policy (ISSUE 6): dispatch + fetch are
+        # retried with backoff+jitter (per-instance decorrelated rng —
+        # fault injection itself stays deterministic via the chaos
+        # plan's own seeded rng). Retrying a dispatch is sound only for
+        # admission-time failures (utils/retry.py has the donation
+        # caveat) — the chaos seam fires BEFORE the jitted call, and
+        # RESOURCE_EXHAUSTED-class rejections do too.
+        self.retry_policy = RetryPolicy()
+        self._retry_rng = decorrelated_rng(0xD15EA5E)
+        self.dispatch_retries = 0
+        self.fetch_retries = 0
         self.tracer = tracer if tracer is not None else SpanTracer()
         # async-drain double buffers (device handles, fetched next call)
         self._pending_stats = None
@@ -373,8 +391,20 @@ class WindowManager:
         )
 
     def _fetch(self, x) -> np.ndarray:
-        """host_fetch + per-manager transfer accounting (count + bytes)."""
-        arr = host_fetch(x)
+        """host_fetch + per-manager transfer accounting (count + bytes).
+        Transient fetch failures (timeouts on the tunnel, injected
+        chaos faults) retry with backoff — the device handle stays
+        valid across a blown fetch deadline."""
+
+        def once():
+            chaos.maybe_fail(chaos.SITE_FETCH)
+            return host_fetch(x)
+
+        def on_retry(_attempt, _exc):
+            self.fetch_retries += 1
+
+        arr = retry_call(once, self.retry_policy, on_retry=on_retry,
+                         rng=self._retry_rng)
         self.host_fetches += 1
         self.bytes_fetched += arr.nbytes
         return arr
@@ -648,8 +678,23 @@ class WindowManager:
             sw_arg = jnp.uint32(
                 0 if self.start_window is None else self.start_window
             )
+        def dispatch_once():
+            # the chaos seam fires BEFORE the jitted call, so a retried
+            # injected fault never sees a consumed (donated) accumulator
+            chaos.maybe_fail(chaos.SITE_DISPATCH)
+            return dispatch(self.acc, jnp.int32(self.fill), sw_arg)
+
+        def on_retry(_attempt, _exc):
+            self.dispatch_retries += 1
+
         with self.tracer.span(SPAN_INGEST_DISPATCH):
-            self.acc, stats_dev = dispatch(self.acc, jnp.int32(self.fill), sw_arg)
+            # admission-time-only classification: the step donates its
+            # accumulator, so a mid-flight UNAVAILABLE/ABORTED must NOT
+            # retry against the consumed buffer
+            self.acc, stats_dev = retry_call(
+                dispatch_once, self.retry_policy, on_retry=on_retry,
+                rng=self._retry_rng, classify=is_dispatch_transient,
+            )
         self.fill += rows
 
         if K > 1:
@@ -752,6 +797,10 @@ class WindowManager:
             "host_fetches": self.host_fetches,
             "bytes_fetched": self.bytes_fetched,
             "bytes_uploaded": self.bytes_uploaded,
+            # transient-failure lanes (ISSUE 6): non-zero means the
+            # retry policy absorbed device/tunnel hiccups
+            "dispatch_retries": self.dispatch_retries,
+            "fetch_retries": self.fetch_retries,
             # feeder-pressure lane + counter-ring occupancy (ISSUE 4);
             # blocks awaiting the 1/K fetch mean host counters may trail
             # the device by up to stats_ring_pending batches

@@ -8,11 +8,13 @@ from .flowframe import (
     encode_flowbatch_frames,
     peek_rows,
 )
+from .journal import FrameJournal, read_journal
 from .runtime import (
     DocChunk,
     FeederConfig,
     FeederRuntime,
     FlowChunk,
+    FrameCodecBase,
     PipelineFeedSink,
     ShardedFeedSink,
     WindowManagerFeedSink,
@@ -23,6 +25,8 @@ __all__ = [
     "FeederConfig",
     "FeederRuntime",
     "FlowChunk",
+    "FrameCodecBase",
+    "FrameJournal",
     "PipelineFeedSink",
     "ShardedFeedSink",
     "WindowManagerFeedSink",
@@ -30,4 +34,5 @@ __all__ = [
     "encode_flowbatch_body",
     "encode_flowbatch_frames",
     "peek_rows",
+    "read_journal",
 ]

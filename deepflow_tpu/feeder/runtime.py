@@ -28,6 +28,32 @@ arXiv:2503.13515). This module is that stage:
     packed tag matrix (async device put) before dispatching batch i,
     mirroring `async_drain` on the output side.
 
+Fault tolerance (ISSUE 6) — every failure class on the
+feeder→device→flush path is either retried, contained, or counted:
+
+  * **poisoned-frame quarantine**: sink codecs catch ALL decode
+    failures at the `decode_frame` boundary (FrameCodecBase), count
+    them, and park the head bytes in a bounded quarantine ring —
+    corrupt wire data never raises into `pump()`;
+  * **graceful degradation**: when a sink dispatch fails even after
+    the window manager's transient-retry policy, the runtime flips to
+    DEGRADED: drain budgets halve, admitted frames are shed WHOLE and
+    counted (`lost_records`/`degraded_shed_records` — no uncounted
+    loss), and every `probe_interval` pumps one probe batch flows
+    through the full dispatch path; a success flips back to healthy.
+    The state machine is (healthy) --emit fail--> (degraded, probe
+    countdown) --probe ok--> (healthy);
+  * **crash-loop guard**: `serve()` wraps every pump in a containment
+    try — a pump exception restarts the loop with capped exponential
+    backoff and a counted health state (`pump_errors`,
+    `pump_failstreak`) instead of silently killing the daemon thread;
+  * **frame journal**: with `journal=` set, every admitted frame is
+    appended (pump boundaries marked) BEFORE decode, so recovery =
+    restore the window checkpoint + `replay_journal` through the
+    normal decode path — bit-exact against an uninterrupted run
+    (journal.py has the barrier protocol; `checkpoint()` is the
+    flush→snapshot→rotate barrier).
+
 Sinks adapt the record plane to each window controller:
 `PipelineFeedSink` (flow records → RollupPipeline's fused step),
 `WindowManagerFeedSink` (pb Documents via ingest/codec.py → the
@@ -38,12 +64,14 @@ ShardedWindowManager per shard group; run one FeederRuntime per group).
 from __future__ import annotations
 
 import dataclasses
+import logging
 import threading
-import time
 from collections import deque
+from pathlib import Path
 
 import numpy as np
 
+from .. import chaos
 from ..datamodel.batch import FlowBatch
 from ..ingest.framing import HEADER_LEN, FlowHeader, MessageType, split_message_spans
 from ..utils.spans import (
@@ -52,8 +80,11 @@ from ..utils.spans import (
     SPAN_FEEDER_DRAIN,
     SpanTracer,
 )
+from ..utils.retry import RetryPolicy, decorrelated_rng
 from ..utils.stats import register_countable
 from .flowframe import decode_flowframe_body, peek_rows
+
+_log = logging.getLogger(__name__)
 
 # ---------------------------------------------------------------------------
 # record chunks — what decoded frames become inside the pending buffer
@@ -94,8 +125,40 @@ class DocChunk:
 # ---------------------------------------------------------------------------
 # sinks
 
+QUARANTINE_KEEP = 8  # poisoned frames retained for diagnosis (head bytes)
 
-class _FlowFrameCodec:
+
+class FrameCodecBase:
+    """The poisoned-frame quarantine boundary every sink codec shares.
+
+    `decode_frame` NEVER raises: any failure — magic/version/field
+    drift, truncation, a decoder bug, an injected chaos fault — is
+    counted (`decode_errors`), the frame's head bytes parked in a
+    bounded `quarantine` ring, and None returned, so a hostile frame
+    is isolated without touching the pump loop (ISSUE 6). Subclasses
+    implement `_decode_frame` with the untrusted-edge raise-on-drift
+    stance decoders already take."""
+
+    def __init__(self):
+        self.decode_errors = 0
+        self.quarantine: deque = deque(maxlen=QUARANTINE_KEEP)
+
+    def _decode_frame(self, raw: bytes):
+        raise NotImplementedError
+
+    def decode_frame(self, raw: bytes):
+        try:
+            chaos.maybe_fail(chaos.SITE_DECODE)
+            return self._decode_frame(raw)
+        except Exception as exc:
+            self.decode_errors += 1
+            self.quarantine.append(
+                (type(exc).__name__, str(exc)[:160], bytes(raw[:64]))
+            )
+            return None
+
+
+class _FlowFrameCodec(FrameCodecBase):
     """Shared decode face for sinks that eat flowframe (TAGGEDFLOW)
     frames."""
 
@@ -103,7 +166,7 @@ class _FlowFrameCodec:
         body = raw[HEADER_LEN:]
         return sum(peek_rows(body[o : o + ln]) for o, ln in split_message_spans(body))
 
-    def decode_frame(self, raw: bytes) -> FlowChunk | None:
+    def _decode_frame(self, raw: bytes) -> FlowChunk | None:
         header = FlowHeader.parse(raw[:HEADER_LEN])
         if header.msg_type != int(MessageType.TAGGEDFLOW):
             raise ValueError(f"flow sink got msg_type {header.msg_type}")
@@ -122,9 +185,15 @@ class PipelineFeedSink(_FlowFrameCodec):
     double-buffered upload: `emit` STAGES the new batch (async device
     put) and dispatches the PREVIOUSLY staged one, so the tag-matrix
     transfer of batch i+1 overlaps batch i's in-flight compute. Outputs
-    therefore trail by one emitted batch until flush()."""
+    therefore trail by one emitted batch until flush().
+
+    Dispatch-failure contract: when the held batch's dispatch raises,
+    its rows are counted into `lost_records` and the FRESHLY staged
+    batch survives in the double buffer — the runtime's next (probe)
+    emit dispatches it, so one device hiccup costs exactly one batch."""
 
     def __init__(self, pipeline, *, double_buffer: bool = True):
+        super().__init__()
         if not pipeline.config.bucket_sizes:
             raise ValueError(
                 "PipelineFeedSink needs PipelineConfig.bucket_sizes — the "
@@ -134,31 +203,73 @@ class PipelineFeedSink(_FlowFrameCodec):
         self.pipeline = pipeline
         self.double_buffer = double_buffer
         self.bucket_sizes = tuple(pipeline.config.bucket_sizes)
-        self._held = None  # (StagedBatch, shed) awaiting dispatch
+        self._held = None  # (StagedBatch, shed, rows) awaiting dispatch
         self._shed_carry = 0  # shed count whose batch had no valid rows
+        self.lost_records = 0  # rows lost to failed dispatches
 
     def emit(self, chunks: list[FlowChunk], rows: int, bucket: int, shed: int) -> list:
         fb = FlowBatch.concat([c.fb for c in chunks])
         assert fb.size == rows
-        shed += self._shed_carry
+        carried = self._shed_carry
+        shed += carried
         self._shed_carry = 0
-        staged = self.pipeline.stage(fb)  # pads to `bucket`, starts upload
-        out = self.flush()  # dispatch the previously staged batch
+        try:
+            staged = self.pipeline.stage(fb)  # pads to `bucket`, starts upload
+        except Exception:
+            # admission itself failed (e.g. device OOM on the async
+            # put): this batch's rows are gone and must be counted, or
+            # delivered = records_out − lost_records over-reports. The
+            # runtime re-arms only the shed IT passed in, so the carry
+            # must go back into the buffer or it undercounts the
+            # device-plane feeder_shed lane.
+            self.lost_records += rows
+            self._shed_carry += carried
+            raise
+        try:
+            out = self.flush()  # dispatch the previously staged batch
+        except Exception:
+            # the HELD batch failed (flush counted its rows lost); keep
+            # the new batch staged for the probe emit. The runtime
+            # re-owns `shed` (it re-arms _shed_pending on failure); the
+            # carry goes back into the buffer.
+            # += not =: flush() may have just deposited the failed
+            # batch's own held_shed into the carry
+            if staged is not None:
+                self._held = (staged, 0, rows)
+            self._shed_carry += carried
+            raise
         if staged is None:  # all-padding emit — carry its shed forward
             self._shed_carry = shed
         elif self.double_buffer:
-            self._held = (staged, shed)
+            self._held = (staged, shed, rows)
         else:
-            out += self.pipeline.ingest_staged(staged, feeder_shed=shed)
+            try:
+                out += self.pipeline.ingest_staged(staged, feeder_shed=shed)
+            except Exception:
+                # same contract as the stage()/flush() failure paths:
+                # the runtime re-arms only the shed IT passed in, so the
+                # carried share must go back into the buffer or the
+                # device-plane feeder_shed lane permanently undercounts
+                self.lost_records += rows
+                self._shed_carry += carried
+                raise
         return out
 
     def flush(self) -> list:
         """Dispatch the held double-buffered batch, if any."""
         if self._held is None:
             return []
-        held, held_shed = self._held
+        held, held_shed, held_rows = self._held
         self._held = None
-        return self.pipeline.ingest_staged(held, feeder_shed=held_shed)
+        try:
+            return self.pipeline.ingest_staged(held, feeder_shed=held_shed)
+        except Exception:
+            # the batch's rows are lost (counted), but its attached shed
+            # count must survive into the carry or the device-plane
+            # feeder_shed lane permanently undercounts
+            self.lost_records += held_rows
+            self._shed_carry += held_shed
+            raise
 
 
 class ShardedFeedSink(_FlowFrameCodec):
@@ -167,6 +278,7 @@ class ShardedFeedSink(_FlowFrameCodec):
     sharded step splits the leading dim evenly across devices."""
 
     def __init__(self, swm, bucket_sizes: tuple[int, ...]):
+        super().__init__()
         d = swm.pipe.n_devices
         bad = [b for b in bucket_sizes if b % d]
         if bad:
@@ -179,14 +291,17 @@ class ShardedFeedSink(_FlowFrameCodec):
 
     def emit(self, chunks: list[FlowChunk], rows: int, bucket: int, shed: int) -> list:
         fb = FlowBatch.concat([c.fb for c in chunks]).pad_to(bucket)
+        out = self.swm.ingest(fb.tags, fb.meters, fb.valid)
+        # only account the shed once the batch actually landed — on a
+        # failed dispatch the runtime re-owns it
         self.feeder_shed += shed
-        return self.swm.ingest(fb.tags, fb.meters, fb.valid)
+        return out
 
     def flush(self) -> list:
         return []
 
 
-class WindowManagerFeedSink:
+class WindowManagerFeedSink(FrameCodecBase):
     """pb Documents (METRICS lane, ingest/codec.py) → the doc-level
     WindowManager append. Keys are the packed-word fingerprints
     computed host-side with the SAME plan the device uses
@@ -197,6 +312,7 @@ class WindowManagerFeedSink:
         from ..datamodel.code import MeterId
         from ..ingest.codec import DocumentDecoder
 
+        super().__init__()
         self.wm = wm
         self.bucket_sizes = tuple(bucket_sizes)
         self.meter_id = int(MeterId.FLOW if meter_id is None else meter_id)
@@ -206,7 +322,7 @@ class WindowManagerFeedSink:
     def count_records(self, raw: bytes) -> int:
         return len(split_message_spans(raw[HEADER_LEN:]))
 
-    def decode_frame(self, raw: bytes) -> DocChunk | None:
+    def _decode_frame(self, raw: bytes) -> DocChunk | None:
         body = raw[HEADER_LEN:]
         spans = split_message_spans(body)
         batches = self.decoder.decode_parts([(body, spans)])
@@ -271,6 +387,15 @@ class FeederConfig:
     # emit the sub-bucket tail at the end of each pump (freshness) —
     # off, records wait for a full max-size bucket (efficiency)
     emit_partial: bool = True
+    # pumps between probe dispatches while DEGRADED (ISSUE 6): every
+    # probe_interval-th pump lets one batch through the full dispatch
+    # path; a success flips the runtime back to healthy
+    probe_interval: int = 8
+    # serve(): max flushed-output batches held for on_flush redelivery
+    # while the callback keeps failing; beyond it the OLDEST are shed
+    # and counted (held_outputs_shed lanes) — a broken downstream must
+    # not grow the hold list until the process OOMs. 0 = unbounded.
+    max_held_outputs: int = 256
 
 
 class FeederRuntime:
@@ -286,6 +411,7 @@ class FeederRuntime:
         *,
         name: str = "feeder",
         tracer: SpanTracer | None = None,
+        journal=None,
     ):
         if not queues:
             raise ValueError("need at least one queue")
@@ -303,6 +429,7 @@ class FeederRuntime:
         self.tracer = tracer if tracer is not None else SpanTracer(
             service="deepflow_tpu.feeder"
         )
+        self._journal = journal
         self._weights = config.weights or (1,) * len(queues)
         self._pressure = [False] * len(queues)
         self._chunks: deque = deque()
@@ -310,8 +437,20 @@ class FeederRuntime:
         self._shed_pending = 0  # records shed since the last emit
         self._rr = 0  # rotating first-queue index (starvation-proof)
         self._lock = threading.Lock()
+        # serializes pump/flush/checkpoint/replay against each other:
+        # a checkpoint racing the serve() thread could otherwise admit
+        # (and journal) frames between the barrier flush and
+        # sync_offset — below the barrier offset but absent from the
+        # snapshot, so replay would skip them (silent loss). RLock:
+        # checkpoint() calls flush() re-entrantly.
+        self._pump_mutex = threading.RLock()
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
+        # degraded-mode state machine (ISSUE 6)
+        self.degraded = False
+        self._probe_now = True
+        self._probe_countdown = 0
+        self._pump_failstreak = 0  # consecutive serve()-loop pump failures
         self.counters = {
             "frames_in": 0,
             "records_in": 0,
@@ -322,7 +461,25 @@ class FeederRuntime:
             "shed_frames": 0,
             "shed_records": 0,
             "pressure_events": 0,
+            # fault-tolerance lanes
+            "emit_failures": 0,
+            "lost_records": 0,
+            "degraded_entries": 0,
+            "degraded_exits": 0,
+            "degraded_shed_records": 0,
+            "probe_attempts": 0,
+            "pump_errors": 0,
+            "flush_callback_errors": 0,
+            "held_outputs_shed": 0,
+            "held_output_shed_records": 0,
+            "checkpoint_aborts": 0,
+            "replayed_frames": 0,
         }
+        # False after a checkpoint() that aborted (barrier flush or
+        # snapshot save failed) — callers that prune old checkpoints or
+        # journals MUST check it before treating the call as durable.
+        self.last_checkpoint_ok = True
+        self._held_shed_logged = False
         register_countable("tpu_feeder", self, name=name)
         register_countable("tpu_feeder_spans", self.tracer, name=name)
 
@@ -335,11 +492,74 @@ class FeederRuntime:
             int(getattr(q, "overwritten", 0)) for q in self.queues
         )
         out["queues_in_pressure"] = sum(self._pressure)
+        # health lanes: the deepflow_system rows dashboards alert on
+        out["degraded"] = int(self.degraded)
+        out["pump_failstreak"] = self._pump_failstreak
+        out["healthy"] = int(not self.degraded and self._pump_failstreak == 0)
+        out["last_checkpoint_ok"] = int(self.last_checkpoint_ok)
+        out["decode_errors"] = int(getattr(self.sink, "decode_errors", 0))
+        if self._journal is not None:
+            for k, v in self._journal.get_counters().items():
+                out[f"journal_{k}"] = v
         return out
 
     def _count(self, key: str, n: int = 1) -> None:
         with self._lock:
             self.counters[key] += n
+
+    # -- degraded-mode state machine -------------------------------------
+    def _count_records_safe(self, raw: bytes) -> int:
+        """Header-peek record count that survives corrupt frames — the
+        shed accounting must never be the thing that raises."""
+        try:
+            return self.sink.count_records(raw)
+        except Exception:
+            return 0
+
+    def _enter_degraded(self) -> None:
+        self._probe_countdown = self.config.probe_interval
+        self._probe_now = False
+        if not self.degraded:
+            self.degraded = True
+            self._count("degraded_entries")
+            _log.warning(
+                "feeder %s: sink dispatch failed after retries — entering "
+                "degraded mode (shedding, probing every %d pumps)",
+                self.name, self.config.probe_interval,
+            )
+
+    def _note_emit_ok(self) -> None:
+        if self.degraded:
+            self.degraded = False
+            self._count("degraded_exits")
+            _log.warning(
+                "feeder %s: probe dispatch succeeded — leaving degraded mode",
+                self.name,
+            )
+
+    def _probe_tick(self) -> None:
+        """Per-pump probe schedule: healthy pumps always dispatch;
+        degraded pumps shed until the countdown elapses, then let one
+        pump's batches through as the probe."""
+        if not self.degraded:
+            self._probe_now = True
+            return
+        self._probe_countdown -= 1
+        if self._probe_countdown <= 0:
+            self._probe_now = True
+            self._probe_countdown = self.config.probe_interval
+        else:
+            self._probe_now = False
+
+    def _shed_frame(self, raw: bytes) -> None:
+        """Degraded-mode shed: whole frames, counted via header peek —
+        the same stance as watermark shedding, plus the degraded lane."""
+        self._count("shed_frames")
+        n = self._count_records_safe(raw)
+        self._count("shed_records", n)
+        self._count("degraded_shed_records", n)
+        with self._lock:
+            self._shed_pending += n
 
     # -- drain + shed ----------------------------------------------------
     def _visit(self, i: int, admit: list) -> int:
@@ -349,6 +569,10 @@ class FeederRuntime:
         watermarks (the shed-policy test pins this)."""
         q = self.queues[i]
         budget = self._weights[i] * self.config.frames_per_queue
+        if self.degraded:
+            # shrunk drain budget: a degraded pipeline stops pretending
+            # it can keep up — the watermark shed upstream does the rest
+            budget = max(1, budget // 2)
         cap = int(getattr(q, "capacity", 0) or 0)
         if cap:
             depth = len(q)
@@ -366,7 +590,7 @@ class FeederRuntime:
             cut = max(len(drained) - budget, 0)
             for raw in drained[:cut]:
                 self._count("shed_frames")
-                n = self.sink.count_records(raw)
+                n = self._count_records_safe(raw)
                 self._count("shed_records", n)
                 with self._lock:
                     self._shed_pending += n
@@ -396,13 +620,40 @@ class FeederRuntime:
 
     def _emit(self, rows: int, bucket: int) -> list:
         chunks = self._take(rows)
+        if self.degraded:
+            # a dispatch attempted while degraded IS the probe — count
+            # it here, not in _probe_tick, so idle pumps (which test
+            # nothing) never inflate the probe_attempts lane
+            self._count("probe_attempts")
         with self._lock:
             shed, self._shed_pending = self._shed_pending, 0
+        lost0 = getattr(self.sink, "lost_records", None)
+        try:
+            with self.tracer.span(SPAN_FEEDER_DISPATCH):
+                out = self.sink.emit(chunks, rows, bucket, shed)
+        except Exception:
+            # containment: the dispatch failed even after the window
+            # manager's transient retries. Count what was actually lost
+            # (sinks with a double buffer keep the staged batch), re-arm
+            # the un-delivered shed so the device lane still sees it on
+            # the next successful batch, and flip to degraded.
+            lost = rows if lost0 is None else self.sink.lost_records - lost0
+            self._count("emit_failures")
+            self._count("lost_records", lost)
+            # records_out counts rows that LEFT the coalescing buffer in
+            # both outcomes (conservation: records_in = records_out +
+            # pending_rows always holds); delivered = records_out −
+            # lost_records
+            self._count("records_out", rows)
+            with self._lock:
+                self._shed_pending += lost + shed
+            self._enter_degraded()
+            return []
+        self._note_emit_ok()
         self._count("batches_out")
         self._count("records_out", rows)
         self._count("pad_rows", bucket - rows)
-        with self.tracer.span(SPAN_FEEDER_DISPATCH):
-            return self.sink.emit(chunks, rows, bucket, shed)
+        return out
 
     def _admit(self, chunk, out: list) -> None:
         self._chunks.append(chunk)
@@ -410,12 +661,37 @@ class FeederRuntime:
         max_b = self.buckets[-1]
         while self._rows >= max_b:
             out.extend(self._emit(max_b, max_b))
+            if self.degraded:
+                # the emit just failed — stop hammering the device; the
+                # remaining pending rows wait for the probe
+                break
 
     def _bucket_for(self, rows: int) -> int:
         for b in self.buckets:
             if rows <= b:
                 return b
         return self.buckets[-1]
+
+    def _process_frame(self, raw: bytes, out: list) -> None:
+        """Decode one admitted frame through the sink codec and coalesce
+        it — the single path pump() and replay_journal() share, so
+        recovery exercises no special-case decode code."""
+        errs0 = int(getattr(self.sink, "decode_errors", 0))
+        try:
+            chunk = self.sink.decode_frame(raw)
+        except Exception:
+            # sinks quarantine internally (FrameCodecBase); this guard
+            # covers foreign sink implementations only
+            self._count("bad_frames")
+            return
+        if int(getattr(self.sink, "decode_errors", 0)) > errs0:
+            self._count("bad_frames")  # quarantined by the codec
+            return
+        self._count("frames_in")
+        if chunk is None or chunk.rows == 0:
+            return
+        self._count("records_in", chunk.rows)
+        self._admit(chunk, out)
 
     # -- the pump --------------------------------------------------------
     def pump(self) -> list:
@@ -424,7 +700,13 @@ class FeederRuntime:
         batches, emit them into the sink, and — with emit_partial —
         flush the sub-bucket tail padded to its smallest bucket.
         Returns whatever the sink's window controller flushed."""
+        with self._pump_mutex:
+            return self._pump_locked()
+
+    def _pump_locked(self) -> list:
         out: list = []
+        self._probe_tick()
+        dispatch0 = self.counters["batches_out"] + self.counters["emit_failures"]
         nq = len(self.queues)
         for _ in range(self.config.rounds_per_pump):
             admit: list = []
@@ -436,48 +718,290 @@ class FeederRuntime:
             if not admit and not drained:
                 break
             with self.tracer.span(SPAN_FEEDER_COALESCE):
+                # one shed decision per round: frames the live run
+                # sheds-and-counts are NOT journaled — replay would
+                # resurrect rows the counters already declared shed,
+                # double-accounting them across the shed and delivered
+                # lanes
+                shedding = self.degraded and not self._probe_now
+                # journal the WHOLE admitted round before touching the
+                # device: a kill anywhere downstream (dispatch, fetch,
+                # flush) then loses nothing the journal can't replay
+                if self._journal is not None and not shedding:
+                    for raw in admit:
+                        self._journal.append(raw)
                 for raw in admit:
-                    try:
-                        chunk = self.sink.decode_frame(raw)
-                    except ValueError:
-                        self._count("bad_frames")
+                    if shedding:
+                        self._shed_frame(raw)
                         continue
-                    self._count("frames_in")
-                    if chunk is None or chunk.rows == 0:
-                        continue
-                    self._count("records_in", chunk.rows)
-                    self._admit(chunk, out)
-        if self.config.emit_partial and self._rows > 0:
+                    self._process_frame(raw, out)
+        if (
+            self.config.emit_partial
+            and self._rows > 0
+            and (self._probe_now or not self.degraded)
+        ):
             out.extend(self._emit(self._rows, self._bucket_for(self._rows)))
+        if self._journal is not None:
+            self._journal.mark()
+        if (
+            self.degraded
+            and self._probe_now
+            and self.counters["batches_out"] + self.counters["emit_failures"]
+            == dispatch0
+        ):
+            # the probe pump had no data to send, so nothing was tested:
+            # keep the probe armed instead of re-arming the countdown —
+            # otherwise a feeder that goes idle while degraded sheds the
+            # first frames that arrive after the device already recovered
+            self._probe_countdown = 0
         return out
 
     def flush(self) -> list:
         """Emit every pending record (tail bucket) and push anything the
         sink holds (the double-buffered staged batch); does NOT drain
         the sink's open windows — that stays the owner's shutdown call."""
-        out: list = []
-        if self._rows > 0:
-            out.extend(self._emit(self._rows, self._bucket_for(self._rows)))
-        with self.tracer.span(SPAN_FEEDER_DISPATCH):
-            out.extend(self.sink.flush())
-        return out
+        with self._pump_mutex:
+            out: list = []
+            if self._rows > 0:
+                out.extend(self._emit(self._rows, self._bucket_for(self._rows)))
+            lost0 = getattr(self.sink, "lost_records", None)
+            try:
+                with self.tracer.span(SPAN_FEEDER_DISPATCH):
+                    out.extend(self.sink.flush())
+            except Exception:
+                lost = 0 if lost0 is None else self.sink.lost_records - lost0
+                self._count("emit_failures")
+                self._count("lost_records", lost)
+                with self._lock:
+                    self._shed_pending += lost
+                self._enter_degraded()
+            return out
+
+    # -- journal recovery ------------------------------------------------
+    def checkpoint(self, save) -> list:
+        """The flush→snapshot→rotate checkpoint barrier.
+
+        Flushes every pending row and the sink's staged batch (so the
+        window state covers all admitted frames), calls `save(barrier)`
+        — a closure around e.g. checkpoint.save_window_state, with
+        `barrier` = {"journal_epoch", "journal_offset"} to embed in the
+        snapshot meta — then rotates the journal. Returns every output
+        the barrier flushed (including whatever `save` returns, e.g.
+        save_window_state's in-flight windows); callers must emit them
+        BEFORE treating the checkpoint as durable.
+
+        If the barrier flush itself fails to deliver (a sink dispatch
+        error), the checkpoint ABORTS — counted (`checkpoint_aborts`)
+        and logged, snapshot not written, journal not rotated. The
+        failed rows' journal records are the only replayable copy left;
+        snapshotting without them and rotating would convert a
+        transient failure into permanent loss. The previous checkpoint
+        plus the intact journal still recover everything. The returned
+        outputs look identical either way, so `last_checkpoint_ok`
+        (also a get_counters lane) records per-call success — callers
+        that prune older checkpoints/journals after this call MUST
+        check it, or an abort turns their pruning into permanent loss.
+
+        Safe to call from any thread while serve() runs: the pump
+        mutex holds the barrier (flush → sync_offset → save → rotate)
+        closed against concurrent admits — a frame journaled between
+        the flush and the barrier offset would be skipped by replay
+        yet missing from the snapshot."""
+        with self._pump_mutex:
+            ef0 = self.counters["emit_failures"]
+            out = self.flush()
+            if self.counters["emit_failures"] > ef0:
+                self.last_checkpoint_ok = False
+                self._count("checkpoint_aborts")
+                _log.warning(
+                    "feeder %s: checkpoint aborted — the barrier flush failed "
+                    "to deliver; journal kept (not rotated), snapshot not "
+                    "written", self.name,
+                )
+                return out
+            # a snapshot failure must not take the barrier flush's
+            # outputs down with it: those windows already left the
+            # manager state and the caller is their only route out.
+            # Abort (counted), deliver `out`, keep the journal — the
+            # old checkpoint + un-rotated journal still recover
+            # everything. KillPoint is a BaseException and still
+            # pierces (process death must not be absorbed).
+            try:
+                barrier = None
+                if self._journal is not None:
+                    epoch, off = self._journal.sync_offset()
+                    barrier = {"journal_epoch": epoch, "journal_offset": off}
+                res = save(barrier)
+            except Exception:
+                self.last_checkpoint_ok = False
+                self._count("checkpoint_aborts")
+                _log.exception(
+                    "feeder %s: checkpoint aborted — snapshot save failed; "
+                    "journal kept (not rotated), flushed outputs delivered",
+                    self.name,
+                )
+                return out
+            if res:
+                out.extend(res)
+            if self._journal is not None:
+                self._journal.rotate()
+            self.last_checkpoint_ok = True
+            return out
+
+    def replay_journal(self, path, *, barrier: dict | None = None) -> list:
+        """Recovery: replay a (crashed) feeder's journal through the
+        NORMAL decode path. FRAME records flow through _process_frame
+        (same coalescing, same bucket emits), MARK records re-create
+        the pump-boundary tail emits — so batch boundaries, and
+        therefore f32 meter fold order and flushed rows, are bit-exact
+        vs the uninterrupted run. `barrier` (from the checkpoint meta)
+        skips records the snapshot already covers when the crash landed
+        between save and rotate; a rotated journal (epoch advanced)
+        replays in full. Frames are re-journaled into THIS runtime's
+        journal, so recovery itself is crash-safe. After the replay,
+        call pump(): it completes the interrupted pump's tail emit.
+
+        Replaying from THIS runtime's own journal path (the natural
+        fixed-path restart) is safe: the entries are read up front and
+        the live journal is rotated first, so replayed frames are
+        re-appended exactly once into the fresh epoch instead of
+        duplicated behind their originals — a second crash would
+        otherwise double-apply every one of them."""
+        from .journal import REC_FRAME, REC_MARK, read_journal
+
+        with self._pump_mutex:
+            out: list = []
+            epoch, entries, truncated = read_journal(path)
+            if self._journal is not None:
+                try:
+                    aliased = Path(path).resolve() == self._journal.path.resolve()
+                except OSError:
+                    aliased = False
+                if aliased:
+                    self._journal.rotate()
+            skip_off = -1
+            if barrier and barrier.get("journal_epoch") == epoch:
+                skip_off = int(barrier.get("journal_offset", 0))
+            if truncated:
+                _log.warning(
+                    "feeder %s: journal %s has a torn tail (crash mid-write) — "
+                    "replaying the clean prefix", self.name, path,
+                )
+            for kind, payload, off in entries:
+                if off < skip_off:
+                    continue
+                if kind == REC_FRAME:
+                    if self._journal is not None:
+                        self._journal.append(payload)
+                    self._count("replayed_frames")
+                    self._process_frame(payload, out)
+                elif kind == REC_MARK:
+                    if self.config.emit_partial and self._rows > 0:
+                        out.extend(self._emit(self._rows, self._bucket_for(self._rows)))
+                    if self._journal is not None:
+                        self._journal.mark()
+            return out
 
     # -- thread ----------------------------------------------------------
+    def _hold_for_redelivery(self, held: list, new: list) -> list:
+        """Extend the serve() redelivery buffer, bounded by
+        config.max_held_outputs: while on_flush keeps failing the pump
+        keeps producing, and an unbounded hold list turns a broken
+        downstream into an OOM. Beyond the cap the OLDEST outputs are
+        shed and counted (held_outputs_shed / held_output_shed_records)
+        — the same counted-shedding contract as every other overflow
+        lane, logged once per overflow episode."""
+        held.extend(new)
+        cap = self.config.max_held_outputs
+        if cap and len(held) > cap:
+            drop = len(held) - cap
+            shed, held = held[:drop], held[drop:]
+            rows = sum(
+                int(getattr(o, "size", 0) or getattr(o, "count", 0) or 0)
+                for o in shed
+            )
+            self._count("held_outputs_shed", drop)
+            self._count("held_output_shed_records", rows)
+            if not self._held_shed_logged:
+                self._held_shed_logged = True
+                _log.error(
+                    "feeder %s: on_flush redelivery buffer overflowed — shed "
+                    "%d oldest output batches (%d records); downstream has "
+                    "been failing past max_held_outputs=%d",
+                    self.name, drop, rows, cap,
+                )
+        return held
+
     def serve(self, poll_ms: int = 20, on_flush=None) -> None:
         """Background pump loop; `on_flush(outputs)` receives every
         non-empty result (flushed windows must not be dropped on the
-        floor by a fire-and-forget loop)."""
+        floor by a fire-and-forget loop). Crash-loop guard (ISSUE 6):
+        a pump exception is counted (`pump_errors`) and the loop
+        restarts with capped exponential backoff — the daemon thread
+        never dies silently; `pump_failstreak`/`healthy` expose the
+        state. An `on_flush` exception is counted separately
+        (`flush_callback_errors`) and its outputs are HELD and
+        re-delivered on the next loop — at-least-once up to
+        config.max_held_outputs, beyond which the oldest are shed and
+        counted (never silently dropped)."""
         if self._thread is not None:
             return
         self._stop.clear()
+        idle = poll_ms / 1000.0
+        # shared backoff policy, decorrelated per instance: N feeder
+        # daemons recovering from the same device fault must not retry
+        # in lockstep (the herd the jitter exists to break)
+        policy = RetryPolicy(
+            base_delay_s=idle, max_delay_s=5.0, multiplier=2.0, jitter=0.5
+        )
+        rng = decorrelated_rng(hash(self.name) & 0xFFFF)
 
         def run():
+            cb_failstreak = 0
+            undelivered: list = []
             while not self._stop.is_set():
-                got = self.pump()
-                if got and on_flush is not None:
-                    on_flush(got)
+                try:
+                    got = self.pump()
+                except Exception:
+                    self._count("pump_errors")
+                    self._pump_failstreak += 1
+                    if self._pump_failstreak == 1:
+                        _log.exception(
+                            "feeder %s: pump failed — restarting loop with "
+                            "backoff", self.name,
+                        )
+                    self._stop.wait(policy.delay(self._pump_failstreak, rng))
+                    continue
+                if self._pump_failstreak:
+                    _log.warning(
+                        "feeder %s: pump loop recovered after %d failures",
+                        self.name, self._pump_failstreak,
+                    )
+                    self._pump_failstreak = 0
+                # flushed windows are held and re-delivered until
+                # on_flush accepts them (a callback that raises mid-way
+                # may see a window twice); the hold is BOUNDED — see
+                # _hold_for_redelivery
+                if on_flush is not None:
+                    undelivered = self._hold_for_redelivery(undelivered, got)
+                if undelivered and on_flush is not None:
+                    batch, undelivered = undelivered, []
+                    try:
+                        on_flush(batch)
+                    except Exception:
+                        undelivered = batch
+                        cb_failstreak += 1
+                        self._count("flush_callback_errors")
+                        _log.exception(
+                            "feeder %s: on_flush failed — holding %d "
+                            "outputs for redelivery", self.name, len(batch),
+                        )
+                        self._stop.wait(policy.delay(cb_failstreak, rng))
+                        continue
+                    cb_failstreak = 0
+                    self._held_shed_logged = False
                 if not got:
-                    time.sleep(poll_ms / 1000.0)
+                    self._stop.wait(idle)
 
         self._thread = threading.Thread(target=run, daemon=True)
         self._thread.start()

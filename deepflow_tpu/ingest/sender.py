@@ -14,6 +14,7 @@ import socket
 import threading
 import time
 
+from ..utils.stats import register_countable
 from .framing import (
     ENCODER_RAW,
     MAX_FRAME_SIZE,
@@ -23,6 +24,14 @@ from .framing import (
     encode_frame,
 )
 from .queues import new_queue
+from ..utils.retry import RetryPolicy, decorrelated_rng
+
+# reconnect backoff: the shared capped-exponential-with-jitter policy
+# (utils/retry.py), so a fleet of senders does not re-dial a
+# recovering server in lockstep (ISSUE 6). attempts is irrelevant here
+# — the reconnect loop is unbounded, only .delay() is used.
+_RECONNECT = RetryPolicy(base_delay_s=0.05, max_delay_s=2.0, jitter=0.5)
+_BACKOFF_CAP_ATTEMPT = 8  # delay saturates at max_delay_s well before this
 
 
 class UniformSender:
@@ -55,7 +64,19 @@ class UniformSender:
         self._sock: socket.socket | None = None
         self._server_idx = 0
         self._running = True
-        self.counters = {"tx_frames": 0, "tx_bytes": 0, "tx_msgs": 0, "reconnects": 0, "send_errors": 0}
+        self._reconnect_pending = False  # a loss happened; next connect is a re-connect
+        self._retry_rng = decorrelated_rng(0x5E4DE2)
+        self.counters = {
+            "tx_frames": 0, "tx_bytes": 0, "tx_msgs": 0,
+            "reconnects": 0, "reconnect_success": 0, "send_errors": 0,
+            "shutdown_shed_msgs": 0,
+        }
+        # reconnect attempts/successes are queryable in deepflow_system
+        # like every other component (weakly held — a dropped sender
+        # deregisters itself)
+        self._stats_src = register_countable(
+            "tpu_sender", self, msg_type=self.msg_type.name
+        )
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
@@ -67,6 +88,14 @@ class UniformSender:
     @property
     def dropped(self) -> int:
         return self._q.overwritten
+
+    def get_counters(self) -> dict:
+        """Countable face (utils/stats.StatsCollector)."""
+        out = dict(self.counters)
+        out["dropped"] = int(self._q.overwritten)
+        out["queue_depth"] = len(self._q)
+        out["connected"] = int(self._sock is not None)
+        return out
 
     def close(self, drain_timeout: float = 5.0) -> None:
         deadline = time.time() + drain_timeout
@@ -91,6 +120,9 @@ class UniformSender:
                 s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 self._sock = s
                 self._server_idx = (self._server_idx + i) % len(self.servers)
+                if self._reconnect_pending:
+                    self.counters["reconnect_success"] += 1
+                    self._reconnect_pending = False
                 return True
             except OSError:
                 continue
@@ -108,7 +140,7 @@ class UniformSender:
         return encode_frame(header, msgs, encoder=self.compression)
 
     def _run(self) -> None:
-        backoff = 0.05
+        attempt = 1  # consecutive connect/send failures (drives backoff)
         pending: list[bytes] = []
         pending_bytes = 0
         last_flush = time.monotonic()
@@ -132,8 +164,17 @@ class UniformSender:
             if pending and (pending_bytes >= self.batch_bytes or now - last_flush >= self.flush_interval or not self._running):
                 if self._sock is None and not self._connect():
                     self.counters["send_errors"] += 1
-                    time.sleep(backoff)
-                    backoff = min(backoff * 2, 2.0)
+                    if not self._running:
+                        # shutdown with every server unreachable: shed
+                        # the pending buffer (and whatever close() left
+                        # in the queue) instead of spinning the thread
+                        # forever — counted, like every other shed lane
+                        self.counters["shutdown_shed_msgs"] += (
+                            len(pending) + len(self._q)
+                        )
+                        return
+                    time.sleep(_RECONNECT.delay(attempt, self._retry_rng))
+                    attempt = min(attempt + 1, _BACKOFF_CAP_ATTEMPT)
                     continue
                 try:
                     # chunk so no frame exceeds batch_bytes (≤ MAX_FRAME_SIZE/2)
@@ -155,7 +196,7 @@ class UniformSender:
                         self.counters["tx_msgs"] += len(chunk)
                     pending_bytes = 0
                     last_flush = now
-                    backoff = 0.05
+                    attempt = 1
                 except OSError:
                     # requeue the in-flight chunk: the overwrite queue is
                     # the only place messages may be shed (at-least-once
@@ -165,11 +206,12 @@ class UniformSender:
                     pending_bytes = sum(len(m) + 4 for m in pending)
                     self.counters["send_errors"] += 1
                     self.counters["reconnects"] += 1
+                    self._reconnect_pending = True
                     try:
                         self._sock.close()
                     except OSError:
                         pass
                     self._sock = None
                     self._server_idx = (self._server_idx + 1) % len(self.servers)
-                    time.sleep(backoff)
-                    backoff = min(backoff * 2, 2.0)
+                    time.sleep(_RECONNECT.delay(attempt, self._retry_rng))
+                    attempt = min(attempt + 1, _BACKOFF_CAP_ATTEMPT)

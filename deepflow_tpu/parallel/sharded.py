@@ -33,9 +33,16 @@ try:  # jax ≥ 0.5 exports shard_map at top level
 except ImportError:  # pragma: no cover - 0.4.x fallback
     from jax.experimental.shard_map import shard_map
 
+from .. import chaos
 from ..aggregator import window as window_mod
 from ..aggregator.fanout import FANOUT_LANES, FanoutConfig
 from ..aggregator.pipeline import make_ingest_step
+from ..utils.retry import (
+    RetryPolicy,
+    decorrelated_rng,
+    is_dispatch_transient,
+    retry_call,
+)
 from ..utils.spans import (
     SPAN_FLUSH_DRAIN,
     SPAN_INGEST_DISPATCH,
@@ -423,6 +430,14 @@ class ShardedWindowManager:
         self.host_fetches = 0
         self.bytes_fetched = 0
         self.bytes_uploaded = 0
+        # transient-failure policy (ISSUE 6) — the single-chip
+        # WindowManager's twin: dispatch + fetch retry with
+        # decorrelated backoff+jitter; same admission-time-only caveat
+        # (utils/retry.py)
+        self.retry_policy = RetryPolicy()
+        self._retry_rng = decorrelated_rng(0x5A4DED)
+        self.dispatch_retries = 0
+        self.fetch_retries = 0
         self.tracer = tracer if tracer is not None else SpanTracer(
             service="deepflow_tpu.sharded_pipeline"
         )
@@ -437,8 +452,18 @@ class ShardedWindowManager:
     def _fetch(self, x) -> np.ndarray:
         """Every device→host transfer goes through the window module's
         host_fetch seam (late-bound so the CI shim counts it), with
-        per-manager count + byte accounting on top."""
-        arr = window_mod.host_fetch(x)
+        per-manager count + byte accounting on top. Transient fetch
+        failures retry with backoff (the handle stays valid)."""
+
+        def once():
+            chaos.maybe_fail(chaos.SITE_FETCH)
+            return window_mod.host_fetch(x)
+
+        def on_retry(_attempt, _exc):
+            self.fetch_retries += 1
+
+        arr = retry_call(once, self.retry_policy, on_retry=on_retry,
+                         rng=self._retry_rng)
         self.host_fetches += 1
         self.bytes_fetched += arr.nbytes
         return arr
@@ -465,6 +490,8 @@ class ShardedWindowManager:
             "host_fetches": self.host_fetches,
             "bytes_fetched": self.bytes_fetched,
             "bytes_uploaded": self.bytes_uploaded,
+            "dispatch_retries": self.dispatch_retries,
+            "fetch_retries": self.fetch_retries,
         }
 
     def telemetry(self) -> dict:
@@ -614,9 +641,24 @@ class ShardedWindowManager:
         self.bytes_uploaded += (
             sum(nb(v) for v in tags.values()) + nb(meters) + nb(valid)
         )
-        with self.tracer.span(SPAN_INGEST_DISPATCH):
-            self.stash, self.acc, self.sketches = self.pipe.step(
+        def dispatch_once():
+            # chaos fires before the sharded step — donated stash/acc/
+            # sketch buffers are untouched when a retried fault raises
+            chaos.maybe_fail(chaos.SITE_DISPATCH)
+            return self.pipe.step(
                 self.stash, self.acc, self.fill, self.sketches, tags, meters, valid
+            )
+
+        def on_retry(_attempt, _exc):
+            self.dispatch_retries += 1
+
+        with self.tracer.span(SPAN_INGEST_DISPATCH):
+            # admission-time-only classification: the step donates its
+            # buffers, so a mid-flight UNAVAILABLE/ABORTED must NOT
+            # retry against consumed arrays
+            self.stash, self.acc, self.sketches = retry_call(
+                dispatch_once, self.retry_policy, on_retry=on_retry,
+                rng=self._retry_rng, classify=is_dispatch_transient,
             )
         self.fill += rows_per_device
 

@@ -17,6 +17,7 @@ import time
 
 import numpy as np
 
+from .. import chaos
 from ..utils.stats import register_countable
 from .store import ColumnarStore, TableSchema
 
@@ -111,6 +112,10 @@ class TableWriter:
             }
             for attempt in range(self.retries):
                 try:
+                    # chaos seam: storage write faults (SinkWriteError is
+                    # an OSError, so injected failures exercise the real
+                    # retry/fail-count path below)
+                    chaos.maybe_fail(chaos.SITE_SINK_WRITE)
                     self.store.insert(self.db, self.schema.name, merged)
                     with self._lock:
                         self.counters["write_ok"] += rows

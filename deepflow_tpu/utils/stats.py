@@ -43,12 +43,15 @@ class StatsPoint:
 class CounterSource:
     """One registered countable: weakly held, tagged."""
 
-    __slots__ = ("module", "tags", "_ref", "_fn", "failures")
+    __slots__ = ("module", "tags", "_ref", "_fn", "failures", "cooldown",
+                 "suppressed")
 
     def __init__(self, module: str, tags: dict[str, str], countable):
         self.module = module
         self.tags = tuple(sorted(tags.items()))
         self.failures = 0  # consecutive get_counters() exceptions
+        self.cooldown = 0  # ticks to skip before the next re-probe
+        self.suppressed = False  # entered backoff (warning already logged)
         if callable(countable) and not isinstance(countable, Countable):
             # plain closures can't be weakly bound to a component lifetime;
             # hold them strongly (caller owns deregistration)
@@ -81,12 +84,20 @@ class StatsCollector:
     counter map (strongly held; `deregister` to remove).
     """
 
-    # consecutive sample failures before a source is dropped (logged once)
+    # consecutive sample failures before a source enters backoff
+    # (warning logged once on entry)
     MAX_SOURCE_FAILURES = 3
+    # re-probe backoff cap, in ticks: a broken source is probed at
+    # 1, 2, 4, … up to this many ticks apart — never dropped for good
+    # (ISSUE 6: a component that recovers, e.g. after a device comes
+    # back, must resume reporting without a process restart)
+    MAX_BACKOFF_TICKS = 64
 
     def __init__(self, interval_s: float = 10.0, ring_size: int = 4096):
         self.interval_s = interval_s
         self.n_source_errors = 0  # total get_counters() exceptions seen
+        self.n_source_recoveries = 0  # sources that came back from backoff
+        self.n_sink_errors = 0  # sink callback exceptions (contained)
         self._sources: list[CounterSource] = []
         self._sinks: list[Callable[[list[StatsPoint]], None]] = []
         self._ring: deque[StatsPoint] = deque(maxlen=ring_size)
@@ -121,10 +132,15 @@ class StatsCollector:
         Samples run outside the lock (a callback may register/deregister)
         and are exception-guarded — one broken component must not kill
         self-telemetry for the rest. Failures are COUNTED
-        (`n_source_errors`), and a source that fails
-        MAX_SOURCE_FAILURES times in a row is dropped with one warning
-        log — a permanently broken Countable must not silently eat a
-        slot (or mask everyone else's points) forever.
+        (`n_source_errors`); a source that fails MAX_SOURCE_FAILURES
+        times in a row enters capped-exponential BACKOFF (one warning
+        log) and keeps being re-probed at 1, 2, 4, …, MAX_BACKOFF_TICKS
+        tick spacing instead of being dropped — a component whose
+        dependency comes back (a reconnected store, a recovered device)
+        resumes reporting, with the recovery counted and logged once
+        (`n_source_recoveries`). Sink callbacks are guarded the same
+        way (`n_sink_errors`): a broken export loop must not kill the
+        collector thread.
         """
         now = time.time() if now is None else now
         points: list[StatsPoint] = []
@@ -132,6 +148,12 @@ class StatsCollector:
             sources = list(self._sources)
         dead: list[CounterSource] = []
         for src in sources:
+            if src.dead():
+                dead.append(src)
+                continue
+            if src.cooldown > 0:  # backing off — skip this tick
+                src.cooldown -= 1
+                continue
             try:
                 fields = src.sample()
             except Exception:
@@ -139,15 +161,31 @@ class StatsCollector:
                     self.n_source_errors += 1
                 src.failures += 1
                 if src.failures >= self.MAX_SOURCE_FAILURES:
-                    dead.append(src)
-                    _log.warning(
-                        "stats source %s%s dropped after %d consecutive "
-                        "sample errors",
-                        src.module, dict(src.tags) or "", src.failures,
-                        exc_info=True,
+                    src.cooldown = min(
+                        1 << (src.failures - self.MAX_SOURCE_FAILURES),
+                        self.MAX_BACKOFF_TICKS,
                     )
+                    if not src.suppressed:
+                        src.suppressed = True
+                        _log.warning(
+                            "stats source %s%s backing off after %d "
+                            "consecutive sample errors (re-probed with "
+                            "capped exponential spacing)",
+                            src.module, dict(src.tags) or "", src.failures,
+                            exc_info=True,
+                        )
                 continue
+            if src.suppressed:  # came back from backoff
+                src.suppressed = False
+                with self._lock:
+                    self.n_source_recoveries += 1
+                _log.warning(
+                    "stats source %s%s recovered after %d consecutive "
+                    "sample errors", src.module, dict(src.tags) or "",
+                    src.failures,
+                )
             src.failures = 0
+            src.cooldown = 0
             if fields is None:  # component died → auto-deregister
                 dead.append(src)
                 continue
@@ -159,7 +197,13 @@ class StatsCollector:
             sinks = list(self._sinks)
             self._ring.extend(points)
         for sink in sinks:
-            sink(points)
+            try:
+                sink(points)
+            except Exception:
+                with self._lock:
+                    self.n_sink_errors += 1
+                _log.warning("stats sink %r failed; points dropped for "
+                             "this tick", sink, exc_info=True)
         return points
 
     def recent(self, module: str | None = None) -> list[StatsPoint]:

@@ -7,8 +7,19 @@ run on 8 virtual CPU devices, which is what multi-chip sharding tests
 need and keeps the real chip free for benchmarking.
 """
 
+import faulthandler
 import os
 import sys
+
+# Hung-device forensics (ISSUE 6): a wedged dispatch/fetch used to die
+# at the suite timeout with no trace of WHERE it hung. faulthandler
+# dumps every thread's stack to stderr shortly before the tier-1
+# timeout (ROADMAP: 870 s) would kill us, without exiting — the test
+# then still fails on its own terms, but the log says which seam hung.
+faulthandler.enable()
+_dump_after = float(os.environ.get("DEEPFLOW_FAULTHANDLER_TIMEOUT_S", "840"))
+if _dump_after > 0:
+    faulthandler.dump_traceback_later(_dump_after, exit=False)
 
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"

@@ -162,3 +162,92 @@ def test_round5_encoders_roundtrip_under_mutation():
                 parse(bytes(b))
             except ValueError:
                 pass
+
+
+def test_feeder_flow_codec_quarantines_corrupt_flowframes():
+    """ISSUE 6: truncated / bit-flipped FlowBatch frames must be
+    quarantined-and-counted by the feeder's flow codec — never raised
+    into pump(). The deepflow stance (decode errors counted, not
+    fatal), enforced at the FrameCodecBase boundary."""
+    from deepflow_tpu.feeder import encode_flowbatch_frames
+    from deepflow_tpu.feeder.runtime import _FlowFrameCodec
+    from deepflow_tpu.ingest.replay import SyntheticFlowGen
+
+    rng = _rng()
+    gen = SyntheticFlowGen(num_tuples=50, seed=5)
+    frames = encode_flowbatch_frames(
+        gen.flow_batch(120, 1_700_000_000), max_rows_per_frame=24
+    )
+    codec = _FlowFrameCodec()
+    n_hostile = 0
+    for fr in frames:
+        # pristine frame decodes
+        assert codec.decode_frame(fr) is not None
+        arr = np.frombuffer(fr, np.uint8).copy()
+        for _ in range(20):
+            mode = rng.integers(0, 3)
+            if mode == 0:  # truncate
+                mut = fr[: int(rng.integers(1, len(fr)))]
+            elif mode == 1:  # bit flips
+                m = arr.copy()
+                flips = rng.integers(0, len(m), size=max(1, len(m) // 16))
+                m[flips] ^= rng.integers(1, 256, size=len(flips)).astype(np.uint8)
+                mut = m.tobytes()
+            else:  # garbage splice
+                cut = int(rng.integers(0, len(fr)))
+                mut = fr[:cut] + rng.integers(
+                    0, 256, int(rng.integers(1, 64)), dtype=np.uint8
+                ).tobytes()
+            n_hostile += 1
+            codec.decode_frame(mut)  # must NEVER raise
+    # plenty of the mutations were actually rejected (and each rejection
+    # was counted + ring-quarantined)
+    assert 0 < codec.decode_errors <= n_hostile
+    assert len(codec.quarantine) == min(codec.decode_errors, 8)
+
+
+def test_feeder_doc_sink_quarantines_corrupt_documents():
+    """Same stance for the pb Document lane: hostile METRICS frames are
+    contained by WindowManagerFeedSink; per-message garbage inside a
+    well-framed body is absorbed by the DocumentDecoder's per-row error
+    counting instead."""
+    from deepflow_tpu.aggregator.window import WindowConfig, WindowManager
+    from deepflow_tpu.datamodel.batch import DocBatch
+    from deepflow_tpu.datamodel.schema import FLOW_METER, TAG_SCHEMA
+    from deepflow_tpu.feeder import WindowManagerFeedSink
+    from deepflow_tpu.ingest.codec import encode_docbatch
+    from deepflow_tpu.ingest.framing import FlowHeader, MessageType, encode_frame
+
+    rng = _rng()
+    n = 24
+    tags = np.zeros((n, TAG_SCHEMA.num_fields), np.uint32)
+    tags[:, TAG_SCHEMA.index("meter_id")] = 1
+    tags[:, TAG_SCHEMA.index("code_id")] = 1
+    meters = np.zeros((n, FLOW_METER.num_fields), np.float32)
+    meters[:, FLOW_METER.index("packet_tx")] = 1
+    db = DocBatch(tags=tags, meters=meters,
+                  timestamp=np.full(n, 1_700_000_000, np.uint32),
+                  valid=np.ones(n, bool))
+    frame = encode_frame(
+        FlowHeader(msg_type=int(MessageType.METRICS), agent_id=1),
+        encode_docbatch(db),
+    )
+
+    wm = WindowManager(WindowConfig(capacity=1 << 10))
+    sink = WindowManagerFeedSink(wm, (32, 64))
+    assert sink.decode_frame(frame) is not None
+
+    arr = np.frombuffer(frame, np.uint8).copy()
+    for _ in range(120):
+        mode = rng.integers(0, 2)
+        if mode == 0:
+            mut = frame[: int(rng.integers(1, len(frame)))]
+        else:
+            m = arr.copy()
+            flips = rng.integers(0, len(m), size=max(1, len(m) // 20))
+            m[flips] ^= rng.integers(1, 256, size=len(flips)).astype(np.uint8)
+            mut = m.tobytes()
+        sink.decode_frame(mut)  # must NEVER raise
+    # hostile bytes landed in one of the two counted containment layers
+    assert sink.decode_errors + sink.decoder.decode_errors > 0
+    assert len(sink.quarantine) == min(sink.decode_errors, 8)

@@ -1,0 +1,618 @@
+"""Journaled recovery (ISSUE 6): kill-and-recover must be BIT-EXACT —
+flushed window rows and the counter block — against an uninterrupted
+oracle run, for kill-points before/during/after advance, flush and
+checkpoint, single-chip and sharded. Plus the journal file format's
+crash artifacts (torn tails, failed rotates) and the atomic+digested
+checkpoint writer."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from deepflow_tpu import chaos
+from deepflow_tpu.aggregator.checkpoint import (
+    load_window_state,
+    read_checkpoint_meta,
+    restore_sharded_state,
+    save_sharded_state,
+    save_window_state,
+)
+from deepflow_tpu.aggregator.pipeline import L4Pipeline, PipelineConfig
+from deepflow_tpu.aggregator.window import WindowConfig
+from deepflow_tpu.datamodel.schema import FLOW_METER, TAG_SCHEMA
+from deepflow_tpu.feeder import (
+    FeederConfig,
+    FeederRuntime,
+    FrameJournal,
+    PipelineFeedSink,
+    ShardedFeedSink,
+    encode_flowbatch_frames,
+    read_journal,
+)
+from deepflow_tpu.feeder.journal import REC_FRAME, REC_MARK
+from deepflow_tpu.ingest.queues import PyOverwriteQueue
+from deepflow_tpu.ingest.replay import SyntheticFlowGen
+
+T0 = 1_700_000_000
+BUCKETS = (64, 128, 256)
+
+# the shared kill-and-recover schedule: two checkpoint barriers, window
+# advances at known dispatch indices, a multi-window flush, final drain
+STEPS = (
+    ("batch", T0, 100),
+    ("batch", T0 + 1, 120),
+    ("ckpt",),
+    ("batch", T0 + 5, 90),
+    ("batch", T0 + 6, 110),
+    ("ckpt",),
+    ("batch", T0 + 7, 80),
+    ("batch", T0 + 10, 100),
+    ("drain",),
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    yield
+    chaos.uninstall()
+
+
+_FRAMES = None
+_ORACLES: dict = {}
+
+
+def _frame_stream(seed=31):
+    """Pre-encode every batch step's frames ONCE — oracle and victim
+    must see byte-identical traffic (cached: every kill variant replays
+    the same stream)."""
+    global _FRAMES
+    if _FRAMES is None:
+        gen = SyntheticFlowGen(num_tuples=150, seed=seed)
+        _FRAMES = {
+            i: encode_flowbatch_frames(gen.flow_batch(n, t), max_rows_per_frame=64)
+            for i, (kind, *args) in enumerate(STEPS)
+            if kind == "batch"
+            for t, n in (args,)
+        }
+    return _FRAMES
+
+
+# -- contexts: the single-chip and sharded pipeline stacks ----------------
+
+
+@dataclasses.dataclass
+class _Ctx:
+    q: object
+    feeder: object
+    save: object  # save(barrier) → outputs to emit
+    drain: object  # () → final outputs
+    restore: object  # () → load the checkpoint into this stack
+    counters: object  # () → comparable logical counter dict
+    ckpt: object  # checkpoint path
+
+
+def _single_ctx(tmp, jname):
+    pipe = L4Pipeline(PipelineConfig(
+        window=WindowConfig(capacity=1 << 12, delay=2),
+        batch_size=256, bucket_sizes=BUCKETS,
+    ))
+    q = PyOverwriteQueue(1 << 12)
+    feeder = FeederRuntime(
+        [q], PipelineFeedSink(pipe), FeederConfig(frames_per_queue=128),
+        journal=FrameJournal(tmp / jname),
+    )
+    ckpt = tmp / "wm.ckpt"
+
+    def save(barrier):
+        in_flight = save_window_state(pipe.wm, ckpt, extra_meta=barrier)
+        return [pipe._to_docbatch(f) for f in in_flight]
+
+    def restore():
+        pipe.wm = load_window_state(ckpt, TAG_SCHEMA, FLOW_METER)
+
+    def counters():
+        c = pipe.get_counters()
+        return {k: c[k] for k in (
+            "doc_in", "flushed_doc", "drop_before_window", "prereduce_shed",
+            "excess_word_hits", "stash_evictions", "window_advances",
+            "feeder_shed",
+        )}
+
+    return _Ctx(q, feeder, save, pipe.drain, restore, counters, ckpt)
+
+
+def _sharded_ctx(tmp, jname):
+    from deepflow_tpu.ops.histogram import LogHistSpec
+    from deepflow_tpu.parallel.mesh import make_mesh
+    from deepflow_tpu.parallel.sharded import (
+        ShardedConfig,
+        ShardedPipeline,
+        ShardedWindowManager,
+    )
+
+    cfg = ShardedConfig(
+        capacity_per_device=1 << 10, num_services=16, hll_precision=6,
+        hist=LogHistSpec(bins=64, vmin=1.0, gamma=1.3),
+    )
+    swm = ShardedWindowManager(ShardedPipeline(make_mesh(2), cfg))
+    q = PyOverwriteQueue(1 << 12)
+    feeder = FeederRuntime(
+        [q], ShardedFeedSink(swm, BUCKETS), FeederConfig(frames_per_queue=128),
+        journal=FrameJournal(tmp / jname),
+    )
+    ckpt = tmp / "swm.ckpt"
+
+    def save(barrier):
+        return save_sharded_state(swm, ckpt, extra_meta=barrier)
+
+    def restore():
+        restore_sharded_state(swm, ckpt)
+
+    def counters():
+        c = swm.get_counters()
+        return {k: c[k] for k in (
+            "flow_in", "flushed_doc", "drop_before_window", "window_advances",
+        )}
+
+    return _Ctx(q, feeder, save, swm.drain, restore, counters, ckpt)
+
+
+def _execute(ctx, frames, start=0):
+    """Run STEPS[start:]; → (outputs in emission order, durable_count)
+    where durable_count = outputs already covered by the last completed
+    barrier (checkpoint or drain) — what a transactional downstream
+    would have committed when a crash hits."""
+    outputs, durable = [], 0
+    for i in range(start, len(STEPS)):
+        kind = STEPS[i][0]
+        if kind == "batch":
+            for fr in frames[i]:
+                ctx.q.put(fr)
+            outputs += ctx.feeder.pump()
+        elif kind == "ckpt":
+            outputs += ctx.feeder.checkpoint(ctx.save)
+            durable = len(outputs)
+        else:  # drain
+            outputs += ctx.feeder.flush()
+            outputs += ctx.drain()
+            durable = len(outputs)
+    return outputs, durable
+
+
+def _assert_outputs_bit_exact(got, want):
+    assert len(got) == len(want), (len(got), len(want))
+    for a, b in zip(got, want):
+        np.testing.assert_array_equal(a.timestamp, b.timestamp)
+        np.testing.assert_array_equal(a.tags, b.tags)
+        assert a.meters.tobytes() == b.meters.tobytes()  # f32 bit-exact
+
+
+def _oracle_for(tmp_path, mk_ctx):
+    """The uninterrupted oracle run (journal active — identical code
+    path). Cached per stack kind: every kill variant compares against
+    the same stream, so one oracle serves the whole matrix."""
+    key = mk_ctx.__name__
+    if key not in _ORACLES:
+        oracle_dir = tmp_path / "oracle"
+        oracle_dir.mkdir()
+        octx = mk_ctx(oracle_dir, "j.bin")
+        out, _ = _execute(octx, _frame_stream())
+        _ORACLES[key] = (out, octx.counters())
+    return _ORACLES[key]
+
+
+def _kill_and_recover(tmp_path, mk_ctx, plan, *, break_rotate=False):
+    """Run the oracle; run a victim killed by `plan`; recover from
+    checkpoint+journal; assert outputs and counters bit-exact."""
+    frames = _frame_stream()
+    oracle_out, oracle_c = _oracle_for(tmp_path, mk_ctx)
+
+    # victim: same stream, killed mid-schedule
+    victim_dir = tmp_path / "victim"
+    victim_dir.mkdir()
+    vctx = mk_ctx(victim_dir, "j1.bin")
+    if break_rotate:
+        # simulate a crash window between snapshot save and journal
+        # rotate: the rotate never happens, so recovery must rely on
+        # the (epoch, offset) barrier in the checkpoint meta
+        vctx.feeder._journal.rotate = lambda: False
+    outputs, durable, killed_at = [], 0, None
+    chaos.install(plan)
+    try:
+        for i in range(len(STEPS)):
+            kind = STEPS[i][0]
+            try:
+                if kind == "batch":
+                    for fr in frames[i]:
+                        vctx.q.put(fr)
+                    outputs += vctx.feeder.pump()
+                elif kind == "ckpt":
+                    outputs += vctx.feeder.checkpoint(vctx.save)
+                    durable = len(outputs)
+                else:
+                    outputs += vctx.feeder.flush()
+                    outputs += vctx.drain()
+                    durable = len(outputs)
+            except chaos.KillPoint:
+                killed_at = i
+                break
+    finally:
+        chaos.uninstall()
+    assert killed_at is not None, "the kill-point never fired"
+    survivors = outputs[:durable]  # post-barrier outputs die with the process
+
+    # recovery: ONLY disk state (checkpoint + journal) survives
+    rctx = mk_ctx(victim_dir, "j2.bin")
+    barrier = None
+    if vctx.ckpt.exists():
+        meta = read_checkpoint_meta(vctx.ckpt)
+        if "journal_epoch" in meta:
+            barrier = {
+                "journal_epoch": meta["journal_epoch"],
+                "journal_offset": meta["journal_offset"],
+            }
+        rctx.restore()
+    recovered = rctx.feeder.replay_journal(victim_dir / "j1.bin", barrier=barrier)
+    recovered += rctx.feeder.pump()  # completes the interrupted pump's tail
+    rest, _ = _execute(rctx, frames, start=killed_at + 1)
+    recovered += rest
+
+    _assert_outputs_bit_exact(survivors + recovered, oracle_out)
+    assert rctx.counters() == oracle_c
+    return rctx
+
+
+# -- the kill matrix ------------------------------------------------------
+# Single-chip (double-buffered sink): dispatch indices 0..5; the T0+5
+# batch's dispatch (idx 2) advances the span and flushes windows
+# T0/T0+1; its flush-row fetch is host_fetch idx 5. Sharded (no double
+# buffer): dispatch idx = batch ordinal; the T0+5 advance's packed-row
+# block fetch is fetch idx 2.
+
+_SINGLE_KILLS = {
+    "pre_advance": chaos.FaultRule(chaos.SITE_DISPATCH, at=(2,), error=chaos.KillPoint()),
+    "mid_flush": chaos.FaultRule(chaos.SITE_FETCH, at=(5,), error=chaos.KillPoint()),
+    "during_ckpt": chaos.FaultRule(chaos.SITE_DISPATCH, at=(3,), error=chaos.KillPoint()),
+    "post_ckpt": chaos.FaultRule(chaos.SITE_DISPATCH, at=(4,), error=chaos.KillPoint()),
+}
+
+_SHARDED_KILLS = {
+    "pre_advance": chaos.FaultRule(chaos.SITE_DISPATCH, at=(2,), error=chaos.KillPoint()),
+    "mid_flush": chaos.FaultRule(chaos.SITE_FETCH, at=(2,), error=chaos.KillPoint()),
+    "post_ckpt": chaos.FaultRule(chaos.SITE_DISPATCH, at=(4,), error=chaos.KillPoint()),
+}
+
+
+@pytest.mark.parametrize("kill", sorted(_SINGLE_KILLS))
+def test_kill_and_recover_single_chip_bit_exact(tmp_path, kill):
+    _kill_and_recover(
+        tmp_path, _single_ctx, chaos.FaultPlan().add(_SINGLE_KILLS[kill])
+    )
+
+
+@pytest.mark.parametrize("kill", sorted(_SHARDED_KILLS))
+def test_kill_and_recover_sharded_bit_exact(tmp_path, kill):
+    _kill_and_recover(
+        tmp_path, _sharded_ctx, chaos.FaultPlan().add(_SHARDED_KILLS[kill])
+    )
+
+
+def test_kill_between_save_and_rotate_does_not_double_apply(tmp_path):
+    """The nasty crash window: snapshot saved, journal NOT rotated. The
+    journal still holds pre-barrier frames; replay must skip them via
+    the (epoch, offset) barrier in the checkpoint meta or every
+    checkpointed row double-counts."""
+    rctx = _kill_and_recover(
+        tmp_path, _single_ctx,
+        chaos.FaultPlan().add(_SINGLE_KILLS["post_ckpt"]),
+        break_rotate=True,
+    )
+    # the un-rotated journal really did hold pre-barrier frames —
+    # i.e. the skip was exercised, not vacuous
+    c = rctx.feeder.get_counters()
+    assert c["replayed_frames"] > 0
+
+
+def test_recovery_without_any_checkpoint(tmp_path):
+    """Kill before the first checkpoint: recovery = full journal replay
+    from an empty manager."""
+    plan = chaos.FaultPlan().add(
+        chaos.FaultRule(chaos.SITE_DISPATCH, at=(0,), error=chaos.KillPoint())
+    )
+    _kill_and_recover(tmp_path, _single_ctx, plan)
+
+
+# -- journal file format --------------------------------------------------
+
+
+def test_journal_roundtrip_and_torn_tail(tmp_path):
+    p = tmp_path / "j.bin"
+    j = FrameJournal(p)
+    j.append(b"frame-one")
+    j.append(b"frame-two")
+    j.mark()
+    j.append(b"frame-three")
+    j.mark()
+    j.close()
+
+    epoch, entries, truncated = read_journal(p)
+    assert epoch == 0 and not truncated
+    assert [(k, pl) for k, pl, _ in entries] == [
+        (REC_FRAME, b"frame-one"), (REC_FRAME, b"frame-two"), (REC_MARK, b""),
+        (REC_FRAME, b"frame-three"), (REC_MARK, b""),
+    ]
+
+    # crash mid-write: a torn trailing record is detected and skipped,
+    # the clean prefix survives. Cut into frame-three's record (13-byte
+    # record header + 11-byte payload, then a 13-byte trailing MARK).
+    data = p.read_bytes()
+    p.write_bytes(data[:-20])
+    epoch, entries, truncated = read_journal(p)
+    assert truncated
+    assert [pl for k, pl, _ in entries if k == REC_FRAME] == [
+        b"frame-one", b"frame-two",
+    ]
+
+    # corrupt interior record: replay stops at it (never yields garbage)
+    buf = bytearray(data)
+    buf[len(data) // 2] ^= 0xFF
+    p.write_bytes(bytes(buf))
+    _, entries2, truncated2 = read_journal(p)
+    assert truncated2 and len(entries2) < len(entries) + 3
+
+
+def test_journal_reopen_truncates_torn_tail(tmp_path):
+    """Reopening a journal after a crash-mid-record must truncate the
+    torn tail before appending: records written after reopen would
+    otherwise sit beyond the corruption and never replay."""
+    p = tmp_path / "j.bin"
+    j = FrameJournal(p)
+    j.append(b"pre-crash")
+    j.mark()
+    j.close()
+    data = p.read_bytes()
+    p.write_bytes(data[:-5])  # tear into the trailing MARK record
+
+    j2 = FrameJournal(p)  # the restarted process reuses the path
+    assert j2.get_counters()["reopen_truncations"] == 1
+    j2.append(b"post-restart")
+    j2.mark()
+    j2.close()
+
+    epoch, entries, truncated = read_journal(p)
+    assert not truncated  # the torn bytes are GONE, not buried
+    assert [pl for k, pl, _ in entries if k == REC_FRAME] == [
+        b"pre-crash", b"post-restart",
+    ]
+
+
+def test_journal_rotate_bumps_epoch_and_clears(tmp_path):
+    p = tmp_path / "j.bin"
+    j = FrameJournal(p)
+    j.append(b"old")
+    j.mark()
+    epoch, off = j.sync_offset()
+    assert epoch == 0 and off > 0
+    assert j.rotate()
+    j.append(b"new")
+    j.mark()
+    j.close()
+    epoch, entries, truncated = read_journal(p)
+    assert epoch == 1 and not truncated
+    assert [pl for k, pl, _ in entries if k == REC_FRAME] == [b"new"]
+    assert j.get_counters()["rotations"] == 1
+
+    # re-open resumes the rotated epoch
+    j2 = FrameJournal(p)
+    assert j2.epoch == 1
+    j2.close()
+
+
+def test_journal_is_bounded(tmp_path):
+    j = FrameJournal(tmp_path / "j.bin", max_bytes=256)
+    blob = b"x" * 64
+    appended = sum(1 for _ in range(20) if j.append(blob))
+    j.close()
+    c = j.get_counters()
+    assert appended < 20  # the bound engaged
+    assert c["overflow_frames"] == 20 - appended  # dropped, COUNTED
+    assert c["frames"] == appended
+
+
+def test_journal_io_faults_are_contained(tmp_path):
+    j = FrameJournal(tmp_path / "j.bin")
+    chaos.install(chaos.FaultPlan().add(
+        chaos.FaultRule(chaos.SITE_JOURNAL_IO, at=(1,),
+                        error=chaos.CheckpointIOError)
+    ))
+    assert j.append(b"ok")  # idx 0: fine
+    assert not j.append(b"lost")  # idx 1: injected I/O error, contained
+    assert j.append(b"ok2")
+    chaos.uninstall()
+    j.mark()
+    j.close()
+    assert j.get_counters()["io_errors"] == 1
+    _, entries, _ = read_journal(tmp_path / "j.bin")
+    assert [pl for k, pl, _ in entries if k == REC_FRAME] == [b"ok", b"ok2"]
+
+
+def test_replay_respects_barrier_offset(tmp_path):
+    """Unit-level barrier skip: frames before the checkpoint's
+    (epoch, offset) never reach the decode path on replay."""
+    frames = _frame_stream()
+    p = tmp_path / "j.bin"
+    j = FrameJournal(p)
+    for fr in frames[0]:
+        j.append(fr)
+    j.mark()
+    epoch, off = j.sync_offset()
+    for fr in frames[1]:
+        j.append(fr)
+    j.mark()
+    j.close()
+
+    ctx = _single_ctx(tmp_path, "j2.bin")
+    ctx.feeder.replay_journal(
+        p, barrier={"journal_epoch": epoch, "journal_offset": off}
+    )
+    c = ctx.feeder.get_counters()
+    assert c["replayed_frames"] == len(frames[1])
+    assert c["records_in"] == 120  # only step 1's rows
+
+
+def test_replay_from_own_journal_path_does_not_duplicate(tmp_path):
+    """The natural fixed-path restart: the recovered runtime opens its
+    journal at the SAME path it replays. The live journal must rotate
+    before re-appending, or every frame sits twice in one epoch and a
+    second crash double-applies them all."""
+    frames = _frame_stream()
+    ctx = _single_ctx(tmp_path, "j.bin")
+    for i in (0, 1):
+        for fr in frames[i]:
+            ctx.q.put(fr)
+        ctx.feeder.pump()
+    ctx.feeder._journal.close()  # crash
+
+    ctx2 = _single_ctx(tmp_path, "j.bin")  # SAME journal path
+    ctx2.feeder.replay_journal(tmp_path / "j.bin")
+    c = ctx2.feeder.get_counters()
+    assert c["replayed_frames"] == len(frames[0]) + len(frames[1])
+    ctx2.feeder._journal.close()
+
+    epoch, entries, truncated = read_journal(tmp_path / "j.bin")
+    assert epoch == 1 and not truncated  # rotated, then re-journaled
+    payloads = [pl for k, pl, _ in entries if k == REC_FRAME]
+    assert len(payloads) == c["replayed_frames"]  # each frame ONCE
+    assert len(set(payloads)) == len(payloads)
+
+
+# -- atomic + digested checkpoints ---------------------------------------
+
+
+def _small_pipe():
+    return L4Pipeline(PipelineConfig(
+        window=WindowConfig(capacity=1 << 12), batch_size=256,
+    ))
+
+
+def test_checkpoint_truncation_fails_loudly(tmp_path):
+    """Regression for the mid-write-kill failure mode of the old
+    non-atomic writer: a torn checkpoint file must produce a clear
+    error, not a numpy/zipfile traceback."""
+    gen = SyntheticFlowGen(num_tuples=40, seed=7)
+    from deepflow_tpu.datamodel.batch import FlowBatch
+
+    pipe = _small_pipe()
+    pipe.ingest(FlowBatch.from_records(gen.records(100, T0)))
+    p = tmp_path / "wm.ckpt"
+    # a MISSING file stays FileNotFoundError (cold start, not corruption)
+    with pytest.raises(FileNotFoundError):
+        read_checkpoint_meta(tmp_path / "nope.ckpt")
+    save_window_state(pipe.wm, p)
+    data = p.read_bytes()
+    for cut in (10, len(data) // 2, len(data) - 3):
+        p.write_bytes(data[:cut])
+        with pytest.raises(ValueError, match="truncated or corrupt"):
+            load_window_state(p, TAG_SCHEMA, FLOW_METER)
+        # the meta-only reader keeps the same loud contract
+        with pytest.raises(ValueError, match="truncated or corrupt"):
+            read_checkpoint_meta(p)
+    # no stray temp file from the atomic writer
+    assert not (tmp_path / "wm.ckpt.tmp").exists()
+
+
+def test_checkpoint_digest_mismatch_fails_loudly(tmp_path):
+    import io
+    import json
+
+    gen = SyntheticFlowGen(num_tuples=40, seed=7)
+    from deepflow_tpu.datamodel.batch import FlowBatch
+
+    pipe = _small_pipe()
+    pipe.ingest(FlowBatch.from_records(gen.records(100, T0)))
+    p = tmp_path / "wm.ckpt"
+    save_window_state(pipe.wm, p)
+
+    # rebuild a VALID npz whose arrays were tampered with but whose
+    # meta (and digest) are stale — zipfile CRCs pass, the content
+    # digest must not
+    with np.load(io.BytesIO(p.read_bytes())) as z:
+        meta = json.loads(bytes(z["meta"]).decode())
+        arrays = {k: np.asarray(z[k]) for k in z.files if k != "meta"}
+    arrays["stash_packed"] = np.zeros_like(arrays["stash_packed"])
+    buf = io.BytesIO()
+    np.savez_compressed(
+        buf, meta=np.frombuffer(json.dumps(meta).encode(), np.uint8), **arrays
+    )
+    p.write_bytes(buf.getvalue())
+    with pytest.raises(ValueError, match="digest mismatch"):
+        load_window_state(p, TAG_SCHEMA, FLOW_METER)
+
+
+def test_sharded_checkpoint_roundtrip(tmp_path):
+    """Sharded save/restore alone (no journal): open windows survive,
+    meter mass conserved, wrong-mesh restore fails loudly."""
+    from deepflow_tpu.ops.histogram import LogHistSpec
+    from deepflow_tpu.parallel.mesh import make_mesh
+    from deepflow_tpu.parallel.sharded import (
+        ShardedConfig,
+        ShardedPipeline,
+        ShardedWindowManager,
+    )
+
+    cfg = ShardedConfig(
+        capacity_per_device=1 << 10, num_services=16, hll_precision=6,
+        hist=LogHistSpec(bins=64, vmin=1.0, gamma=1.3),
+    )
+
+    def mk(n_dev=2):
+        return ShardedWindowManager(ShardedPipeline(make_mesh(n_dev), cfg))
+
+    gen = SyntheticFlowGen(num_tuples=80, seed=19)
+    stream = [(T0, 128), (T0 + 1, 128), (T0 + 6, 128), (T0 + 7, 64)]
+
+    def run(save_after):
+        g = SyntheticFlowGen(num_tuples=80, seed=19)
+        swm = mk()
+        docs = []
+        for i, (t, n) in enumerate(stream):
+            fb = g.flow_batch(n, t)
+            docs += swm.ingest(fb.tags, fb.meters, fb.valid)
+            if save_after == i:
+                save_sharded_state(swm, tmp_path / "swm.ckpt")
+                swm = mk()
+                restore_sharded_state(swm, tmp_path / "swm.ckpt")
+        docs += swm.drain()
+        c = FLOW_METER.index("packet_tx")
+        return (sum(float(db.meters[:, c].sum()) for db in docs),
+                sum(db.size for db in docs))
+
+    assert run(save_after=1) == run(save_after=None)
+
+    # device-count mismatch must fail loudly, not mis-split
+    swm4 = mk(4)
+    with pytest.raises(ValueError, match="devices"):
+        restore_sharded_state(swm4, tmp_path / "swm.ckpt")
+
+    # window-timing mismatch must fail loudly too: start_window /
+    # drop_before_window are indices in units of interval and would be
+    # silently reinterpreted under a different delay/interval
+    from deepflow_tpu.parallel.sharded import ShardedWindowManager as _SWM
+    from deepflow_tpu.parallel.mesh import make_mesh as _mm
+    from deepflow_tpu.parallel.sharded import ShardedPipeline as _SP
+
+    with pytest.raises(ValueError, match="window timing"):
+        restore_sharded_state(
+            _SWM(_SP(_mm(2), cfg), delay=5), tmp_path / "swm.ckpt"
+        )
+
+    # capacity mismatch: the stash S dim disagrees with the compiled
+    # config — loud error, not a downstream shape blowup
+    cfg_small = dataclasses.replace(cfg, capacity_per_device=1 << 9)
+    with pytest.raises(ValueError, match="capacity_per_device"):
+        restore_sharded_state(
+            _SWM(_SP(_mm(2), cfg_small)), tmp_path / "swm.ckpt"
+        )

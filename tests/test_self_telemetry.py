@@ -275,16 +275,23 @@ def test_points_to_influx_numpy_scalars_keep_int_typing():
     assert "i=9i" in text and "f=2.0" in text
 
 
-def test_stats_collector_counts_and_drops_broken_sources():
+def test_stats_collector_backs_off_and_reprobes_broken_sources():
+    """ISSUE 6: a source that keeps failing enters capped exponential
+    backoff (sampled at 1, 2, 4, … tick spacing) instead of being
+    dropped forever; when it heals, reporting resumes and the recovery
+    is counted once."""
     col = StatsCollector(interval_s=999)
 
     calls = {"n": 0}
+    state = {"fail": True}
 
-    def broken():
+    def flaky():
         calls["n"] += 1
-        raise RuntimeError("boom")
+        if state["fail"]:
+            raise RuntimeError("boom")
+        return {"x": 1}
 
-    col.register("bad", broken)
+    col.register("bad", flaky)
     col.register("good", lambda: {"x": 1})
 
     for _ in range(StatsCollector.MAX_SOURCE_FAILURES):
@@ -292,10 +299,45 @@ def test_stats_collector_counts_and_drops_broken_sources():
         # the healthy source keeps reporting throughout
         assert [p.module for p in pts] == ["good"]
     assert col.n_source_errors == StatsCollector.MAX_SOURCE_FAILURES
-    # dropped: no further sampling of the broken source
+    # backoff: the next tick skips the broken source (cooldown=1)...
     col.tick(now=float(T0 + 1))
     assert calls["n"] == StatsCollector.MAX_SOURCE_FAILURES
-    assert col.n_source_errors == StatsCollector.MAX_SOURCE_FAILURES
+    # ...but the one after re-probes it — NOT dropped permanently
+    col.tick(now=float(T0 + 2))
+    assert calls["n"] == StatsCollector.MAX_SOURCE_FAILURES + 1
+    assert col.n_source_errors == StatsCollector.MAX_SOURCE_FAILURES + 1
+    # the spacing grows (cooldown=2 now) and is capped
+    col.tick(now=float(T0 + 3))
+    assert calls["n"] == StatsCollector.MAX_SOURCE_FAILURES + 1
+
+    # heal the source: burn through the remaining cooldown, then the
+    # re-probe succeeds, reporting resumes, recovery counted once
+    state["fail"] = False
+    for i in range(4):
+        pts = col.tick(now=float(T0 + 4 + i))
+        if sorted(p.module for p in pts) == ["bad", "good"]:
+            break
+    else:
+        raise AssertionError("backed-off source never re-probed")
+    assert col.n_source_recoveries == 1
+    # healthy again: sampled every tick from here on
+    pts = col.tick(now=float(T0 + 10))
+    assert sorted(p.module for p in pts) == ["bad", "good"]
+    assert col.n_source_recoveries == 1
+
+
+def test_stats_collector_survives_broken_sink():
+    """A raising sink callback must not kill the tick (the collector
+    thread would die silently) — contained and counted."""
+    col = StatsCollector(interval_s=999)
+    col.register("m", lambda: {"x": 1})
+    col.add_sink(lambda pts: (_ for _ in ()).throw(RuntimeError("sink boom")))
+    got = []
+    col.add_sink(got.extend)
+    pts = col.tick(now=float(T0))
+    assert [p.module for p in pts] == ["m"]
+    assert col.n_sink_errors == 1
+    assert got  # the healthy sink still received the points
 
 
 def test_stats_collector_transient_failure_recovers():
