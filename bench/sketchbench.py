@@ -1,0 +1,201 @@
+"""Sketch-tier A/B bench (ISSUE 8): exact-only vs +sketch-plane vs
++top-K through the windowed raw-doc ingest path, under a
+high-cardinality generator (Zipf heavy flows + a uniform scan sweep —
+the DDoS/scan shape that overflows the exact stash).
+
+Measures, per (batch, stash) shape:
+  * rec/s for the three variants (the sketch tax on steady ingest);
+  * HLL cardinality error of the closed window vs the true distinct
+    count (acceptance: <1% at ≥1M distinct keys, hll_precision=14);
+  * top-K heavy-hitter recall vs the true by-bytes top-K
+    (acceptance: ≥0.95 at K=128, Zipf s=1.1);
+  * exact-tier coverage (flushed rows / distinct keys) — the shed the
+    sketch tier papers over.
+
+Protocol + committed CPU numbers: PERF.md §17 (on-chip columns
+reserved). Knobs: SKETCHBENCH_SHAPES="batch:stash,...",
+SKETCHBENCH_BATCHES, SKETCHBENCH_KEYS, SKETCHBENCH_TOPK,
+SKETCHBENCH_PRECISION. Emits one JSON record on the last stdout line
+(bench_all.py c9 re-emits it)."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deepflow_tpu.aggregator.sketchplane import SketchConfig  # noqa: E402
+from deepflow_tpu.aggregator.window import WindowConfig, WindowManager  # noqa: E402
+from deepflow_tpu.datamodel.schema import FLOW_METER, TAG_SCHEMA  # noqa: E402
+from deepflow_tpu.ops.histogram import LogHistSpec  # noqa: E402
+
+T0 = 1_700_000_000
+
+
+def _shapes() -> list[tuple[int, int]]:
+    env = os.environ.get("SKETCHBENCH_SHAPES")
+    if env:
+        return [tuple(int(x) for x in s.split(":")) for s in env.split(",")]
+    # full protocol grid: {64k..1M} batch × {8k, 64k} stash
+    return [(1 << 16, 1 << 13), (1 << 16, 1 << 16),
+            (1 << 18, 1 << 13), (1 << 18, 1 << 16),
+            (1 << 20, 1 << 13), (1 << 20, 1 << 16)]
+
+
+class _KeyGen:
+    """Zipf heavy flows over [0, n_keys) + a SEQUENTIAL scan sweep —
+    every batch is half skewed traffic, half scanner walking the key
+    space (the address-scan shape: guaranteed-high distinct count)."""
+
+    def __init__(self, rng, n_keys, zipf_s):
+        self.rng, self.n_keys, self.s = rng, n_keys, zipf_s
+        self.cursor = 0
+
+    def batch(self, n):
+        half = n // 2
+        z = self.rng.zipf(self.s, size=4 * half)
+        z = z[z <= self.n_keys][:half].astype(np.uint64) - 1
+        scan = (self.cursor + np.arange(n - len(z), dtype=np.uint64)) % self.n_keys
+        self.cursor = int((self.cursor + len(scan)) % self.n_keys)
+        keys = np.concatenate([z, scan])
+        self.rng.shuffle(keys)
+        return keys
+
+
+def _doc_batch(keys: np.ndarray, t: int):
+    n = len(keys)
+    k_lo = (keys & 0xFFFFFFFF).astype(np.uint32)
+    k_hi32 = (keys >> 32).astype(np.uint32)
+    tags = np.zeros((TAG_SCHEMA.num_fields, n), np.uint32)
+    tags[TAG_SCHEMA.index("ip0_w3")] = k_lo
+    tags[TAG_SCHEMA.index("ip0_w2")] = k_hi32
+    tags[TAG_SCHEMA.index("server_port")] = 443
+    tags[TAG_SCHEMA.index("protocol")] = 6
+    tags[TAG_SCHEMA.index("l3_epc_id1")] = (k_lo % np.uint32(7)).astype(np.uint32)
+    meters = np.zeros((FLOW_METER.num_fields, n), np.float32)
+    meters[FLOW_METER.index("byte_tx")] = 100.0
+    meters[FLOW_METER.index("rtt_sum")] = 10.0
+    meters[FLOW_METER.index("rtt_count")] = 1.0
+    # injective 64-bit fingerprint of the key id — the doc key identity
+    hi = (k_lo * np.uint32(2654435761)) ^ k_hi32
+    lo = k_lo ^ np.uint32(0x9E3779B9) ^ (k_hi32 * np.uint32(40503))
+    return (np.full(n, t, np.uint32), jnp.asarray(hi), jnp.asarray(lo),
+            jnp.asarray(tags), jnp.asarray(meters), np.ones(n, bool))
+
+
+def _run_variant(variant, batch, stash, batches, n_keys, zipf_s, k_top,
+                 precision):
+    sk = None
+    if variant != "exact":
+        sk = SketchConfig(
+            num_groups=8, hll_precision=precision, cms_depth=4,
+            cms_width=1 << 16,
+            hist=LogHistSpec(bins=128, vmin=1.0, gamma=1.1),
+            topk_rows=2 if variant == "topk" else 0,
+            topk_cols=max(64, 1 << (max(k_top, 1) - 1).bit_length() + 3),
+            pending=8,
+        )
+    wm = WindowManager(WindowConfig(capacity=stash, delay=2, sketch=sk))
+    gen = _KeyGen(np.random.default_rng(7), n_keys, zipf_s)
+    key_stream, flushed = [], []
+    # warmup batch compiles the fused step (excluded from timing; a
+    # separate throwaway generator keeps the measured stream seeded)
+    wk = _KeyGen(np.random.default_rng(1), n_keys, zipf_s).batch(
+        min(batch, 1 << 14)
+    )
+    wm.ingest(*_doc_batch(wk, T0 - 100))
+    wm.flush_all()
+
+    t_ingest = 0.0
+    for i in range(batches):
+        keys = gen.batch(batch)
+        key_stream.append(keys)
+        b = _doc_batch(keys, T0)
+        t0 = time.perf_counter()
+        flushed += wm.ingest(*b)
+        jax.block_until_ready(wm.acc.slot)
+        t_ingest += time.perf_counter() - t0
+    flushed += wm.flush_all()
+
+    all_keys = np.concatenate(key_stream)
+    true_distinct = len(np.unique(all_keys))
+    f0 = next(f for f in flushed if f.window_idx == T0)
+    exact_rows = f0.count
+    rec = {
+        "variant": variant,
+        "rec_s": batch * batches / t_ingest if t_ingest else 0.0,
+        "true_distinct": true_distinct,
+        "exact_rows_flushed": int(exact_rows),
+        "exact_coverage": float(exact_rows) / true_distinct,
+        "stash_evictions": int(np.asarray(wm.state.dropped_overflow)),
+    }
+    if sk is not None and f0.sketches is not None:
+        blk = f0.sketches
+        est = blk.distinct()
+        rec["hll_estimate"] = est
+        rec["cardinality_error"] = abs(est - true_distinct) / true_distinct
+        if variant == "topk":
+            uniq, counts = np.unique(all_keys, return_counts=True)
+            order = np.argsort(-counts, kind="stable")
+            true_top = uniq[order[:k_top]]
+            # match on the doc-key fingerprint the sketch stores — the
+            # same identity flushed exact rows carry
+            t_lo = (true_top & 0xFFFFFFFF).astype(np.uint32)
+            t_hi32 = (true_top >> 32).astype(np.uint32)
+            want = {
+                (int((a * np.uint32(2654435761)) ^ b),
+                 int(a ^ np.uint32(0x9E3779B9) ^ (b * np.uint32(40503))))
+                for a, b in zip(t_lo, t_hi32)
+            }
+            got = blk.topk(k_top)
+            have = {(t_["key_hi"], t_["key_lo"]) for t_ in got}
+            rec["topk_recall"] = len(want & have) / max(1, k_top)
+            rec["topk_returned"] = len(got)
+    counters = wm.get_counters()
+    rec["sketch_rows"] = counters["sketch_rows"]
+    rec["sketch_shed"] = counters["sketch_shed"]
+    return rec
+
+
+def main():
+    batches = int(os.environ.get("SKETCHBENCH_BATCHES", "4"))
+    n_keys = int(os.environ.get("SKETCHBENCH_KEYS", str(1 << 20)))
+    zipf_s = float(os.environ.get("SKETCHBENCH_ZIPF", "1.1"))
+    k_top = int(os.environ.get("SKETCHBENCH_TOPK", "128"))
+    precision = int(os.environ.get("SKETCHBENCH_PRECISION", "14"))
+    rows = []
+    err = None
+    try:
+        for batch, stash in _shapes():
+            for variant in ("exact", "sketch", "topk"):
+                r = _run_variant(variant, batch, stash, batches, n_keys,
+                                 zipf_s, k_top, precision)
+                r.update(batch=batch, stash=stash)
+                rows.append(r)
+                print(json.dumps(r), file=sys.stderr)
+    except Exception as e:  # partial-JSON convention (bench.py stance)
+        err = repr(e)
+    out = {
+        "bench": "sketchbench", "rows": rows,
+        "n_keys": n_keys, "zipf_s": zipf_s, "k_top": k_top,
+        "hll_precision": precision, "batches_per_variant": batches,
+        "backend": jax.default_backend(),
+    }
+    if err:
+        out["partial"] = True
+        out["error"] = err
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -447,13 +447,48 @@ def config8(quick: bool):
          journal_on_fsync=rec["journal_on_fsync"], buckets=rec["buckets"])
 
 
+def config9(quick: bool):
+    """Sketch tier A/B (ISSUE 8): exact-only vs +sketch-plane vs +top-K
+    through the windowed raw-doc path under Zipf+scan traffic, via
+    bench/sketchbench.py (protocol + committed numbers: PERF.md §17).
+    The vs line is the top-K variant's heavy-hitter recall at the
+    largest shape run; cardinality error and the exact tier's shed
+    coverage ride the detail rows. Quick mode trims to one small shape;
+    the acceptance grid (1M-row batches, ≥1M distinct keys, K=128,
+    Zipf s=1.1) is the standalone default."""
+    import os
+    import subprocess
+
+    env = {**os.environ}
+    if quick:
+        env.update(SKETCHBENCH_SHAPES="65536:8192", SKETCHBENCH_BATCHES="2",
+                   SKETCHBENCH_KEYS=str(1 << 18))
+    out = subprocess.run(
+        [sys.executable, "bench/sketchbench.py"],
+        capture_output=True, text=True, timeout=3600, env=env,
+    )
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    rows = rec["rows"]
+    if not rows:
+        emit("c9_sketch_tier", 0, "error", 0, error=rec.get("error", "no rows"))
+        return
+    topk_rows = [r for r in rows if r["variant"] == "topk"]
+    last = topk_rows[-1] if topk_rows else rows[-1]
+    emit("c9_sketch_tier", last["rec_s"], "records/s",
+         last.get("topk_recall", 0.0), rows=rows,
+         cardinality_error=last.get("cardinality_error"),
+         exact_coverage=last.get("exact_coverage"),
+         n_keys=rec["n_keys"], zipf_s=rec["zipf_s"], k_top=rec["k_top"],
+         partial=rec.get("partial", False), error=rec.get("error"))
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--cpu", action="store_true")
     p.add_argument("--quick", action="store_true")
     args = p.parse_args()
     for fn in (config1, config2, config3, config4, config5, config6, config7,
-               config8):
+               config8, config9):
         try:
             fn(args.quick)
         except Exception as e:  # one config must not kill the others

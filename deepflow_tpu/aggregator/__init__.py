@@ -9,6 +9,7 @@ from .stash import (
     stash_merge_fold,
     unpack_flush_rows,
 )
+from .sketchplane import SketchConfig, WindowSketchBlock
 from .window import WindowConfig, WindowManager
 
 __all__ = [
@@ -23,4 +24,6 @@ __all__ = [
     "unpack_flush_rows",
     "WindowConfig",
     "WindowManager",
+    "SketchConfig",
+    "WindowSketchBlock",
 ]

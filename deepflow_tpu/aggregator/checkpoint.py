@@ -36,6 +36,7 @@ from __future__ import annotations
 import hashlib
 import io
 import json
+import logging
 import os
 from functools import partial
 from pathlib import Path
@@ -46,11 +47,72 @@ import numpy as np
 
 from .. import chaos
 from ..datamodel.schema import MeterSchema, TagSchema
+from .sketchplane import SketchConfig, SketchState, sketch_init
 from .stash import AccumState, StashState, pack_u32_columns
 from .window import WindowConfig, WindowManager
 
-_VERSION = 3
+_VERSION = 4
 _MIN_READ_VERSION = 2  # v2 = pre-digest layout, still loadable
+
+_log = logging.getLogger(__name__)
+
+# sketch-plane lanes (v4): one checkpoint array per device lane, with a
+# leading device dim on the sharded kind. v2/v3 files predate the plane
+# — loading one re-initializes the sketches with a LOUD log (partial
+# aggregates of open windows' sketches are rebuilt from replay where the
+# journal covers them; approximate tiers degrade, they never crash).
+_SKETCH_LANES = (
+    "win", "count", "hll", "cms", "hist",
+    "tk_votes", "tk_hi", "tk_lo", "tk_ida", "tk_idb",
+    "pend", "pend_win",
+)
+
+
+def _sketch_arrays(sk: SketchState, prefix: str = "sk_") -> dict:
+    return {prefix + name: np.asarray(getattr(sk, name)) for name in _SKETCH_LANES}
+
+
+def _sketch_meta(sk: SketchState, cfg: SketchConfig) -> dict:
+    return {
+        "sketch": cfg.meta(),
+        "sketch_pend_n": np.asarray(sk.pend_n).tolist(),
+        "sketch_rows": np.asarray(sk.rows).tolist(),
+        "sketch_shed": np.asarray(sk.shed).tolist(),
+    }
+
+
+def _restore_sketch(meta: dict, arrays: dict, cfg: SketchConfig,
+                    ring: int, path, *, sharded_dim: int | None = None):
+    """→ SketchState from a v4 checkpoint, or a LOUDLY-logged fresh
+    plane when the file predates the sketch tier (v2/v3) or was saved
+    with sketches off."""
+    if "sk_win" not in arrays:
+        _log.warning(
+            "checkpoint %s (version %s) carries no sketch planes — "
+            "re-initializing the per-window sketch tier empty; open "
+            "windows' approximate answers restart from this point",
+            path, meta.get("version"),
+        )
+        sk = sketch_init(cfg, ring)
+        if sharded_dim is not None:
+            sk = jax.tree.map(
+                lambda x: jnp.broadcast_to(x[None], (sharded_dim,) + x.shape), sk
+            )
+        return sk
+    saved_cfg = SketchConfig.from_meta(meta["sketch"])
+    if saved_cfg != cfg:
+        raise ValueError(
+            f"checkpoint {path} sketch config {saved_cfg} != manager "
+            f"sketch config {cfg} — plane shapes/error knobs disagree"
+        )
+    kw = {name: jnp.asarray(arrays["sk_" + name]) for name in _SKETCH_LANES}
+    scal = lambda v, dt: jnp.asarray(np.asarray(v), dt)
+    return SketchState(
+        **kw,
+        pend_n=scal(meta["sketch_pend_n"], jnp.int32),
+        rows=scal(meta["sketch_rows"], jnp.uint32),
+        shed=scal(meta["sketch_shed"], jnp.uint32),
+    )
 
 
 @jax.jit
@@ -270,6 +332,22 @@ def save_window_state(wm: WindowManager, path: str | Path, *, extra_meta=None):
             # NOT resume into the rank-merge
             "fold_mode": wm.config.fold_mode,
         }
+        if wm.sk is not None:
+            # v4: the per-window sketch plane rides the checkpoint so a
+            # resumed manager keeps open windows' approximate state
+            # bit-exact. settle() above drained the device pending
+            # buffer AND every host-held block married its flush, so
+            # the host dict must be empty here — anything left means a
+            # block's window never flushed, which would silently vanish
+            # across the resume.
+            if wm._sketch_blocks:
+                raise RuntimeError(
+                    "sketch blocks for windows "
+                    f"{sorted(wm._sketch_blocks)} are still held after "
+                    "settle(); checkpointing would lose them"
+                )
+            arrays.update(_sketch_arrays(wm.sk))
+            meta.update(_sketch_meta(wm.sk, wm.config.sketch))
         if extra_meta:
             meta.update(extra_meta)
         _write_checkpoint(path, meta, arrays)
@@ -277,8 +355,13 @@ def save_window_state(wm: WindowManager, path: str | Path, *, extra_meta=None):
 
 
 def load_window_state(
-    path: str | Path, tag_schema: TagSchema, meter_schema: MeterSchema
+    path: str | Path, tag_schema: TagSchema, meter_schema: MeterSchema,
+    *, sketch_config: SketchConfig | None = None,
 ) -> WindowManager:
+    """Rebuild a WindowManager from a checkpoint. The sketch plane
+    restores from v4 files automatically; `sketch_config` asks for the
+    plane explicitly when resuming a pre-v4 file into a sketch-enabled
+    deployment (re-initialized with a loud log — never a crash)."""
     meta, arrays = _read_checkpoint(path)
     _check_version(meta, path)
     if meta.get("kind", "window") != "window":
@@ -287,6 +370,8 @@ def load_window_state(
             "single-chip window checkpoint (restore_sharded_state loads "
             "sharded ones)"
         )
+    if sketch_config is None and "sketch" in meta:
+        sketch_config = SketchConfig.from_meta(meta["sketch"])
     cfg = WindowConfig(
         interval=meta["interval"],
         delay=meta["delay"],
@@ -295,6 +380,7 @@ def load_window_state(
         async_drain=meta.get("async_drain", False),
         stats_ring=meta.get("stats_ring", 1),
         fold_mode=meta.get("fold_mode", "full"),
+        sketch=sketch_config,
     )
     wm = WindowManager(cfg, tag_schema, meter_schema)
     t = tag_schema.num_fields
@@ -324,6 +410,10 @@ def load_window_state(
     wm.aux_count = meta.get("aux_count", 0)
     wm.excess_word_hits = meta.get("excess_word_hits", 0)
     wm.feeder_shed = meta.get("feeder_shed", 0)
+    if cfg.sketch is not None:
+        wm.sk = _restore_sketch(meta, arrays, cfg.sketch, cfg.ring, path)
+        wm.sketch_rows = int(meta.get("sketch_rows", 0))
+        wm.sketch_shed = int(meta.get("sketch_shed", 0))
     # the save settled (ring drained), so the restored host span IS
     # the device gate state — mirror it back onto the device
     wm._sync_device_sw()
@@ -347,10 +437,10 @@ def save_sharded_state(swm, path: str | Path, *, extra_meta=None) -> list:
         arrays = {
             "stash_packed": np.asarray(_pack_stash_sharded(swm.stash)),
             "dropped": np.asarray(swm.stash.dropped_overflow),
-            "hll": np.asarray(swm.sketches.hll),
-            "cms": np.asarray(swm.sketches.cms),
-            "hist": np.asarray(swm.sketches.hist),
         }
+        # v4: per-window sketch lanes, one array per lane with the
+        # device dim leading (pend_n/rows/shed are [D] vectors in meta)
+        arrays.update(_sketch_arrays(swm.sketches))
         c = swm.pipe.config
         meta = {
             "version": _VERSION,
@@ -368,6 +458,8 @@ def save_sharded_state(swm, path: str | Path, *, extra_meta=None) -> list:
             "total_flushed": swm.total_flushed,
             "n_advances": swm.n_advances,
         }
+        meta.update(_sketch_meta(swm.sketches, c.sketch_config()))
+        meta["sketch_ring"] = c.sketch_ring
         if extra_meta:
             meta.update(extra_meta)
         _write_checkpoint(path, meta, arrays)
@@ -383,7 +475,6 @@ def restore_sharded_state(swm, path: str | Path):
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from ..datamodel.schema import TAG_SCHEMA
-    from ..parallel.sharded import SketchPlanes
 
     meta, arrays = _read_checkpoint(path)
     _check_version(meta, path)
@@ -424,15 +515,24 @@ def restore_sharded_state(swm, path: str | Path):
             "window indices in units of interval and would be silently "
             "reinterpreted"
         )
+    if "sketch_ring" in meta and meta["sketch_ring"] != swm.pipe.config.sketch_ring:
+        raise ValueError(
+            f"checkpoint sketch_ring={meta['sketch_ring']} != pipeline "
+            f"sketch_ring={swm.pipe.config.sketch_ring} — per-window slot "
+            "layout disagrees"
+        )
     stash = _unpack_stash_sharded(
         jnp.asarray(arrays["stash_packed"]),
         jnp.asarray(arrays["dropped"], dtype=jnp.int32),
         num_tags=t,
     )
-    sketches = SketchPlanes(
-        hll=jnp.asarray(arrays["hll"]),
-        cms=jnp.asarray(arrays["cms"]),
-        hist=jnp.asarray(arrays["hist"]),
+    # sketch planes: v4 restores bit-exact; v2/v3 files carry the old
+    # span-global planes (or none) — re-initialize per-window planes
+    # with a loud log, never a crash (satellite contract)
+    sketches = _restore_sketch(
+        meta, arrays, swm.pipe.config.sketch_config(),
+        swm.pipe.config.sketch_ring, path,
+        sharded_dim=swm.pipe.n_devices,
     )
     spec = NamedSharding(swm.pipe.mesh, P(swm.pipe.axes))
     swm.stash = jax.tree.map(lambda x: jax.device_put(x, spec), stash)

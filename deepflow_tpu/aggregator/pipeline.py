@@ -38,7 +38,10 @@ from .window import (
     WindowConfig,
     WindowManager,
     batch_counter_block,
+    sketch_inputs_from_columns,
+    sketch_span_bounds,
 )
+from .sketchplane import SketchConfig, sketch_plane_step
 
 _KEY_COLS = np.nonzero(TAG_SCHEMA.key_mask)[0].astype(np.int32)
 # DOC_KEY_PACK covers exactly the TAG_SCHEMA key columns — drift between
@@ -102,13 +105,25 @@ def batch_prereduce(tags, meters, valid, interval, cap, sum_cols, max_cols):
 
 
 def make_ingest_step(fanout_config: FanoutConfig, interval: int = 1, app: bool = False,
-                     batch_unique_cap: int | None = None, fold_mode: str = "full"):
+                     batch_unique_cap: int | None = None, fold_mode: str = "full",
+                     sketch_config: "SketchConfig | None" = None, delay: int = 2):
     """Build the pure device step pair: FlowBatch columns → stash.
 
     Returns (append, fold):
 
       (stash, acc) = append(stash, acc, offset, tags, meters, valid)
       (stash, acc) = fold(stash, acc)
+
+    With `sketch_config` set (ISSUE 8), append grows the per-window
+    sketch plane in the same traced step:
+
+      (stash, acc, sk) = append(stash, acc, offset, sk, tags, meters,
+                                valid, start_window)
+
+    where `sk` is a sketchplane.SketchState and `start_window` the
+    host's open-span gate (the plane derives its close bound from the
+    batch itself, exactly like the window managers — `delay` must match
+    the manager's).
 
     `append` runs per batch: fanout → fingerprint → one
     dynamic_update_slice into the accumulator ring at `offset` (a traced
@@ -141,7 +156,7 @@ def make_ingest_step(fanout_config: FanoutConfig, interval: int = 1, app: bool =
 
     check_fold_mode(fold_mode)
 
-    def append(stash, acc, offset, tags, meters, valid):
+    def _base_append(stash, acc, offset, tags, meters, valid):
         if batch_unique_cap is not None:
             tags, meters, valid, dropped = batch_prereduce(
                 tags, meters, valid, interval, batch_unique_cap,
@@ -154,7 +169,32 @@ def make_ingest_step(fanout_config: FanoutConfig, interval: int = 1, app: bool =
         hi, lo = _doc_fingerprint(doc_tags)  # packed key words, no key_mat take
         window = (ts // jnp.uint32(interval)).astype(jnp.uint32)
         acc = _append_impl(acc, window, hi, lo, doc_tags, doc_meters, doc_valid, offset)
-        return stash, acc
+        return stash, acc, tags, meters, valid
+
+    if sketch_config is None:
+        def append(stash, acc, offset, tags, meters, valid):
+            stash, acc, _, _, _ = _base_append(stash, acc, offset, tags, meters, valid)
+            return stash, acc
+    else:
+        meter_ix = meter_schema.index
+
+        def append(stash, acc, offset, sk, tags, meters, valid, start_window):
+            stash, acc, r_tags, r_meters, r_valid = _base_append(
+                stash, acc, offset, tags, meters, valid
+            )
+            ts = jnp.asarray(r_tags["timestamp"], jnp.uint32)
+            base_w, close_w = sketch_span_bounds(
+                start_window, ts, r_valid, interval=interval, delay=delay
+            )
+            inp = sketch_inputs_from_columns(
+                r_tags, r_meters, sk.hll.shape[1], meter_ix
+            )
+            sk = sketch_plane_step(
+                sk, sketch_config.hist,
+                window=ts // jnp.uint32(interval), valid=r_valid,
+                base_w=base_w, close_w=close_w, **inp,
+            )
+            return stash, acc, sk
 
     if fold_mode == "merge":
         def fold(stash, acc):
@@ -231,6 +271,16 @@ class RollupPipeline:
         )
         self._tag_names: tuple | None = None  # fixed on first batch
         self._step = None
+        # closed-window sketch blocks (ISSUE 8): DocBatch is the exact
+        # writer format, so blocks accumulate here for the sketch sink
+        # (integration/dfstats.sketch_system_sink) / querier instead.
+        # BOUNDED: a deployment that never drains pop_closed_sketches
+        # must not leak a block per window forever — beyond the cap the
+        # oldest block drops and is counted (same drop-oldest-counted
+        # stance as the device pending buffer).
+        self.closed_sketches: list = []
+        self.max_held_sketches = 512
+        self.sketch_blocks_dropped = 0
         # self-telemetry registration (reference RegisterCountable stance:
         # every component registers at construction; weakly held, so
         # short-lived pipelines deregister themselves)
@@ -253,17 +303,40 @@ class RollupPipeline:
         max_cols = np.nonzero(m.max_mask)[0].astype(np.int32)
         cap_u = self.config.batch_unique_cap
         interval = self.config.window.interval
+        delay = self.config.window.delay
         fanout_cfg = self.config.fanout
         fanout_fn = self.fanout_fn
+        sketch_cfg = self.config.window.sketch
+        m_ix = m.index
+
+        def _sketch(sk, tags, meters, valid, start_window):
+            """Per-window plane update from the RAW flow rows (ISSUE 8):
+            pre-fanout, so a flow counts once — doc-lane replication
+            would multiply every CMS/top-K weight by FANOUT_LANES. With
+            the pre-reduce on, the post-reduce rows carry the summed
+            meters, so weights stay exact. Traced into the same fused
+            step — zero extra dispatches or fetches."""
+            ts = jnp.asarray(tags["timestamp"], jnp.uint32)
+            base_w, close_w = sketch_span_bounds(
+                start_window, ts, valid, interval=interval, delay=delay
+            )
+            inp = sketch_inputs_from_columns(tags, meters, sk.hll.shape[1], m_ix)
+            return sketch_plane_step(
+                sk, sketch_cfg.hist,
+                window=ts // jnp.uint32(interval), valid=valid,
+                base_w=base_w, close_w=close_w, **inp,
+            )
 
         def step(acc, offset, start_window, stash_valid, stash_evict,
-                 feeder_shed, fold_rows, tag_mat, meters, valid):
+                 feeder_shed, fold_rows, sk, tag_mat, meters, valid):
             tags = {k: tag_mat[i] for i, k in enumerate(names)}
             aux = None
             if cap_u is not None:
                 tags, meters, valid, aux = batch_prereduce(
                     tags, meters, valid, interval, cap_u, sum_cols, max_cols
                 )
+            if sk is not None:
+                sk = _sketch(sk, tags, meters, valid, start_window)
             doc_tags, doc_meters, ts, doc_valid = fanout_fn(
                 tags, meters, valid, fanout_cfg
             )
@@ -276,13 +349,28 @@ class RollupPipeline:
                 excess_hits=excess_hits, stash_valid=stash_valid,
                 stash_evictions=stash_evict, ring_fill=offset,
                 feeder_shed=feeder_shed, fold_rows=fold_rows,
+                sketch_rows=None if sk is None else sk.rows,
+                sketch_shed=None if sk is None else sk.shed,
             )
             acc = _append_impl(
                 acc, window, hi, lo, doc_tags, doc_meters, gated, offset
             )
-            return acc, block
+            if sk is None:
+                return acc, block
+            return acc, block, sk
 
-        return jax.jit(step, donate_argnums=(0,))
+        if sketch_cfg is None:
+            # keep the sketch-free signature (and jit cache key) identical
+            # to the pre-ISSUE-8 step: None is not a pytree leaf we want
+            # in the dispatch path
+            def step_plain(acc, offset, start_window, stash_valid, stash_evict,
+                           feeder_shed, fold_rows, tag_mat, meters, valid):
+                return step(acc, offset, start_window, stash_valid,
+                            stash_evict, feeder_shed, fold_rows, None,
+                            tag_mat, meters, valid)
+
+            return jax.jit(step_plain, donate_argnums=(0,))
+        return jax.jit(step, donate_argnums=(0, 7))
 
     def _pad_target(self, rows: int) -> int:
         """Static pad size for a batch of `rows`: the smallest bucket
@@ -333,7 +421,7 @@ class RollupPipeline:
             # idle heartbeat: skip the upload/append (it would burn ring
             # rows and force empty folds); still settle any deferred
             # async-drain buffers so closed windows aren't held up
-            return [self._to_docbatch(f) for f in self.wm.settle()]
+            return self._convert_flushed(self.wm.settle())
         return self.ingest_staged(staged, feeder_shed=feeder_shed)
 
     def ingest_staged(
@@ -356,8 +444,14 @@ class RollupPipeline:
             # stash lanes read at dispatch time (post any fold) — device
             # handles, no transfer; they fill the counter block's
             # occupancy/eviction/fold_rows lanes inside the same fused
-            # call
+            # call. The sketch plane rides the same dispatch when on.
             st = self.wm.state
+            if self.wm.sk is not None:
+                return self._step(
+                    acc, offset, start_window, st.valid, st.dropped_overflow,
+                    shed, self.wm._fold_rows_dev, self.wm.sk,
+                    staged.tag_mat, staged.meters, staged.valid,
+                )
             return self._step(
                 acc, offset, start_window, st.valid, st.dropped_overflow,
                 shed, self.wm._fold_rows_dev,
@@ -366,10 +460,33 @@ class RollupPipeline:
 
         flushed = self.wm.ingest_step(dispatch, rows, ring_rows=max_rows)
         self._jit.poll()
-        return [self._to_docbatch(f) for f in flushed]
+        return self._convert_flushed(flushed)
 
     def drain(self) -> list[DocBatch]:
-        return [self._to_docbatch(f) for f in self.wm.flush_all()]
+        return self._convert_flushed(self.wm.flush_all())
+
+    def _convert_flushed(self, flushed: list[FlushedWindow]) -> list[DocBatch]:
+        """FlushedWindows → writer DocBatches; closed sketch blocks are
+        captured into `closed_sketches` (sketch-only windows — every
+        exact row shed — produce a block but no DocBatch)."""
+        from .sketchplane import hold_blocks
+
+        out = []
+        blocks = []
+        for f in flushed:
+            if f.sketches is not None:
+                blocks.append(f.sketches)
+            if f.count:
+                out.append(self._to_docbatch(f))
+        self.sketch_blocks_dropped += hold_blocks(
+            self.closed_sketches, blocks, self.max_held_sketches
+        )
+        return out
+
+    def pop_closed_sketches(self) -> list:
+        """Drain the accumulated WindowSketchBlocks (oldest first)."""
+        out, self.closed_sketches = self.closed_sketches, []
+        return out
 
     def _to_docbatch(self, f: FlushedWindow) -> DocBatch:
         ts = np.full((f.count,), f.start_time, dtype=np.uint32)
@@ -387,6 +504,10 @@ class RollupPipeline:
         plus the fused-step jit compile/retrace counters."""
         out = self.wm.get_counters()
         out.update(self._jit.get_counters())
+        # held closed-window blocks + the drop-oldest overflow counter:
+        # a rising dropped count means nobody drains pop_closed_sketches
+        out["sketch_blocks_held"] = len(self.closed_sketches)
+        out["sketch_blocks_dropped"] = self.sketch_blocks_dropped
         return out
 
     def telemetry(self) -> dict:

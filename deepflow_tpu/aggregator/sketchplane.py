@@ -1,0 +1,584 @@
+"""Per-window device-resident sketch plane — the approximate tier of the
+windowed pipeline (ISSUE 8).
+
+The exact stash is capacity-bounded: under high-cardinality traffic
+(DDoS, scans, per-user flows) it overflows and sheds, which is both a
+correctness cliff and the throughput ceiling. This plane keeps, for
+every *open window*, a fixed-size approximate summary on device — HLL
+registers (distinct clients per service), a count-min plane (per-flow
+frequency/bytes), a log-binned latency histogram (t-digest source), and
+an invertible top-K sketch (ops/topk.py — heavy flow keys recoverable
+from the sketch itself) — updated from the SAME fused jit dispatch as
+the exact append, so the shed path degrades *detail*, never *coverage*.
+
+Ring semantics. Open windows span at most R = delay//interval + 2
+consecutive indices, so an [R]-slot ring indexed by `window % R` holds
+them without aliasing (consecutive windows are distinct mod R). The
+fused step closes slots itself: it derives the post-batch span start
+(`close_w`, exactly the host's advance rule) and, between folding the
+batch's closing-span rows and its new-span rows, moves every slot with
+win < close_w into a flat PENDING buffer of packed u32 block rows. The
+host drains pending at each window advance, riding the flush drain's
+existing fetches (the scalar fetch widens to [2], the packed-row fetch
+becomes one concatenated u32 transfer) — the ≤3-fetch budget is
+unchanged, gated in CI.
+
+The one coverage exception is counted, never silent: a single batch
+whose accepted rows span more than R windows *below* the close bound
+(a giant timestamp jump inside one batch) cannot give each of those
+already-closing windows its own slot; such rows are dropped from the
+sketch tier only (the exact stash still takes them) and counted in the
+`shed` lane, which rides the device counter block (CB_SKETCH_SHED).
+
+Closed blocks are host-side `WindowSketchBlock`s: pure-numpy queries
+(the shared xp ops math — ops/cms.row_slots, ops/hll.hll_estimate_np),
+mergeable across shards (register max / counter add / MJRTY combine),
+t-digest export via the histogram→centroid compressor, and the top-K
+inversion (candidates from the invertible sketch, estimates from the
+same window's count-min plane).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.cms import row_slots
+from ..ops.hll import clz32, hll_estimate_np
+from ..ops.histogram import LogHistSpec, loghist_bin
+from ..ops.tdigest import tdigest_compress, tdigest_quantile
+from ..ops.topk import topk_candidates, topk_select, topk_update
+
+_U32_MAX = np.uint32(0xFFFFFFFF)
+SENTINEL_WIN = _U32_MAX
+
+
+@dataclasses.dataclass(frozen=True)
+class SketchConfig:
+    """Shapes and error knobs of the per-window plane.
+
+    hll_precision=14 meets the <1% north-star cardinality bound
+    (~0.81% standard error); the defaults here are sized for the
+    many-windows-resident case — bench/sketchbench.py carries the
+    measured error/recall for the production settings."""
+
+    num_groups: int = 16  # service rows (HLL + histogram group axis)
+    hll_precision: int = 12
+    cms_depth: int = 4
+    cms_width: int = 1 << 12
+    hist: LogHistSpec = LogHistSpec(bins=256, vmin=1.0, gamma=1.04)
+    topk_rows: int = 2  # 0 disables the top-K lane
+    topk_cols: int = 1 << 9
+    pending: int = 16  # closed-block rows buffered between host drains
+
+    def __post_init__(self):
+        if self.cms_width & (self.cms_width - 1):
+            raise ValueError("cms_width must be a power of two")
+        if self.topk_rows and self.topk_cols & (self.topk_cols - 1):
+            raise ValueError("topk_cols must be a power of two")
+
+    @property
+    def hll_m(self) -> int:
+        return 1 << self.hll_precision
+
+    @property
+    def block_width(self) -> int:
+        """u32 words per packed closed-window block row: the n_updates
+        word, then hll / cms / hist / 5 top-K lanes, flattened in that
+        order (the layout contract between `_flatten_open`,
+        `WindowSketchBlock.from_row` and checkpoint v4)."""
+        g = self.num_groups
+        return (
+            1
+            + g * self.hll_m
+            + self.cms_depth * self.cms_width
+            + g * self.hist.bins
+            + 5 * self.topk_rows * self.topk_cols
+        )
+
+    def meta(self) -> dict:
+        """JSON-able form for checkpoint meta (v4)."""
+        return {
+            "num_groups": self.num_groups,
+            "hll_precision": self.hll_precision,
+            "cms_depth": self.cms_depth,
+            "cms_width": self.cms_width,
+            "hist_bins": self.hist.bins,
+            "hist_vmin": self.hist.vmin,
+            "hist_gamma": self.hist.gamma,
+            "topk_rows": self.topk_rows,
+            "topk_cols": self.topk_cols,
+            "pending": self.pending,
+        }
+
+    @classmethod
+    def from_meta(cls, m: dict) -> "SketchConfig":
+        return cls(
+            num_groups=m["num_groups"],
+            hll_precision=m["hll_precision"],
+            cms_depth=m["cms_depth"],
+            cms_width=m["cms_width"],
+            hist=LogHistSpec(
+                bins=m["hist_bins"], vmin=m["hist_vmin"], gamma=m["hist_gamma"]
+            ),
+            topk_rows=m["topk_rows"],
+            topk_cols=m["topk_cols"],
+            pending=m["pending"],
+        )
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class SketchState:
+    """Device-resident plane (leading mesh dim when sharded).
+
+    Open ring: `win[R]` (absolute window per slot, SENTINEL=empty) +
+    per-slot planes. Pending: flat packed closed blocks awaiting the
+    host drain. `rows`/`shed` are the cumulative counter-block lanes."""
+
+    win: jnp.ndarray  # [R] u32
+    count: jnp.ndarray  # [R] u32 rows folded per open slot
+    hll: jnp.ndarray  # [R, G, m] i32
+    cms: jnp.ndarray  # [R, D, W] i32
+    hist: jnp.ndarray  # [R, G, B] i32
+    tk_votes: jnp.ndarray  # [R, d, C] i32
+    tk_hi: jnp.ndarray  # [R, d, C] u32
+    tk_lo: jnp.ndarray  # [R, d, C] u32
+    tk_ida: jnp.ndarray  # [R, d, C] u32
+    tk_idb: jnp.ndarray  # [R, d, C] u32
+    pend: jnp.ndarray  # [P, WIDE] u32 packed closed blocks
+    pend_win: jnp.ndarray  # [P] u32
+    pend_n: jnp.ndarray  # scalar i32
+    rows: jnp.ndarray  # scalar u32 — CB_SKETCH_ROWS source
+    shed: jnp.ndarray  # scalar u32 — CB_SKETCH_SHED source
+
+    @property
+    def ring(self) -> int:
+        return self.win.shape[-1]
+
+
+def sketch_init(cfg: SketchConfig, ring: int) -> SketchState:
+    g, m = cfg.num_groups, cfg.hll_m
+    return SketchState(
+        win=jnp.full((ring,), SENTINEL_WIN, dtype=jnp.uint32),
+        count=jnp.zeros((ring,), jnp.uint32),
+        hll=jnp.zeros((ring, g, m), jnp.int32),
+        cms=jnp.zeros((ring, cfg.cms_depth, cfg.cms_width), jnp.int32),
+        hist=jnp.zeros((ring, g, cfg.hist.bins), jnp.int32),
+        tk_votes=jnp.zeros((ring, cfg.topk_rows, cfg.topk_cols), jnp.int32),
+        tk_hi=jnp.zeros((ring, cfg.topk_rows, cfg.topk_cols), jnp.uint32),
+        tk_lo=jnp.zeros((ring, cfg.topk_rows, cfg.topk_cols), jnp.uint32),
+        tk_ida=jnp.zeros((ring, cfg.topk_rows, cfg.topk_cols), jnp.uint32),
+        tk_idb=jnp.zeros((ring, cfg.topk_rows, cfg.topk_cols), jnp.uint32),
+        pend=jnp.zeros((cfg.pending, cfg.block_width), jnp.uint32),
+        pend_win=jnp.full((cfg.pending,), SENTINEL_WIN, dtype=jnp.uint32),
+        pend_n=jnp.zeros((), jnp.int32),
+        rows=jnp.zeros((), jnp.uint32),
+        shed=jnp.zeros((), jnp.uint32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# device side (traced helpers — callers fuse these into jitted steps)
+
+
+def _flatten_open(sk: SketchState) -> jnp.ndarray:
+    """[R, WIDE] u32 packed block rows, layout per SketchConfig.block_width."""
+    r = sk.ring
+    u = lambda x: x.reshape(r, -1).astype(jnp.uint32)
+    return jnp.concatenate(
+        [
+            sk.count[:, None].astype(jnp.uint32),
+            u(sk.hll),
+            u(sk.cms),
+            u(sk.hist),
+            u(sk.tk_votes),
+            u(sk.tk_hi),
+            u(sk.tk_lo),
+            u(sk.tk_ida),
+            u(sk.tk_idb),
+        ],
+        axis=1,
+    )
+
+
+def sketch_close(sk: SketchState, close_w) -> SketchState:
+    """Move every open slot with win < close_w into the pending buffer
+    and reset it. Pending overflow drops the block (never corrupts a
+    neighbour) and counts the lost rows into `shed`. Traced; the
+    flatten+scatter body runs under a `lax.cond` so the (frequent)
+    no-close batches skip the full-plane copy."""
+    from jax import lax
+
+    close_w = jnp.asarray(close_w, jnp.uint32)
+    r = sk.ring
+    p = sk.pend.shape[0]
+    close = (sk.win != jnp.uint32(SENTINEL_WIN)) & (sk.win < close_w)
+
+    def do_close(sk: SketchState) -> SketchState:
+        n_close = jnp.sum(close.astype(jnp.int32))
+        pos = sk.pend_n + jnp.cumsum(close.astype(jnp.int32)) - 1
+        pos = jnp.where(close, pos, p)  # non-closing rows → dropped
+        overflow = close & (pos >= p)
+        pos = jnp.minimum(pos, p)
+        blocks = _flatten_open(sk)
+        pend = sk.pend.at[pos].set(blocks, mode="drop")
+        pend_win = sk.pend_win.at[pos].set(sk.win, mode="drop")
+        shed = sk.shed + jnp.sum(jnp.where(overflow, sk.count, 0)).astype(
+            jnp.uint32
+        )
+
+        def rst(x, fill):
+            m = close.reshape((r,) + (1,) * (x.ndim - 1))
+            return jnp.where(m, jnp.asarray(fill, x.dtype), x)
+
+        return SketchState(
+            win=rst(sk.win, SENTINEL_WIN),
+            count=rst(sk.count, 0),
+            hll=rst(sk.hll, 0),
+            cms=rst(sk.cms, 0),
+            hist=rst(sk.hist, 0),
+            tk_votes=rst(sk.tk_votes, 0),
+            tk_hi=rst(sk.tk_hi, 0),
+            tk_lo=rst(sk.tk_lo, 0),
+            tk_ida=rst(sk.tk_ida, 0),
+            tk_idb=rst(sk.tk_idb, 0),
+            pend=pend,
+            pend_win=pend_win,
+            pend_n=jnp.minimum(sk.pend_n + n_close, p),
+            rows=sk.rows,
+            shed=shed,
+        )
+
+    return lax.cond(jnp.any(close), do_close, lambda s: s, sk)
+
+
+def _scatter_rows(
+    sk: SketchState,
+    spec: LogHistSpec,
+    mask,
+    window,
+    group,
+    client_hi,
+    client_lo,
+    key_hi,
+    key_lo,
+    weight,
+    rtt,
+    rtt_valid,
+    id_a,
+    id_b,
+) -> SketchState:
+    """Fold one phase's rows into their ring slots (claiming empties).
+    Callers guarantee the phase's window span is < R wide, so slots are
+    collision-free by construction (consecutive windows ≡ distinct
+    mod R)."""
+    r = sk.ring
+    g, m = sk.hll.shape[1], sk.hll.shape[2]
+    d_cms, w_cms = sk.cms.shape[1], sk.cms.shape[2]
+    window = jnp.asarray(window, jnp.uint32)
+    slot = (window % jnp.uint32(r)).astype(jnp.int32)
+    gslot = jnp.where(mask, slot, r)
+    gid = (jnp.asarray(group).astype(jnp.int32)) % g
+
+    win = sk.win.at[gslot].min(window, mode="drop")  # claim (SENTINEL > any)
+    count = sk.count.at[gslot].add(1, mode="drop")
+
+    reg = (jnp.asarray(client_lo, jnp.uint32) & jnp.uint32(m - 1)).astype(jnp.int32)
+    rho = (clz32(client_hi) + 1).astype(jnp.int32)
+    hll = sk.hll.at[gslot, gid, reg].max(rho, mode="drop")
+
+    w = jnp.where(mask, jnp.asarray(weight).astype(jnp.int32), 0)
+    rs = row_slots(key_hi, key_lo, d_cms, w_cms)  # [D, N] in [0, D*W)
+    flat = gslot[None, :].astype(jnp.int32) * (d_cms * w_cms) + rs
+    cms = (
+        sk.cms.reshape(-1)
+        .at[flat.reshape(-1)]
+        .add(jnp.broadcast_to(w[None, :], flat.shape).reshape(-1), mode="drop")
+        .reshape(r, d_cms, w_cms)
+    )
+
+    b = loghist_bin(rtt, spec)
+    hslot = jnp.where(mask & rtt_valid, slot, r)
+    hist = sk.hist.at[hslot, gid, b].add(1, mode="drop")
+
+    if sk.tk_votes.shape[1]:
+        tkv, tkh, tkl, tia, tib = topk_update(
+            (sk.tk_votes, sk.tk_hi, sk.tk_lo, sk.tk_ida, sk.tk_idb),
+            slot, key_hi, key_lo, id_a, id_b, weight, mask,
+        )
+    else:
+        tkv, tkh, tkl, tia, tib = (
+            sk.tk_votes, sk.tk_hi, sk.tk_lo, sk.tk_ida, sk.tk_idb,
+        )
+
+    return dataclasses.replace(
+        sk, win=win, count=count, hll=hll, cms=cms, hist=hist,
+        tk_votes=tkv, tk_hi=tkh, tk_lo=tkl, tk_ida=tia, tk_idb=tib,
+    )
+
+
+def sketch_plane_step(
+    sk: SketchState,
+    spec: LogHistSpec,
+    *,
+    window,
+    valid,
+    base_w,
+    close_w,
+    group,
+    client_hi,
+    client_lo,
+    key_hi,
+    key_lo,
+    weight,
+    rtt,
+    rtt_valid,
+    id_a,
+    id_b,
+) -> SketchState:
+    """One batch through the plane, in window order (traced):
+
+      1. closing-span rows (base_w ≤ window < close_w, within the live
+         ring span) fold into their still-open slots;
+      2. every slot with win < close_w closes into the pending buffer;
+      3. new-span rows (window ≥ close_w) claim the freed slots.
+
+    `base_w`/`close_w` are the pre-/post-batch open-span starts — the
+    single-chip fused step derives them on device from the same rule
+    the host replays; the sharded step receives them from the host
+    (which decides advances before dispatch).
+
+    The closing phase's collision-free span is anchored at the OLDEST
+    LIVE RING SLOT (or base_w when the ring is empty), not at base_w:
+    when a batch's own t_min jumps ahead of windows still open from
+    earlier batches, anchoring at base_w would let a closing row alias
+    mod R into an older occupied slot and silently merge two windows'
+    sketches. Rows in the mid-gap [anchor + R, close_w) — only
+    possible when one batch spans more than R windows below its close
+    bound — are counted into `shed` instead (module docstring)."""
+    r = sk.ring
+    window = jnp.asarray(window, jnp.uint32)
+    base_w = jnp.asarray(base_w, jnp.uint32)
+    close_w = jnp.asarray(close_w, jnp.uint32)
+    # oldest live slot bounds the alias-free span; SENTINEL (empty
+    # ring) never lowers the min below base_w
+    anchor = jnp.minimum(jnp.min(sk.win), base_w)
+    hi_a = jnp.minimum(close_w, anchor + jnp.uint32(r))
+    in_a = valid & (window >= base_w) & (window < hi_a)
+    in_c = valid & (window >= jnp.maximum(close_w, base_w))
+    shed = (
+        valid
+        & (window >= jnp.maximum(anchor + jnp.uint32(r), base_w))
+        & (window < close_w)
+    )
+
+    args = (group, client_hi, client_lo, key_hi, key_lo, weight, rtt,
+            rtt_valid, id_a, id_b)
+    sk = _scatter_rows(sk, spec, in_a, window, *args)
+    sk = sketch_close(sk, close_w)
+    sk = _scatter_rows(sk, spec, in_c, window, *args)
+    folded = (jnp.sum(in_a) + jnp.sum(in_c)).astype(jnp.uint32)
+    return dataclasses.replace(
+        sk,
+        rows=sk.rows + folded,
+        shed=sk.shed + jnp.sum(shed).astype(jnp.uint32),
+    )
+
+
+def _drain_impl(sk: SketchState, close_w):
+    sk = sketch_close(sk, close_w)
+    pend, pend_win, n = sk.pend, sk.pend_win, sk.pend_n
+    sk = dataclasses.replace(sk, pend_n=jnp.zeros((), jnp.int32))
+    return sk, pend, pend_win, n
+
+
+# donated: the returned state's pending cursor resets while the old
+# pend/pend_win buffers come back as outputs — XLA copies whichever
+# side cannot alias, so later in-step closes never race the (possibly
+# deferred) host fetch of the drained rows.
+sketch_drain = jax.jit(_drain_impl, donate_argnums=(0,))
+
+
+# ---------------------------------------------------------------------------
+# host side
+
+
+@dataclasses.dataclass
+class WindowSketchBlock:
+    """One closed window's fetched sketch summary (host numpy).
+
+    Mergeable across shards (`merge`). `distinct`/`estimate`/`topk`
+    are pure numpy over the fetched arrays (shared-xp ops math — no
+    device access); `tdigest`/`quantile` reuse the jitted centroid
+    compressor on tiny fixed-size arrays — a device dispatch, but off
+    the ingest fetch path and outside the host_fetch budget seam, so
+    sink/querier consumers pay it per closed window, never per batch.
+    Top-K lanes are kept as flat candidate arrays (bucket layout is
+    irrelevant once closed), which is also what makes the cross-shard
+    merge a plain concatenation."""
+
+    window: int
+    config: SketchConfig
+    n_updates: int
+    hll: np.ndarray  # [G, m] i32
+    cms: np.ndarray  # [D, W] i64 (i64: shard merges must not wrap)
+    hist: np.ndarray  # [G, B] i64
+    tk_hi: np.ndarray  # [n_cand] u32
+    tk_lo: np.ndarray
+    tk_ida: np.ndarray
+    tk_idb: np.ndarray
+    tk_votes: np.ndarray  # [n_cand] i64
+
+    @classmethod
+    def from_row(cls, row: np.ndarray, window: int, cfg: SketchConfig):
+        """Unpack one [WIDE] u32 packed block row (layout contract:
+        SketchConfig.block_width)."""
+        g, m = cfg.num_groups, cfg.hll_m
+        d, w = cfg.cms_depth, cfg.cms_width
+        b = cfg.hist.bins
+        tk = cfg.topk_rows * cfg.topk_cols
+        o = 0
+
+        def take(n):
+            nonlocal o
+            out = row[o : o + n]
+            o += n
+            return out
+
+        n_updates = int(take(1)[0])
+        hll = take(g * m).astype(np.int32).reshape(g, m)
+        cms = take(d * w).astype(np.int64).reshape(d, w)
+        hist = take(g * b).astype(np.int64).reshape(g, b)
+        votes = take(tk).astype(np.int32).astype(np.int64)
+        hi, lo, ida, idb = (take(tk) for _ in range(4))
+        keep = votes > 0
+        return cls(
+            window=int(window), config=cfg, n_updates=n_updates,
+            hll=hll, cms=cms, hist=hist,
+            tk_hi=hi[keep].astype(np.uint32), tk_lo=lo[keep].astype(np.uint32),
+            tk_ida=ida[keep].astype(np.uint32), tk_idb=idb[keep].astype(np.uint32),
+            tk_votes=votes[keep],
+        )
+
+    def merge(self, other: "WindowSketchBlock") -> "WindowSketchBlock":
+        """Cross-shard combine for the same window: register max,
+        counter add, candidate union (estimates re-derive from the
+        merged count-min at query time)."""
+        assert other.window == self.window, (self.window, other.window)
+        return WindowSketchBlock(
+            window=self.window,
+            config=self.config,
+            n_updates=self.n_updates + other.n_updates,
+            hll=np.maximum(self.hll, other.hll),
+            cms=self.cms + other.cms,
+            hist=self.hist + other.hist,
+            tk_hi=np.concatenate([self.tk_hi, other.tk_hi]),
+            tk_lo=np.concatenate([self.tk_lo, other.tk_lo]),
+            tk_ida=np.concatenate([self.tk_ida, other.tk_ida]),
+            tk_idb=np.concatenate([self.tk_idb, other.tk_idb]),
+            tk_votes=np.concatenate([self.tk_votes, other.tk_votes]),
+        )
+
+    # -- queries ---------------------------------------------------------
+    def distinct(self, group: int | None = None) -> float:
+        """HLL distinct-client estimate: one group, or the whole window
+        (register-max union over groups — NOT the per-group sum, which
+        would double-count clients seen by several services)."""
+        if group is None:
+            est = hll_estimate_np(self.hll.max(axis=0, keepdims=True))
+            return float(est[0])
+        return float(hll_estimate_np(self.hll[group : group + 1])[0])
+
+    def distinct_per_group(self) -> np.ndarray:
+        return hll_estimate_np(self.hll)
+
+    def estimate(self, key_hi, key_lo) -> np.ndarray:
+        """Count-min point estimates (overestimate-only) for flow keys."""
+        from ..ops.cms import cms_query_np
+
+        return cms_query_np(self.cms, key_hi, key_lo)
+
+    def tdigest(self, group: int | None = None, compression: int = 64):
+        """(means, weights) centroid export of the latency histogram —
+        the compact wire form (ops/tdigest.py). group None pools."""
+        hist = self.hist.sum(axis=0) if group is None else self.hist[group]
+        spec = self.config.hist
+        centers = spec.vmin * np.power(
+            spec.gamma, np.arange(spec.bins, dtype=np.float64) + 0.5
+        )
+        m, w = tdigest_compress(
+            jnp.asarray(centers, jnp.float32),
+            jnp.asarray(hist, jnp.float32),
+            compression=compression,
+        )
+        return np.asarray(m), np.asarray(w)
+
+    def quantile(self, q: float, group: int | None = None) -> float:
+        """Latency quantile through the t-digest export path."""
+        m, w = self.tdigest(group)
+        return float(
+            np.asarray(tdigest_quantile(jnp.asarray(m), jnp.asarray(w),
+                                        jnp.asarray([q], jnp.float32)))[0]
+        )
+
+    def topk(self, k: int) -> list[dict]:
+        """Invert the heavy-hitter sketch: candidates from the bucket
+        lanes, ranked by the same window's count-min estimate."""
+        if len(self.tk_hi) == 0:
+            return []
+        est = self.estimate(self.tk_hi, self.tk_lo)
+        hi, lo, ida, idb, est_k = topk_select(
+            self.tk_hi, self.tk_lo, self.tk_ida, self.tk_idb, est, k
+        )
+        return [
+            {
+                "key_hi": int(hi[i]), "key_lo": int(lo[i]),
+                "id_a": int(ida[i]), "id_b": int(idb[i]),
+                "estimate": int(est_k[i]),
+            }
+            for i in range(len(hi))
+        ]
+
+
+def hold_blocks(held: list, new_blocks, cap: int) -> int:
+    """THE closed-block retention policy, shared by RollupPipeline and
+    ShardedWindowManager: append, then drop-oldest beyond `cap` (the
+    same counted-drop stance as the device pending buffer). Returns the
+    number dropped — callers count it so an undrained
+    pop_closed_sketches consumer is loud, not a leak."""
+    held.extend(new_blocks)
+    overflow = len(held) - cap
+    if overflow > 0:
+        del held[:overflow]
+        return overflow
+    return 0
+
+
+def unpack_drained(rows: np.ndarray, wins: np.ndarray, cfg: SketchConfig):
+    """Fetched pending rows ([n, WIDE] u32 + [n] window ids) →
+    WindowSketchBlocks. Blocks that never saw a row (possible on the
+    sharded path, where a device closes a window its shard had no data
+    for) are dropped here."""
+    out = []
+    for i in range(rows.shape[0]):
+        blk = WindowSketchBlock.from_row(rows[i], int(wins[i]), cfg)
+        if blk.n_updates or len(blk.tk_hi):
+            out.append(blk)
+    return out
+
+
+__all__ = [
+    "SketchConfig",
+    "SketchState",
+    "WindowSketchBlock",
+    "sketch_init",
+    "sketch_close",
+    "sketch_drain",
+    "sketch_plane_step",
+    "unpack_drained",
+    "topk_candidates",
+]

@@ -45,6 +45,16 @@ import numpy as np
 
 from .. import chaos
 from ..datamodel.schema import FLOW_METER, TAG_SCHEMA, MeterSchema, TagSchema
+from ..ops.hashing import fingerprint64
+from .sketchplane import (
+    SketchConfig,
+    SketchState,
+    WindowSketchBlock,
+    sketch_drain,
+    sketch_init,
+    sketch_plane_step,
+    unpack_drained,
+)
 from ..utils.retry import (
     RetryPolicy,
     decorrelated_rng,
@@ -99,8 +109,12 @@ def host_fetch(x) -> np.ndarray:
 # (full-sort mode: whole live stash + ring; merge mode: only the acc
 # rows that folded, span-bounded on advances), so the merge-fold's row
 # savings are visible in deepflow_system without a new fetch.
+# v4 (ISSUE 8): + sketch_rows / sketch_shed — cumulative rows the
+# per-window sketch plane folded (the lane asserting sketch updates
+# actually ran in the fused dispatch) and rows the plane counted-shed
+# (mid-gap jumps, pending-buffer overflow); zero with the plane off.
 
-COUNTER_BLOCK_VERSION = 3
+COUNTER_BLOCK_VERSION = 4
 (
     CB_VERSION,  # constant COUNTER_BLOCK_VERSION
     CB_T_MAX,  # max valid timestamp (pre-gate)
@@ -114,12 +128,14 @@ COUNTER_BLOCK_VERSION = 3
     CB_RING_FILL,  # accumulator rows already occupied at dispatch
     CB_FEEDER_SHED,  # records shed by the feeder before this batch
     CB_FOLD_ROWS,  # rows the last fold's keyed sort touched
-) = range(12)
-CB_LEN = 12
+    CB_SKETCH_ROWS,  # cumulative rows folded into the sketch plane
+    CB_SKETCH_SHED,  # cumulative rows the sketch plane counted-shed
+) = range(14)
+CB_LEN = 14
 CB_FIELDS = (
     "version", "t_max", "t_min", "n_valid", "n_late", "prereduce_shed",
     "excess_word_hits", "stash_occupancy", "stash_evictions", "ring_fill",
-    "feeder_shed", "fold_rows",
+    "feeder_shed", "fold_rows", "sketch_rows", "sketch_shed",
 )
 
 
@@ -160,6 +176,8 @@ def batch_counter_block(
     ring_fill=None,
     feeder_shed=None,
     fold_rows=None,
+    sketch_rows=None,
+    sketch_shed=None,
 ):
     """`batch_stats` widened into the versioned counter block (traced).
 
@@ -186,7 +204,8 @@ def batch_counter_block(
             jnp.full((1,), COUNTER_BLOCK_VERSION, dtype=jnp.uint32),
             stats,
             jnp.stack([u32(excess_hits), occ, u32(stash_evictions),
-                       u32(ring_fill), u32(feeder_shed), u32(fold_rows)]),
+                       u32(ring_fill), u32(feeder_shed), u32(fold_rows),
+                       u32(sketch_rows), u32(sketch_shed)]),
         ]
     )
     return gated, window, block
@@ -208,6 +227,153 @@ def _raw_append_step(acc, offset, start_window, stash_valid, stash_evict,
     )
     acc = _append_impl(acc, window, key_hi, key_lo, tags, meters, gated, offset)
     return acc, block
+
+
+def sketch_tag_indices(tag_schema: TagSchema, meter_schema: MeterSchema) -> tuple:
+    """Static column-index tuple the sketch-enabled fused steps close
+    over: ip0/ip1 words (client + flow identity), server_port /
+    protocol / l3_epc_id1 (service grouping + id preview), and the
+    byte / rtt meter columns. Raises with the missing field name when a
+    schema cannot drive the plane (the plane is TAG_SCHEMA-shaped)."""
+    try:
+        t = tag_schema.index
+        m = meter_schema.index
+        return (
+            tuple(t(f"ip0_w{w}") for w in range(4))
+            + tuple(t(f"ip1_w{w}") for w in range(4))
+            + (t("server_port"), t("protocol"), t("l3_epc_id1"),
+               m("byte_tx"), m("rtt_sum"), m("rtt_count"))
+        )
+    except KeyError as e:
+        raise ValueError(
+            f"sketch plane needs tag/meter column {e} which this "
+            f"tag schema / {meter_schema.name} meter schema does not declare"
+        ) from e
+
+
+def sketch_plane_inputs(
+    num_groups: int, *, ip0, ip1, server_port, protocol, l3_epc_id1,
+    byte_w, rtt_sum, rtt_count,
+):
+    """Traced: derive the plane's per-row inputs from raw columns.
+
+    Shared by every sketch-enabled step (the raw-doc step here, the
+    pipeline's flow-row step, the sharded device step) so all entry
+    points sketch identical quantities: the HLL distinct entity is the
+    client address (ip0 words), the flow key is the 10-column
+    (ip0, ip1, server_port, protocol) fingerprint, the service group is
+    the (l3_epc_id1, server_port) hash, the heavy-hitter weight is
+    byte_tx, and the id preview is (ip0_w3, port<<16|proto)."""
+    u = lambda c: jnp.asarray(c, jnp.uint32)
+    ip0 = [u(c) for c in ip0]
+    ip1 = [u(c) for c in ip1]
+    port, proto, epc = u(server_port), u(protocol), u(l3_epc_id1)
+    client_hi, client_lo = fingerprint64(jnp.stack(ip0, axis=1))
+    key_hi, key_lo = fingerprint64(jnp.stack(ip0 + ip1 + [port, proto], axis=1))
+    group = (epc * jnp.uint32(131) + port) % jnp.uint32(num_groups)
+    rtt_cnt = rtt_count
+    rtt = rtt_sum / jnp.maximum(rtt_cnt, 1.0)
+    return dict(
+        group=group, client_hi=client_hi, client_lo=client_lo,
+        key_hi=key_hi, key_lo=key_lo, weight=byte_w,
+        rtt=rtt, rtt_valid=rtt_cnt > 0,
+        id_a=ip0[3],
+        id_b=(port << jnp.uint32(16)) | (proto & jnp.uint32(0xFFFF)),
+    )
+
+
+def sketch_inputs_from_matrix(tags, meters, num_groups: int, ix: tuple):
+    """`sketch_plane_inputs` over column-major [T, N] tags / [M, N]
+    meters via the static `ix` tuple (sketch_tag_indices)."""
+    (i00, i01, i02, i03, i10, i11, i12, i13,
+     ix_port, ix_proto, ix_epc, m_byte, m_rs, m_rc) = ix
+    return sketch_plane_inputs(
+        num_groups,
+        ip0=[tags[i] for i in (i00, i01, i02, i03)],
+        ip1=[tags[i] for i in (i10, i11, i12, i13)],
+        server_port=tags[ix_port], protocol=tags[ix_proto],
+        l3_epc_id1=tags[ix_epc],
+        byte_w=meters[m_byte], rtt_sum=meters[m_rs], rtt_count=meters[m_rc],
+    )
+
+
+def sketch_inputs_from_columns(tags: dict, meters, num_groups: int, meter_ix):
+    """`sketch_plane_inputs` over a raw flow-column dict + row-major
+    [N, M] meters (`meter_ix` = the meter schema's index fn) — the
+    shape every flow-row step holds (RollupPipeline, the sharded device
+    step, make_ingest_step's sketch append). One call site per step
+    keeps the 'all entry points sketch identical quantities' contract
+    a single function instead of three copies."""
+    return sketch_plane_inputs(
+        num_groups,
+        ip0=[tags[f"ip0_w{w}"] for w in range(4)],
+        ip1=[tags[f"ip1_w{w}"] for w in range(4)],
+        server_port=tags["server_port"], protocol=tags["protocol"],
+        l3_epc_id1=tags["l3_epc_id1"],
+        byte_w=meters[:, meter_ix("byte_tx")],
+        rtt_sum=meters[:, meter_ix("rtt_sum")],
+        rtt_count=meters[:, meter_ix("rtt_count")],
+    )
+
+
+def sketch_span_bounds(start_window, ts, valid, *, interval: int, delay: int):
+    """Traced: (base_w, close_w) for the plane — the pre-/post-batch
+    open-span starts, replicating the host rules exactly: close_w is
+    `_process_block`'s advance target (max(gate, (t_max-delay)//i), the
+    same value `_stats_ring_push` maintains on device) and base_w is
+    the opening rule's max(gate, min(t_min, t_max-delay)//i)."""
+    has = jnp.any(valid)
+    t_max = jnp.max(jnp.where(valid, ts, jnp.uint32(0)))
+    t_min = jnp.min(jnp.where(valid, ts, _U32_MAX))
+    t_adj = jnp.where(t_max > jnp.uint32(delay), t_max - jnp.uint32(delay),
+                      jnp.uint32(0))
+    close_w = jnp.maximum(start_window, t_adj // jnp.uint32(interval))
+    base_w = jnp.maximum(
+        start_window, jnp.minimum(t_min // jnp.uint32(interval), close_w)
+    )
+    close_w = jnp.where(has, close_w, start_window)
+    base_w = jnp.where(has, base_w, start_window)
+    return base_w, close_w
+
+
+@partial(
+    jax.jit,
+    donate_argnums=(0, 7),
+    static_argnames=("interval", "delay", "ix", "spec"),
+)
+def _raw_append_step_sk(acc, offset, start_window, stash_valid, stash_evict,
+                        feeder_shed, fold_rows, sk, timestamp, key_hi, key_lo,
+                        tags, meters, valid, *, interval, delay, ix, spec):
+    """`_raw_append_step` with the per-window sketch plane fused in
+    (ISSUE 8): the SAME jit dispatch updates HLL/CMS/histogram/top-K
+    slots for every accepted row — key identity is the caller's doc
+    fingerprint (key_hi/key_lo), client identity re-derives from the
+    ip0 tag words — and the counter block grows the v4 sketch lanes.
+    Zero new fetches: the plane's closed blocks leave the device via
+    the advance drain, not here."""
+    ts = jnp.asarray(timestamp, dtype=jnp.uint32)
+    valid_b = jnp.asarray(valid)
+    base_w, close_w = sketch_span_bounds(
+        start_window, ts, valid_b, interval=interval, delay=delay
+    )
+    inp = sketch_inputs_from_matrix(tags, meters, sk.hll.shape[1], ix)
+    # the caller's fingerprint IS the flow key — sketch estimates then
+    # join exactly against flushed exact rows
+    inp["key_hi"] = jnp.asarray(key_hi, jnp.uint32)
+    inp["key_lo"] = jnp.asarray(key_lo, jnp.uint32)
+    sk = sketch_plane_step(
+        sk, spec,
+        window=ts // jnp.uint32(interval), valid=valid_b,
+        base_w=base_w, close_w=close_w, **inp,
+    )
+    gated, window, block = batch_counter_block(
+        ts, valid_b, start_window, interval,
+        stash_valid=stash_valid, stash_evictions=stash_evict, ring_fill=offset,
+        feeder_shed=feeder_shed, fold_rows=fold_rows,
+        sketch_rows=sk.rows, sketch_shed=sk.shed,
+    )
+    acc = _append_impl(acc, window, key_hi, key_lo, tags, meters, gated, offset)
+    return acc, block, sk
 
 
 @partial(jax.jit, donate_argnums=(0,), static_argnames=("interval", "delay"))
@@ -287,6 +453,14 @@ class WindowConfig:
     # eviction candidates until folded), never shed more. Default stays
     # "full" until on-chip numbers land (PERF.md §15).
     fold_mode: str = "full"
+    # Per-window device sketch plane (ISSUE 8): HLL / count-min /
+    # latency-histogram / invertible top-K state per open window,
+    # updated inside the SAME fused dispatch as the exact append and
+    # drained as packed blocks riding the advance's existing fetches —
+    # distinct-count / quantile / heavy-hitter answers stop depending
+    # on exact-stash capacity (sheds degrade detail, not coverage).
+    # None = off (today's exact-only behavior, zero cost).
+    sketch: SketchConfig | None = None
 
     def __post_init__(self):
         check_fold_mode(self.fold_mode)
@@ -312,6 +486,11 @@ class FlushedWindow:
     tags: np.ndarray  # [n, T] u32
     meters: np.ndarray  # [n, M] f32
     count: int
+    # the window's approximate summary (ISSUE 8) — present when the
+    # sketch plane is enabled; count == 0 with a block attached means
+    # the exact stash shed every row of this window but the sketch tier
+    # still covered it (degradation of detail, not of coverage)
+    sketches: WindowSketchBlock | None = None
 
 
 class WindowManager:
@@ -357,6 +536,18 @@ class WindowManager:
         # merge mode drains through the compacting range flush so the
         # stash keeps the canonical layout the rank-merge requires
         self._flush_compact = config.fold_mode == "merge"
+        # per-window sketch plane (ISSUE 8): device state + the static
+        # column-index tuple the fused step closes over; CB-lane mirrors
+        self.sk: SketchState | None = None
+        self._sketch_ix: tuple | None = None
+        self.sketch_rows = 0
+        self.sketch_shed = 0
+        # closed blocks fetched but whose window has not flushed yet
+        # (K-ring replay can drain blocks ahead of their flush range)
+        self._sketch_blocks: dict[int, WindowSketchBlock] = {}
+        if config.sketch is not None:
+            self._sketch_ix = sketch_tag_indices(tag_schema, meter_schema)
+            self.sk = sketch_init(config.sketch, config.ring)
         self.n_advances = 0
         # device↔host transfer accounting (the host_fetch seam)
         self.host_fetches = 0
@@ -410,15 +601,78 @@ class WindowManager:
         return arr
 
     # -- device→host drains ---------------------------------------------
-    def _drain_flush(self, packed, total_dev) -> list[FlushedWindow]:
+    def _drain_flush(self, entry) -> list[FlushedWindow]:
         """Fetch ONE packed flush result and split it into windows.
 
-        Two transfers regardless of row/window count: the scalar row
-        count, then only the live prefix of the packed matrix."""
-        total = int(self._fetch(total_dev))
-        if total == 0:
-            return []
-        rows = self._fetch(packed[:total])
+        Two transfers regardless of row/window count — with the sketch
+        plane enabled the SAME two transfers also carry the closed
+        sketch blocks: the scalar fetch widens to [row count, pending
+        block count] and the row fetch becomes one concatenated u32
+        transfer (flush rows ‖ packed blocks ‖ block window ids), so
+        the ≤3-fetch budget is untouched (tests/test_perf_gate.py)."""
+        if len(entry) == 2:  # exact-only path
+            packed, total_dev = entry
+            total = int(self._fetch(total_dev))
+            if total == 0:
+                return []
+            rows = self._fetch(packed[:total])
+            return self._split_flushed(rows, total)
+
+        packed, total_dev, pend, pend_win, pend_n, lo, hi = entry
+        scal = self._fetch(
+            jnp.stack([jnp.asarray(total_dev, jnp.int32),
+                       jnp.asarray(pend_n, jnp.int32)])
+        )
+        total, n_blocks = int(scal[0]), int(scal[1])
+        flushed = []
+        if total or n_blocks:
+            row_cols = packed.shape[1]
+            wide = pend.shape[1]
+            flat = self._fetch(
+                jnp.concatenate([
+                    packed[:total].reshape(-1),
+                    pend[:n_blocks].reshape(-1),
+                    pend_win[:n_blocks],
+                ])
+            )
+            rows = flat[: total * row_cols].reshape(total, row_cols)
+            block_rows = flat[
+                total * row_cols : total * row_cols + n_blocks * wide
+            ].reshape(n_blocks, wide)
+            wins = flat[total * row_cols + n_blocks * wide :]
+            for blk in unpack_drained(block_rows, wins, self.config.sketch):
+                have = self._sketch_blocks.get(blk.window)
+                self._sketch_blocks[blk.window] = (
+                    blk if have is None else have.merge(blk)
+                )
+            if total:
+                flushed = self._split_flushed(rows, total)
+        # marry blocks to this drain's window range; blocks whose exact
+        # rows were all shed become sketch-only windows (count == 0)
+        for f in flushed:
+            f.sketches = self._sketch_blocks.pop(f.window_idx, None)
+        exact_wins = {f.window_idx for f in flushed}
+        for w in sorted(self._sketch_blocks):
+            if lo <= w < hi and w not in exact_wins:
+                blk = self._sketch_blocks.pop(w)
+                flushed.append(
+                    FlushedWindow(
+                        window_idx=w,
+                        start_time=w * self.config.interval,
+                        key_hi=np.zeros((0,), np.uint32),
+                        key_lo=np.zeros((0,), np.uint32),
+                        tags=np.zeros((0, self.tag_schema.num_fields), np.uint32),
+                        meters=np.zeros(
+                            (0, self.meter_schema.num_fields), np.float32
+                        ),
+                        count=0,
+                        sketches=blk,
+                    )
+                )
+        flushed.sort(key=lambda f: f.window_idx)
+        return flushed
+
+    def _split_flushed(self, rows: np.ndarray, total: int) -> list[FlushedWindow]:
         win, key_hi, key_lo, tags, meters = unpack_flush_rows(
             rows, self.tag_schema.num_fields
         )
@@ -445,8 +699,8 @@ class WindowManager:
             return []
         with self.tracer.span(SPAN_FLUSH_DRAIN):
             out = []
-            for packed, total_dev in ready:
-                out.extend(self._drain_flush(packed, total_dev))
+            for entry in ready:
+                out.extend(self._drain_flush(entry))
             return out
 
     def _fold(self):
@@ -536,6 +790,9 @@ class WindowManager:
             self.device_ring_fill = vec[CB_RING_FILL]
             self.feeder_shed += vec[CB_FEEDER_SHED]
             self.fold_rows = vec[CB_FOLD_ROWS]
+            # cumulative device scalars — mirror, don't accumulate
+            self.sketch_rows = vec[CB_SKETCH_ROWS]
+            self.sketch_shed = vec[CB_SKETCH_SHED]
         elif len(vec) == 5:  # legacy [t_max, t_min, n_valid, n_late, aux]
             t_max, t_min, n_valid, n_late, aux = vec
         else:
@@ -581,9 +838,22 @@ class WindowManager:
                     np.uint32(new_start),
                     compact=self._flush_compact,
                 )
-                self._pending_flush.append((packed, total))
+                self._pending_flush.append(
+                    self._with_sketch_entry(
+                        packed, total, self.start_window, new_start
+                    )
+                )
                 self.start_window = new_start
                 self.n_advances += 1
+
+    def _with_sketch_entry(self, packed, total, lo: int, hi: int):
+        """Build one _pending_flush entry: the exact flush pair alone,
+        or widened with the sketch plane's pending-drain handles (one
+        extra DISPATCH, zero extra fetches — _drain_flush bundles)."""
+        if self.sk is None:
+            return (packed, total)
+        self.sk, pend, pend_win, pend_n = sketch_drain(self.sk, np.uint32(hi))
+        return (packed, total, pend, pend_win, pend_n, lo, hi)
 
     # -- ingest ----------------------------------------------------------
     def ingest(
@@ -607,18 +877,32 @@ class WindowManager:
         rows = int(timestamp.shape[0])
         interval = self.config.interval
 
-        def dispatch(acc, offset, start_window):
-            # read the stash AT DISPATCH time (ingest_step may fold
-            # first) so the block's occupancy/fold_rows lanes see the
-            # post-fold plane; all lanes are device-resident — zero
-            # transfer
-            st = self.state
-            return _raw_append_step(
-                acc, offset, start_window, st.valid, st.dropped_overflow,
-                jnp.uint32(feeder_shed), self._fold_rows_dev,
-                timestamp, key_hi, key_lo, tags, meters, valid,
-                interval=interval,
-            )
+        if self.sk is not None:
+            def dispatch(acc, offset, start_window):
+                # sketch-enabled twin: the plane state reads/donates at
+                # dispatch time like the stash lanes; the step returns
+                # the updated plane as a third output
+                st = self.state
+                return _raw_append_step_sk(
+                    acc, offset, start_window, st.valid, st.dropped_overflow,
+                    jnp.uint32(feeder_shed), self._fold_rows_dev, self.sk,
+                    timestamp, key_hi, key_lo, tags, meters, valid,
+                    interval=interval, delay=self.config.delay,
+                    ix=self._sketch_ix, spec=self.config.sketch.hist,
+                )
+        else:
+            def dispatch(acc, offset, start_window):
+                # read the stash AT DISPATCH time (ingest_step may fold
+                # first) so the block's occupancy/fold_rows lanes see the
+                # post-fold plane; all lanes are device-resident — zero
+                # transfer
+                st = self.state
+                return _raw_append_step(
+                    acc, offset, start_window, st.valid, st.dropped_overflow,
+                    jnp.uint32(feeder_shed), self._fold_rows_dev,
+                    timestamp, key_hi, key_lo, tags, meters, valid,
+                    interval=interval,
+                )
 
         return self.ingest_step(dispatch, rows)
 
@@ -689,12 +973,16 @@ class WindowManager:
 
         with self.tracer.span(SPAN_INGEST_DISPATCH):
             # admission-time-only classification: the step donates its
-            # accumulator, so a mid-flight UNAVAILABLE/ABORTED must NOT
-            # retry against the consumed buffer
-            self.acc, stats_dev = retry_call(
+            # accumulator (and sketch plane), so a mid-flight
+            # UNAVAILABLE/ABORTED must NOT retry against consumed buffers
+            out = retry_call(
                 dispatch_once, self.retry_policy, on_retry=on_retry,
                 rng=self._retry_rng, classify=is_dispatch_transient,
             )
+            if self.sk is not None:
+                self.acc, stats_dev, self.sk = out
+            else:
+                self.acc, stats_dev = out
         self.fill += rows
 
         if K > 1:
@@ -757,7 +1045,9 @@ class WindowManager:
         self.state, packed, total = stash_flush_range(
             self.state, np.uint32(0), _U32_MAX, compact=self._flush_compact
         )
-        self._pending_flush.append((packed, total))
+        self._pending_flush.append(
+            self._with_sketch_entry(packed, total, 0, int(_U32_MAX))
+        )
         flushed += self._settle_ready()
         for f in flushed:
             self.start_window = max(self.start_window, f.window_idx + 1)
@@ -806,6 +1096,12 @@ class WindowManager:
             # the device by up to stats_ring_pending batches
             "feeder_shed": self.feeder_shed,
             "stats_ring_pending": self._ring_count,
+            # sketch-plane lanes (ISSUE 8, CB v4): cumulative rows the
+            # plane folded / counted-shed as of the last fetched block —
+            # sketch_rows > 0 is the CI assertion that sketch updates
+            # actually ran inside the fused dispatch
+            "sketch_rows": self.sketch_rows,
+            "sketch_shed": self.sketch_shed,
         }
 
     @property

@@ -167,3 +167,103 @@ def system_sink(store):
             store.insert(DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE, cols)
 
     return sink
+
+
+# ---------------------------------------------------------------------------
+# Sketch tier → deepflow_system (ISSUE 8). Closed-window sketch blocks
+# (aggregator/sketchplane.WindowSketchBlock) land in the SAME
+# prometheus-samples row shape, so distinct-count / quantile /
+# heavy-hitter answers for a closed window are queryable through BOTH
+# engines without flushing exact rows:
+#   SQL:    SELECT value FROM deepflow_system.deepflow_system
+#           WHERE metric = 'deepflow_sketch_distinct' AND time = <w>
+#   PromQL: deepflow_sketch_distinct{service="3"}
+#           topk(5, deepflow_sketch_top_bytes)  (querier/promql.py)
+
+SKETCH_METRIC_DISTINCT = "deepflow_sketch_distinct"
+SKETCH_METRIC_QUANTILE = "deepflow_sketch_rtt_quantile"
+SKETCH_METRIC_TOPK = "deepflow_sketch_top_bytes"
+
+
+def sketch_block_rows(
+    block, interval: int, *, quantiles=(0.5, 0.95, 0.99), topk: int = 16
+) -> list[tuple[int, str, dict, float]]:
+    """One closed-window block → (time, metric, labels, value) rows.
+
+    Per-service distinct counts (services whose HLL row saw data) and
+    rtt quantiles, the window-level distinct count, and the inverted
+    top-K heavy flows (one series per recovered key: the `key` label is
+    the flow fingerprint, `ip`/`svc` carry the id-preview words)."""
+    import jax.numpy as jnp
+
+    from ..ops.tdigest import tdigest_quantile
+
+    t = block.window * interval
+    rows: list[tuple[int, str, dict, float]] = []
+    rows.append((t, SKETCH_METRIC_DISTINCT, {"service": "all"}, block.distinct()))
+    per_group = block.distinct_per_group()
+    active = np.nonzero(block.hll.max(axis=1) > 0)[0]
+    for g in active:
+        g = int(g)
+        rows.append(
+            (t, SKETCH_METRIC_DISTINCT, {"service": str(g)}, float(per_group[g]))
+        )
+        # quantile rows only for services with actual latency samples —
+        # an all-zero histogram (e.g. UDP-only traffic, rtt_count=0)
+        # must produce NO series, not a fake 0 ms series. One t-digest
+        # compression serves every requested quantile.
+        if block.hist[g].sum() > 0:
+            m, w = block.tdigest(g)
+            qv = np.asarray(tdigest_quantile(
+                jnp.asarray(m), jnp.asarray(w),
+                jnp.asarray(list(quantiles), jnp.float32),
+            ))
+            for q, v in zip(quantiles, qv):
+                rows.append(
+                    (t, SKETCH_METRIC_QUANTILE,
+                     {"service": str(g), "q": str(q)}, float(v))
+                )
+    for rank, hh in enumerate(block.topk(topk)):
+        rows.append(
+            (
+                t, SKETCH_METRIC_TOPK,
+                {
+                    "key": f"{hh['key_hi']:08x}{hh['key_lo']:08x}",
+                    "rank": str(rank),
+                    "ip": str(hh["id_a"]),
+                    "svc": str(hh["id_b"]),
+                },
+                float(hh["estimate"]),
+            )
+        )
+    return rows
+
+
+def sketch_rows_to_columns(rows) -> dict[str, np.ndarray]:
+    from .formats import pack_tags
+
+    return {
+        "time": np.asarray([r[0] for r in rows], np.uint32),
+        "metric": np.asarray([r[1] for r in rows], dtype=object),
+        "labels": np.asarray([pack_tags(r[2]) for r in rows], dtype=object),
+        "value": np.asarray([r[3] for r in rows], np.float64),
+    }
+
+
+def sketch_system_sink(store, interval: int = 1, **row_kw):
+    """→ a callable(blocks) writing closed-window sketch answers into
+    deepflow_system — wire a pipeline's `pop_closed_sketches()` (or a
+    ShardedWindowManager's) into it after every ingest/drain."""
+    ensure_system_table(store)
+
+    def sink(blocks) -> None:
+        rows = []
+        for b in blocks:
+            rows.extend(sketch_block_rows(b, interval, **row_kw))
+        if rows:
+            store.insert(
+                DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE,
+                sketch_rows_to_columns(rows),
+            )
+
+    return sink

@@ -20,17 +20,27 @@ def cms_init(depth: int = 4, width: int = 1 << 16) -> jnp.ndarray:
     return jnp.zeros((depth, width), dtype=jnp.int32)
 
 
-def _row_slots(hash_hi, hash_lo, depth: int, width: int):
-    """[depth, N] flattened slot indices."""
-    d = jnp.arange(depth, dtype=jnp.uint32)[:, None]
-    h = hash_hi[None, :] + d * hash_lo[None, :]  # wrapping u32
+def row_slots(hash_hi, hash_lo, depth: int, width: int, xp=jnp):
+    """[depth, N] flattened slot indices.
+
+    `xp` follows the ops/hashing convention: jnp for device updates, np
+    for host-side point queries over fetched sketch blocks
+    (aggregator/sketchplane.WindowSketchBlock) — one implementation, so
+    the two sides cannot drift."""
+    d = xp.arange(depth, dtype=xp.uint32)[:, None]
+    h = xp.asarray(hash_hi, dtype=xp.uint32)[None, :] + d * xp.asarray(
+        hash_lo, dtype=xp.uint32
+    )[None, :]  # wrapping u32
     # avalanche the row mix so consecutive d don't alias
-    h = h ^ (h >> jnp.uint32(15))
-    h = h * jnp.uint32(0x2C1B3C6D)
-    h = h ^ (h >> jnp.uint32(12))
-    col = (h & jnp.uint32(width - 1)).astype(jnp.int32)
-    row_base = (jnp.arange(depth, dtype=jnp.int32) * width)[:, None]
+    h = h ^ (h >> xp.uint32(15))
+    h = h * xp.uint32(0x2C1B3C6D)
+    h = h ^ (h >> xp.uint32(12))
+    col = (h & xp.uint32(width - 1)).astype(xp.int32)
+    row_base = (xp.arange(depth, dtype=xp.int32) * width)[:, None]
     return row_base + col
+
+
+_row_slots = row_slots
 
 
 @partial(jax.jit, donate_argnums=(0,))
@@ -55,3 +65,15 @@ def cms_query(state: jnp.ndarray, hash_hi, hash_lo) -> jnp.ndarray:
 
 def cms_merge(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return a + b
+
+
+def cms_query_np(state, hash_hi, hash_lo):
+    """Host-side point query over a fetched counter plane (np in/out) —
+    same row math as `cms_query` via the shared `row_slots`."""
+    import numpy as np
+
+    state = np.asarray(state)
+    depth, width = state.shape
+    slots = row_slots(hash_hi, hash_lo, depth, width, xp=np)
+    vals = state.reshape(-1)[slots.reshape(-1)].reshape(depth, -1)
+    return vals.min(axis=0)

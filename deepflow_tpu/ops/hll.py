@@ -73,3 +73,23 @@ def hll_merge(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Register-wise max — associative/commutative, safe under psum-style
     tree merges (`lax.pmax` over a mesh axis does this in-network)."""
     return jnp.maximum(a, b)
+
+
+def hll_estimate_np(state) -> "np.ndarray":
+    """Host-side estimate over a fetched register plane (np in/out) —
+    the same classic-HLL math as `hll_estimate`, for query paths that
+    must not touch the device (sketchplane.WindowSketchBlock)."""
+    import numpy as np
+
+    state = np.asarray(state)
+    m = state.shape[1]
+    alpha = 0.7213 / (1.0 + 1.079 / m)
+    raw = alpha * m * m / np.sum(np.exp2(-state.astype(np.float64)), axis=1)
+    zeros = np.sum(state == 0, axis=1).astype(np.float64)
+    with np.errstate(divide="ignore"):
+        linear = m * np.log(m / np.maximum(zeros, 1.0))
+    use_linear = (raw <= 2.5 * m) & (zeros > 0)
+    return np.where(use_linear, linear, raw)
+
+
+clz32 = _clz32  # per-register rank helper, shared with the window plane

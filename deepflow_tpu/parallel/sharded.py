@@ -37,6 +37,16 @@ from .. import chaos
 from ..aggregator import window as window_mod
 from ..aggregator.fanout import FANOUT_LANES, FanoutConfig
 from ..aggregator.pipeline import make_ingest_step
+from ..aggregator.sketchplane import (
+    SketchConfig,
+    SketchState,
+    _drain_impl as _sketch_drain_impl,
+    hold_blocks,
+    sketch_init,
+    sketch_plane_step,
+    unpack_drained,
+)
+from ..aggregator.window import sketch_inputs_from_columns
 from ..utils.retry import (
     RetryPolicy,
     decorrelated_rng,
@@ -62,20 +72,30 @@ from ..aggregator.stash import (
     stash_init,
 )
 from ..datamodel.schema import FLOW_METER, TAG_SCHEMA
-from ..ops.hashing import fingerprint64
-from ..ops.histogram import LogHistSpec, loghist_update
-from ..ops.hll import hll_update
-from ..ops.cms import cms_update
+from ..ops.histogram import LogHistSpec
+
+
+# ISSUE 8 unification: the span-global SketchPlanes (hll/cms/hist reset
+# at every close) became the PER-WINDOW plane shared with the
+# single-chip path — aggregator/sketchplane.SketchState, one ring slot
+# per open window plus a pending buffer of closed packed blocks. The
+# old attribute names (.hll/.cms/.hist) survive on the new state (with
+# a leading [R] ring dim), and `window_close` still returns the merged
+# cross-mesh view, so existing consumers keep working; per-window
+# blocks additionally drain through `ShardedWindowManager` at every
+# advance (host-merged across devices — exactly the drain pattern the
+# exact rows already use).
+SketchPlanes = SketchState
 
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
-class SketchPlanes:
-    """Per-device sketch state (leading mesh dim when sharded)."""
+class MergedSketchView:
+    """Cross-mesh merged view of the open ring (window_close output)."""
 
-    hll: jnp.ndarray  # [G, m] i32 — distinct clients per service
-    cms: jnp.ndarray  # [depth, width] i32 — heavy-hitter counts
-    hist: jnp.ndarray  # [G, B] i32 — latency log-histogram per service
+    hll: jnp.ndarray  # [G, m] i32
+    cms: jnp.ndarray  # [depth, width] i32
+    hist: jnp.ndarray  # [G, B] i32
 
 
 @dataclasses.dataclass(frozen=True)
@@ -88,6 +108,15 @@ class ShardedConfig:
     cms_depth: int = 4
     cms_width: int = 1 << 14
     hist: LogHistSpec = LogHistSpec(bins=512, vmin=1.0, gamma=1.04)
+    # per-window sketch ring (ISSUE 8): slots for simultaneously-open
+    # windows — must cover delay//interval + 2 of the window manager
+    # driving this pipeline (validated there, loudly); the default
+    # covers delay ≤ 6·interval. Top-K lane shapes and the closed-block
+    # pending buffer follow sketchplane.SketchConfig
+    sketch_ring: int = 8
+    topk_rows: int = 2
+    topk_cols: int = 1 << 9
+    sketch_pending: int = 16
     # batches accumulated per device between sort+reduce folds
     # (same amortization as WindowConfig.accum_batches)
     accum_batches: int = 8
@@ -104,6 +133,18 @@ class ShardedConfig:
     def __post_init__(self):
         check_fold_mode(self.fold_mode)
 
+    def sketch_config(self) -> SketchConfig:
+        return SketchConfig(
+            num_groups=self.num_services,
+            hll_precision=self.hll_precision,
+            cms_depth=self.cms_depth,
+            cms_width=self.cms_width,
+            hist=self.hist,
+            topk_rows=self.topk_rows,
+            topk_cols=self.topk_cols,
+            pending=self.sketch_pending,
+        )
+
 
 class ShardedPipeline:
     """shard_map'd ingest step + collective window-close merges."""
@@ -119,6 +160,7 @@ class ShardedPipeline:
         self._close = self._build_window_close()
         self._flush = self._build_flush()
         self._flush_range = self._build_flush_range()
+        self._sketch_drain = self._build_sketch_drain()
 
     # -- state ----------------------------------------------------------
     def init_state(self) -> tuple[StashState, SketchPlanes]:
@@ -129,10 +171,8 @@ class ShardedPipeline:
             return jnp.broadcast_to(x[None], (d,) + x.shape)
 
         stash = jax.tree.map(dev_axis, stash_init(c.capacity_per_device, TAG_SCHEMA, FLOW_METER))
-        sketches = SketchPlanes(
-            hll=jnp.zeros((d, c.num_services, 1 << c.hll_precision), jnp.int32),
-            cms=jnp.zeros((d, c.cms_depth, c.cms_width), jnp.int32),
-            hist=jnp.zeros((d, c.num_services, c.hist.bins), jnp.int32),
+        sketches = jax.tree.map(
+            dev_axis, sketch_init(c.sketch_config(), c.sketch_ring)
         )
         spec = NamedSharding(self.mesh, P(self.axes))
         stash = jax.tree.map(lambda x: jax.device_put(x, spec), stash)
@@ -160,7 +200,8 @@ class ShardedPipeline:
         t_idx = TAG_SCHEMA.index
         m_idx = FLOW_METER.index
 
-        def device_step(stash, acc, offset, sk, tag_mat, meters, valid):
+        def device_step(stash, acc, offset, sk, tag_mat, meters, valid,
+                        start_window, close_below):
             # block shapes: stash [1, S, ...], tag_mat [1, T, n] — one
             # packed matrix, not a dict of columns: every pytree leaf is
             # a separate host→device upload through the accelerator
@@ -168,44 +209,41 @@ class ShardedPipeline:
             # step cost seconds; packed, the step ships 3 arrays total
             stash1 = jax.tree.map(lambda x: x[0], stash)
             acc1 = jax.tree.map(lambda x: x[0], acc)
+            sk1 = jax.tree.map(lambda x: x[0], sk)
             tags1 = {k: tag_mat[0, i] for i, k in enumerate(self._tag_names)}
             meters1, valid1 = meters[0], valid[0]
 
             new_stash, new_acc = base_append(stash1, acc1, offset, tags1, meters1, valid1)
 
-            # Sketch updates from the raw flow batch (service-level keys).
-            # service id: enrichment hook — until the PlatformInfoTable
-            # lands, derive from (dst epc, server port).
-            service = (
-                (tags1["l3_epc_id1"] * jnp.uint32(131) + tags1["server_port"])
-                % jnp.uint32(c.num_services)
-            ).astype(jnp.int32)
-            client_hi, client_lo = fingerprint64(
-                jnp.stack([tags1[f"ip0_w{w}"] for w in range(4)], axis=1)
+            # Per-window sketch plane (ISSUE 8) from the raw flow shard.
+            # The sharded window protocol is HOST-driven (the manager
+            # decides advances from host-visible timestamps BEFORE
+            # dispatch), so the open/close span bounds arrive as
+            # replicated scalars instead of being derived in-step —
+            # every device closes the same windows at the same batch,
+            # even when its own shard never saw the advancing timestamp.
+            ts = jnp.asarray(tags1["timestamp"], jnp.uint32)
+            inp = sketch_inputs_from_columns(
+                tags1, meters1, sk1.hll.shape[1], m_idx
             )
-            hll = hll_update(sk.hll[0], service, client_hi, client_lo, valid1)
-            svc_hi, svc_lo = fingerprint64(
-                jnp.stack([tags1["l3_epc_id1"], tags1["server_port"]], axis=1)
-            )
-            byte_w = meters1[:, m_idx("byte_tx")].astype(jnp.int32)
-            cms = cms_update(sk.cms[0], svc_hi, svc_lo, byte_w, valid1)
-            rtt = meters1[:, m_idx("rtt_sum")] / jnp.maximum(meters1[:, m_idx("rtt_count")], 1.0)
-            hist = loghist_update(
-                sk.hist[0], service, rtt, valid1 & (meters1[:, m_idx("rtt_count")] > 0), c.hist
+            new_sk = sketch_plane_step(
+                sk1, c.hist,
+                window=ts // jnp.uint32(c.interval), valid=valid1,
+                base_w=start_window, close_w=close_below, **inp,
             )
 
             expand = lambda x: x[None]
             return (
                 jax.tree.map(expand, new_stash),
                 jax.tree.map(expand, new_acc),
-                SketchPlanes(hll=hll[None], cms=cms[None], hist=hist[None]),
+                jax.tree.map(expand, new_sk),
             )
 
         pspec = P(self.axes)
         mapped = shard_map(
             device_step,
             mesh=self.mesh,
-            in_specs=(pspec, pspec, P(), pspec, pspec, pspec, pspec),
+            in_specs=(pspec, pspec, P(), pspec, pspec, pspec, pspec, P(), P()),
             out_specs=(pspec, pspec, pspec),
         )
         return jax.jit(mapped, donate_argnums=(0, 1, 3))
@@ -244,11 +282,18 @@ class ShardedPipeline:
         )
         return jax.jit(mapped, donate_argnums=(0, 1))
 
-    def step(self, stash, acc, offset, sketches, tags, meters, valid):
+    def step(self, stash, acc, offset, sketches, tags, meters, valid,
+             start_window: int = 0, close_below: int = 0):
         """tags: {f: [D*n]} u32 (device-shardable), meters [D*n, M],
         valid [D*n]. Leading dim must be divisible by the device count.
         `offset` is the per-device accumulator write position (host-tracked,
-        identical on every device)."""
+        identical on every device). `start_window`/`close_below` drive
+        the per-window sketch plane (ISSUE 8): the host's open-span
+        start and — on an advancing batch — the new span start, which
+        closes every older sketch slot into the pending buffer inside
+        this same dispatch (0 = close nothing). Callers whose batches
+        span more than `sketch_ring` windows must pass them, or sketch
+        slots may alias (the exact stash is unaffected either way)."""
         d = self.n_devices
 
         def shard_batch(x):
@@ -266,7 +311,10 @@ class ShardedPipeline:
         )  # [D, T, n]
         meters = shard_batch(jnp.asarray(meters))
         valid = shard_batch(jnp.asarray(valid))
-        return self._step(stash, acc, jnp.int32(offset), sketches, tag_mat, meters, valid)
+        return self._step(
+            stash, acc, jnp.int32(offset), sketches, tag_mat, meters, valid,
+            jnp.uint32(start_window), jnp.uint32(close_below),
+        )
 
     def fold(self, stash, acc, hi_window=None):
         """Amortized per-device fold of accumulated rows into the stash
@@ -286,37 +334,79 @@ class ShardedPipeline:
     def _build_window_close(self):
         axes = self.axes
 
-        def close(sk: SketchPlanes):
+        def close(sk: SketchState):
             sk1 = jax.tree.map(lambda x: x[0], sk)
-            # per-second global view: merge over every chip in the pod.
-            hll_global = lax.pmax(sk1.hll, axes)
-            cms_global = lax.psum(sk1.cms, axes)
-            hist_global = lax.psum(sk1.hist, axes)
+            # fold the open ring (slot axis) first, then merge across
+            # every chip in the pod — register max / counter add are
+            # associative, so ring-then-mesh equals any other order
+            hll_l = jnp.max(sk1.hll, axis=0)
+            cms_l = jnp.sum(sk1.cms, axis=0)
+            hist_l = jnp.sum(sk1.hist, axis=0)
+            hll_global = lax.pmax(hll_l, axes)
+            cms_global = lax.psum(cms_l, axes)
+            hist_global = lax.psum(hist_l, axes)
             # pod-wide 1m rollup path (DCN tier only): reduce over hosts
             # of the already-ICI-merged per-host planes.
-            hll_host = lax.pmax(sk1.hll, axes[1])  # ICI
+            hll_host = lax.pmax(hll_l, axes[1])  # ICI
             hll_pod_1m = lax.pmax(hll_host, axes[0])  # DCN
             expand = lambda x: x[None]
-            zeroed = jax.tree.map(lambda x: jnp.zeros_like(x[None]), sk1)
-            global_view = SketchPlanes(
+            global_view = MergedSketchView(
                 hll=expand(hll_global), cms=expand(cms_global), hist=expand(hist_global)
             )
-            return zeroed, global_view, expand(hll_pod_1m)
+            return global_view, expand(hll_pod_1m)
 
         pspec = P(self.axes)
         mapped = shard_map(
             close,
             mesh=self.mesh,
             in_specs=(pspec,),
-            out_specs=(pspec, pspec, pspec),
+            out_specs=(pspec, pspec),
         )
         return jax.jit(mapped)
 
     def window_close(self, sketches):
-        """Merge sketch planes across the mesh; returns (reset local
-        planes, globally-merged planes replicated per device, pod-wide 1m
-        HLL). Call at each window boundary."""
-        return self._close(sketches)
+        """Merge the open sketch ring across the mesh; returns
+        (sketches, globally-merged MergedSketchView replicated per
+        device, pod-wide 1m HLL).
+
+        ISSUE 8 semantics change: per-window state is authoritative now,
+        so this VIEW no longer resets the local planes (slots reset when
+        their window closes in-step; the first tuple element returns the
+        planes unchanged for call-site compatibility). The view covers
+        every still-open window — the per-window closed blocks drain
+        through ShardedWindowManager instead."""
+        view, pod_1m = self._close(sketches)
+        return sketches, view, pod_1m
+
+    def _build_sketch_drain(self):
+        """Per-device pending-drain (+ forced close below a bound) —
+        the sketch twin of _build_flush_range: one device call, outputs
+        fetched by the manager bundled into the flush drain's existing
+        transfers."""
+
+        def dr(sk, close_w):
+            sk1 = jax.tree.map(lambda x: x[0], sk)
+            new_sk, pend, pend_win, n = _sketch_drain_impl(sk1, close_w)
+            expand = lambda x: x[None]
+            return (
+                jax.tree.map(expand, new_sk),
+                pend[None], pend_win[None], n[None],
+            )
+
+        pspec = P(self.axes)
+        mapped = shard_map(
+            dr,
+            mesh=self.mesh,
+            in_specs=(pspec, P()),
+            out_specs=(pspec, pspec, pspec, pspec),
+        )
+        return jax.jit(mapped, donate_argnums=(0,))
+
+    def sketch_drain(self, sketches, close_below):
+        """Close every sketch slot below `close_below` on every device
+        and hand back the pending blocks: (sketches, pend [D, P, WIDE],
+        pend_win [D, P], pend_n [D])."""
+        return self._sketch_drain(sketches, jnp.uint32(close_below))
 
     # -- doc flush ------------------------------------------------------
     def _build_flush(self):
@@ -408,6 +498,15 @@ class ShardedWindowManager:
         self.pipe = pipe
         self.interval = pipe.config.interval
         self.delay = delay
+        self._sk_cfg = pipe.config.sketch_config()
+        ring_needed = delay // pipe.config.interval + 2
+        if pipe.config.sketch_ring < ring_needed:
+            raise ValueError(
+                f"sketch_ring={pipe.config.sketch_ring} cannot hold the "
+                f"{ring_needed} simultaneously-open windows of "
+                f"delay={delay}/interval={pipe.config.interval} — per-window "
+                "sketch slots would alias"
+            )
         self.stash, self.sketches = pipe.init_state()
         self.acc = None  # per-device accumulator, sized on first batch
         self.fill = 0  # host-tracked per-device accumulator rows
@@ -424,6 +523,14 @@ class ShardedWindowManager:
         # merged sketch views of the last closed window (None until one closes)
         self.global_view = None
         self.pod_1m = None
+        # per-window sketch tier (ISSUE 8): closed blocks host-merged
+        # across devices, in window order. BOUNDED drop-oldest-counted
+        # (like the device pending buffer) so an undrained consumer
+        # cannot leak a block per window forever.
+        self.closed_sketches: list = []
+        self.max_held_sketches = 512
+        self.sketch_blocks_closed = 0
+        self.sketch_blocks_dropped = 0
         # device↔host transfer accounting through the shared host_fetch
         # seam (aggregator/window.py) — the perf gate shims that seam
         # and asserts the per-ingest budget on this path too
@@ -492,7 +599,20 @@ class ShardedWindowManager:
             "bytes_uploaded": self.bytes_uploaded,
             "dispatch_retries": self.dispatch_retries,
             "fetch_retries": self.fetch_retries,
+            # per-window sketch tier (ISSUE 8): closed blocks merged
+            # across devices so far, blocks awaiting a consumer, and
+            # the drop-oldest overflow count (non-zero = nobody drains
+            # pop_closed_sketches)
+            "sketch_blocks_closed": self.sketch_blocks_closed,
+            "sketch_blocks_held": len(self.closed_sketches),
+            "sketch_blocks_dropped": self.sketch_blocks_dropped,
         }
+
+    def pop_closed_sketches(self) -> list:
+        """Drain the host-merged closed WindowSketchBlocks (window
+        order). The sketch twin of the DocBatches `ingest` returns."""
+        out, self.closed_sketches = self.closed_sketches, []
+        return out
 
     def telemetry(self) -> dict:
         """JSON-able counters + span summary (bench snapshot shape)."""
@@ -522,11 +642,16 @@ class ShardedWindowManager:
 
     def _drain_range(self, lo: int, hi: int):
         """Flush [lo, hi) from every device stash in one fused call and
-        regroup the packed rows into per-window DocBatches.
+        regroup the packed rows into per-window DocBatches; the sketch
+        tier's closed blocks (ISSUE 8) drain in the SAME two transfers
+        (pend counts ride the bundled scalar vector, packed blocks +
+        window ids ride the row-block fetch as one concatenated u32
+        array) and are host-merged across devices by window into
+        `closed_sketches`.
 
-        Host pays: the [D] totals fetch + ONE [D, max(totals)] row-block
-        fetch — independent of how many windows closed (previously: a
-        full slot+valid plane scan plus 3 plane fetches PER window)."""
+        Host pays: ONE [3D] scalar fetch + ONE concatenated block fetch
+        — independent of how many windows closed (previously: a full
+        slot+valid plane scan plus 3 plane fetches PER window)."""
         from ..aggregator.stash import unpack_flush_rows
         from ..datamodel.batch import DocBatch
         from ..datamodel.schema import FLOW_METER, TAG_SCHEMA
@@ -534,21 +659,58 @@ class ShardedWindowManager:
         self.stash, packed, totals = self.pipe.flush_range(
             self.stash, np.uint32(lo), np.uint32(hi)
         )
+        # forced close at `hi`: every device closes the same windows at
+        # this drain even if its shard never saw the advancing timestamp
+        self.sketches, pend, pend_win, pend_n = self.pipe.sketch_drain(
+            self.sketches, hi
+        )
         d = self.pipe.n_devices
-        # the fold_rows mirror rides the totals fetch — one [2D] scalar
-        # vector instead of [D], zero additional host syncs
+        # fold_rows + sketch pend counts ride the totals fetch — one
+        # [3D] scalar vector, zero additional host syncs
         fr_dev = self._fold_rows_dev
         if fr_dev is None:
             fr_dev = jnp.zeros((d,), jnp.uint32)
         bundled = self._fetch(
-            jnp.concatenate([totals, fr_dev.astype(jnp.int32)])
-        )  # [2D]
+            jnp.concatenate(
+                [totals, fr_dev.astype(jnp.int32), pend_n.astype(jnp.int32)]
+            )
+        )  # [3D]
         totals_np = bundled[:d]
-        self.fold_rows = int(bundled[d:].sum())
+        self.fold_rows = int(bundled[d : 2 * d].sum())
+        pend_np = bundled[2 * d :]
         max_t = int(totals_np.max())
+        max_p = int(pend_np.max())
+        if max_t == 0 and max_p == 0:
+            return []
+        row_cols = packed.shape[2]
+        wide = pend.shape[2]
+        flat = self._fetch(
+            jnp.concatenate([
+                packed[:, :max_t].reshape(-1),
+                pend[:, :max_p].reshape(-1),
+                pend_win[:, :max_p].reshape(-1),
+            ])
+        )
+        nb = d * max_t * row_cols
+        npend = d * max_p * wide
+        block = flat[:nb].reshape(d, max_t, row_cols)
+        pend_rows = flat[nb : nb + npend].reshape(d, max_p, wide)
+        pend_wins = flat[nb + npend :].reshape(d, max_p)
+        merged: dict[int, object] = {}
+        for dev in range(d):
+            n = int(pend_np[dev])
+            for blk in unpack_drained(
+                pend_rows[dev, :n], pend_wins[dev, :n], self._sk_cfg
+            ):
+                have = merged.get(blk.window)
+                merged[blk.window] = blk if have is None else have.merge(blk)
+        ordered = [merged[w] for w in sorted(merged)]
+        self.sketch_blocks_closed += len(ordered)
+        self.sketch_blocks_dropped += hold_blocks(
+            self.closed_sketches, ordered, self.max_held_sketches
+        )
         if max_t == 0:
             return []
-        block = self._fetch(packed[:, :max_t])  # [D, max_t, 3+T+M]
         per_dev = [
             unpack_flush_rows(block[d, : int(t)], TAG_SCHEMA.num_fields)
             for d, t in enumerate(totals_np)
@@ -646,7 +808,14 @@ class ShardedWindowManager:
             # sketch buffers are untouched when a retried fault raises
             chaos.maybe_fail(chaos.SITE_DISPATCH)
             return self.pipe.step(
-                self.stash, self.acc, self.fill, self.sketches, tags, meters, valid
+                self.stash, self.acc, self.fill, self.sketches, tags, meters,
+                valid,
+                # sketch-plane span bounds (ISSUE 8): the host's gate,
+                # and — when this batch advances — the new span start so
+                # the step closes the outgoing windows' sketch slots
+                # before their ring positions are reclaimed
+                start_window=self.start_window or 0,
+                close_below=new_start if advancing else 0,
             )
 
         def on_retry(_attempt, _exc):

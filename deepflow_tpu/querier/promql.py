@@ -3,7 +3,11 @@ seat (the reference compiles PromQL onto its CK engine; we evaluate
 directly).
 
 Supported:  [agg by (l1, l2)] (metric{label="v", label!="v"})
-            and rate(metric{...}[Ns])  inside the aggregation
+            and rate(metric{...}[Ns])  inside the aggregation,
+            topk(k, metric{...}) / bottomk(k, metric{...}) — the
+            heavy-hitter surface the sketch tier feeds (ISSUE 8:
+            topk(5, deepflow_sketch_top_bytes) ranks the invertible
+            sketch's recovered flows without any exact-row scan)
 Instant queries: evaluate at time `t` with a lookback window (last
 sample per series wins, Prometheus staleness semantics simplified).
 Range queries: query_range evaluates the instant expression at each
@@ -21,11 +25,12 @@ from ..storage.store import ColumnarStore
 
 _QUERY_RE = re.compile(
     r"^\s*(?:(?P<agg>sum|avg|max|min|count)\s*(?:by\s*\((?P<by>[^)]*)\)\s*)?\(\s*)?"
+    r"(?:(?P<topk>topk|bottomk)\s*\(\s*(?P<k>\d+)\s*,\s*)?"
     r"(?:(?P<rate>rate)\s*\(\s*)?"
     r"(?P<metric>[a-zA-Z_:][a-zA-Z0-9_:]*)"
     r"(?:\{(?P<matchers>[^}]*)\})?"
     r"(?:\[(?P<range>\d+)(?P<range_unit>[smh])\])?"
-    r"(?:\s*\))?(?:\s*\))?\s*$"
+    r"(?:\s*\))?(?:\s*\))?(?:\s*\))?\s*$"
 )
 
 _UNIT_S = {"s": 1, "m": 60, "h": 3600}
@@ -71,6 +76,10 @@ def query_instant(
     m = _QUERY_RE.match(query)
     if not m:
         raise PromQLError(f"unsupported query {query!r}")
+    if query.count("(") != query.count(")"):
+        # the regex's optional close-paren groups would otherwise let a
+        # typo ("topk(5, m" / "sum(m))") parse and silently answer
+        raise PromQLError(f"unbalanced parentheses in {query!r}")
     agg = m.group("agg")
     by = [s.strip() for s in (m.group("by") or "").split(",") if s.strip()]
     is_rate = bool(m.group("rate"))
@@ -122,6 +131,17 @@ def query_instant(
             per_series[packed] = dv / dt if dt > 0 else 0.0
         else:
             per_series[packed] = samples[-1][1]
+
+    if m.group("topk"):
+        # topk/bottomk(k, inner): keep the k extreme series, then fall
+        # through to an (optional) outer aggregation over the survivors
+        k = int(m.group("k"))
+        sign = -1.0 if m.group("topk") == "topk" else 1.0
+        keep = sorted(per_series.items(), key=lambda kv: (sign * kv[1], kv[0]))[:k]
+        per_series = dict(keep)
+        if agg is None:
+            # rank order, not label order — the whole point of topk
+            return [{"labels": _label_dict(p), "value": v} for p, v in keep]
 
     if agg is None:
         return [

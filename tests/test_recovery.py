@@ -616,3 +616,239 @@ def test_sharded_checkpoint_roundtrip(tmp_path):
         restore_sharded_state(
             _SWM(_SP(_mm(2), cfg_small)), tmp_path / "swm.ckpt"
         )
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint v4: per-window sketch planes (ISSUE 8). The planes must
+# round-trip BIT-EXACT through a KillPoint at mid-window (open windows'
+# partial sketch state resumes, not restarts), single-chip AND sharded;
+# v2/v3 files must still load — sketch planes re-initialize with a loud
+# log, never a crash.
+
+from deepflow_tpu.aggregator.sketchplane import SketchConfig  # noqa: E402
+from deepflow_tpu.aggregator.window import WindowManager  # noqa: E402
+from deepflow_tpu.ops.histogram import LogHistSpec  # noqa: E402
+
+_SK = SketchConfig(
+    num_groups=4, hll_precision=7, cms_depth=2, cms_width=256,
+    hist=LogHistSpec(bins=32, vmin=1.0, gamma=1.3),
+    topk_rows=2, topk_cols=64, pending=8,
+)
+
+
+def _sk_doc_batch(seed, n, t):
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    keys = rng.integers(0, 150, n).astype(np.uint32)
+    tags = np.zeros((TAG_SCHEMA.num_fields, n), np.uint32)
+    tags[TAG_SCHEMA.index("ip0_w3")] = keys
+    tags[TAG_SCHEMA.index("server_port")] = 443
+    tags[TAG_SCHEMA.index("protocol")] = 6
+    tags[TAG_SCHEMA.index("l3_epc_id1")] = keys % 5
+    meters = np.zeros((FLOW_METER.num_fields, n), np.float32)
+    meters[FLOW_METER.index("byte_tx")] = 100.0
+    meters[FLOW_METER.index("rtt_sum")] = 10.0
+    meters[FLOW_METER.index("rtt_count")] = 1.0
+    hi = keys * np.uint32(2654435761) + np.uint32(1)
+    lo = keys ^ np.uint32(0x9E3779B9)
+    return (np.full(n, t, np.uint32), jnp.asarray(hi), jnp.asarray(lo),
+            jnp.asarray(tags), jnp.asarray(meters), np.ones(n, bool))
+
+
+_SK_TIMES = (T0, T0 + 1, T0 + 2, T0 + 5, T0 + 6)
+_SK_KILL_AFTER = 2  # mid-window: T0+2 ingested, its window still open
+
+
+def _assert_blocks_equal(a, b):
+    assert a.window == b.window and a.n_updates == b.n_updates
+    for lane in ("hll", "cms", "hist", "tk_votes", "tk_hi", "tk_lo",
+                 "tk_ida", "tk_idb"):
+        np.testing.assert_array_equal(
+            getattr(a, lane), getattr(b, lane), err_msg=(a.window, lane)
+        )
+
+
+def _flush_stream_equal(got, want):
+    assert [f.window_idx for f in got] == [f.window_idx for f in want]
+    for g, w in zip(got, want):
+        assert g.count == w.count
+        np.testing.assert_array_equal(g.key_hi, w.key_hi)
+        np.testing.assert_array_equal(g.key_lo, w.key_lo)
+        assert (g.sketches is None) == (w.sketches is None)
+        if g.sketches is not None:
+            _assert_blocks_equal(g.sketches, w.sketches)
+
+
+def test_sketch_planes_roundtrip_killpoint_mid_window_single_chip(tmp_path):
+    def batches():
+        return [_sk_doc_batch(60 + i, 96, t) for i, t in enumerate(_SK_TIMES)]
+
+    # uninterrupted oracle
+    oracle = WindowManager(WindowConfig(capacity=1 << 11, sketch=_SK))
+    want = []
+    for b in batches():
+        want.extend(oracle.ingest(*b))
+    want.extend(oracle.flush_all())
+
+    # victim: killed mid-window right after the checkpoint barrier
+    path = tmp_path / "sk.ckpt"
+    victim = WindowManager(WindowConfig(capacity=1 << 11, sketch=_SK))
+    got = []
+    with pytest.raises(chaos.KillPoint):
+        for i, b in enumerate(batches()):
+            got.extend(victim.ingest(*b))
+            if i == _SK_KILL_AFTER:
+                got.extend(save_window_state(victim, path))
+                raise chaos.KillPoint("process death mid-window")
+
+    recovered = load_window_state(path, TAG_SCHEMA, FLOW_METER)
+    assert recovered.sk is not None
+    # the plane itself round-trips bit-exact
+    for lane in ("win", "count", "hll", "cms", "hist", "tk_votes", "tk_hi"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(recovered.sk, lane)),
+            np.asarray(getattr(victim.sk, lane)), err_msg=lane,
+        )
+    # ...and the continued run is indistinguishable from the oracle,
+    # flushed rows AND closed sketch blocks
+    for b in batches()[_SK_KILL_AFTER + 1 :]:
+        got.extend(recovered.ingest(*b))
+    got.extend(recovered.flush_all())
+    _flush_stream_equal(got, want)
+    assert recovered.get_counters()["sketch_rows"] == (
+        oracle.get_counters()["sketch_rows"]
+    )
+
+
+def test_sketch_planes_roundtrip_killpoint_mid_window_sharded(tmp_path):
+    from deepflow_tpu.parallel.mesh import make_mesh
+    from deepflow_tpu.parallel.sharded import (
+        ShardedConfig,
+        ShardedPipeline,
+        ShardedWindowManager,
+    )
+
+    cfg = ShardedConfig(
+        capacity_per_device=1 << 10, num_services=8, hll_precision=7,
+        cms_depth=2, cms_width=256,
+        hist=LogHistSpec(bins=32, vmin=1.0, gamma=1.3),
+        topk_cols=64, sketch_pending=8,
+    )
+    gen = SyntheticFlowGen(num_tuples=200, seed=61)
+    batches = [gen.flow_batch(128, t) for t in _SK_TIMES]
+
+    def run(wm, bs):
+        out, blocks = [], []
+        for fb in bs:
+            out.extend(wm.ingest(fb.tags, fb.meters, fb.valid))
+            blocks.extend(wm.pop_closed_sketches())
+        out.extend(wm.drain())
+        blocks.extend(wm.pop_closed_sketches())
+        return out, blocks
+
+    oracle = ShardedWindowManager(ShardedPipeline(make_mesh(2), cfg))
+    want_docs, want_blocks = run(oracle, batches)
+
+    path = tmp_path / "sk_sharded.ckpt"
+    victim = ShardedWindowManager(ShardedPipeline(make_mesh(2), cfg))
+    got_docs, got_blocks = [], []
+    with pytest.raises(chaos.KillPoint):
+        for i, fb in enumerate(batches):
+            got_docs.extend(victim.ingest(fb.tags, fb.meters, fb.valid))
+            got_blocks.extend(victim.pop_closed_sketches())
+            if i == _SK_KILL_AFTER:
+                save_sharded_state(victim, path)
+                raise chaos.KillPoint("process death mid-window")
+
+    recovered = ShardedWindowManager(ShardedPipeline(make_mesh(2), cfg))
+    restore_sharded_state(recovered, path)
+    for lane in ("win", "count", "hll", "cms", "tk_votes", "tk_hi"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(recovered.sketches, lane)),
+            np.asarray(getattr(victim.sketches, lane)), err_msg=lane,
+        )
+    d2, b2 = run(recovered, batches[_SK_KILL_AFTER + 1 :])
+    got_docs.extend(d2)
+    got_blocks.extend(b2)
+    assert [d.size for d in got_docs] == [d.size for d in want_docs]
+    assert [b.window for b in got_blocks] == [b.window for b in want_blocks]
+    for g, w in zip(got_blocks, want_blocks):
+        _assert_blocks_equal(g, w)
+
+
+def test_pre_v4_checkpoints_reinit_sketch_planes_loudly(tmp_path, caplog):
+    """v3-era files (no sk_* arrays) must LOAD: the sketch tier
+    re-initializes with a loud log — resuming an exact-only snapshot
+    into a sketch-enabled deployment is a degradation, not a crash."""
+    import logging
+
+    from deepflow_tpu.aggregator import checkpoint as ckpt_mod
+
+    wm = WindowManager(WindowConfig(capacity=1 << 10, sketch=_SK))
+    list(wm.ingest(*_sk_doc_batch(62, 64, T0)))
+    path = tmp_path / "v3.ckpt"
+    save_window_state(wm, path)
+    # strip the file back to a v3 layout: no sketch arrays, no sketch meta
+    meta, arrays = ckpt_mod._read_checkpoint(path)
+    meta = {k: v for k, v in meta.items()
+            if not k.startswith("sketch") and k != "digest"}
+    meta["version"] = 3
+    arrays = {k: v for k, v in arrays.items() if not k.startswith("sk_")}
+    ckpt_mod._write_checkpoint(path, meta, arrays)
+
+    with caplog.at_level(logging.WARNING):
+        restored = load_window_state(
+            path, TAG_SCHEMA, FLOW_METER, sketch_config=_SK
+        )
+    assert any("no sketch planes" in r.message for r in caplog.records)
+    assert restored.sk is not None
+    assert int(np.asarray(restored.sk.rows)) == 0  # fresh plane
+    # exact state still restored
+    assert restored.start_window == wm.start_window
+    # and the manager keeps working with the fresh plane
+    flushed = list(restored.ingest(*_sk_doc_batch(63, 64, T0 + 5)))
+    flushed += restored.flush_all()
+    assert any(f.sketches is not None for f in flushed)
+
+
+def test_pre_v4_sharded_checkpoint_reinits_sketch_planes_loudly(tmp_path, caplog):
+    import logging
+
+    from deepflow_tpu.aggregator import checkpoint as ckpt_mod
+    from deepflow_tpu.parallel.mesh import make_mesh
+    from deepflow_tpu.parallel.sharded import (
+        ShardedConfig,
+        ShardedPipeline,
+        ShardedWindowManager,
+    )
+
+    cfg = ShardedConfig(
+        capacity_per_device=1 << 9, num_services=8, hll_precision=6,
+        cms_depth=2, cms_width=128,
+        hist=LogHistSpec(bins=16, vmin=1.0, gamma=1.5),
+        topk_cols=64, sketch_pending=8,
+    )
+    wm = ShardedWindowManager(ShardedPipeline(make_mesh(2), cfg))
+    gen = SyntheticFlowGen(num_tuples=100, seed=64)
+    fb = gen.flow_batch(64, T0)
+    wm.ingest(fb.tags, fb.meters, fb.valid)
+    path = tmp_path / "v3_sharded.ckpt"
+    save_sharded_state(wm, path)
+    meta, arrays = ckpt_mod._read_checkpoint(path)
+    meta = {k: v for k, v in meta.items()
+            if not k.startswith("sketch") and k != "digest"}
+    meta["version"] = 3
+    arrays = {k: v for k, v in arrays.items() if not k.startswith("sk_")}
+    ckpt_mod._write_checkpoint(path, meta, arrays)
+
+    fresh = ShardedWindowManager(ShardedPipeline(make_mesh(2), cfg))
+    with caplog.at_level(logging.WARNING):
+        restore_sharded_state(fresh, path)
+    assert any("no sketch planes" in r.message for r in caplog.records)
+    assert int(np.asarray(fresh.sketches.rows).sum()) == 0
+    # exact state restored; the manager keeps working
+    fb2 = gen.flow_batch(64, T0 + 5)
+    fresh.ingest(fb2.tags, fb2.meters, fb2.valid)
+    fresh.drain()
+    assert fresh.pop_closed_sketches()

@@ -101,10 +101,14 @@ def test_window_close_merges_hll_across_devices():
 
     acc = pipe.init_acc(4 * 512)
     stash, acc, sketches = pipe.step(stash, acc, 0, sketches, fb.tags, fb.meters, fb.valid)
-    reset, global_view, pod_1m = pipe.window_close(sketches)
+    kept, global_view, pod_1m = pipe.window_close(sketches)
 
-    # local planes zeroed
-    assert np.asarray(reset.hll).sum() == 0
+    # ISSUE 8: per-window state is authoritative — the view does NOT
+    # reset the local planes (slots reset when their window closes
+    # in-step); the first return is the planes unchanged
+    np.testing.assert_array_equal(
+        np.asarray(kept.hll), np.asarray(sketches.hll)
+    )
     # global estimate ≈ distinct client ips
     svc = int((5 * 131 + 443) % 16)
     est_rows = np.asarray(jax.device_get(global_view.hll))

@@ -1,0 +1,483 @@
+"""Per-window device sketch plane (ISSUE 8) — window semantics, shed
+coverage, K-ring equivalence, sharded merge, and the querier e2e.
+
+The exact-path tests pin the plane against per-window numpy oracles
+(true distinct counts / frequencies recomputed from the input rows);
+the shed tests pin the tentpole's point: a stash too small for the key
+space loses exact rows but the window's sketch answers stay in-bound.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepflow_tpu.aggregator.sketchplane import SketchConfig
+from deepflow_tpu.aggregator.window import WindowConfig, WindowManager
+from deepflow_tpu.datamodel.schema import FLOW_METER, TAG_SCHEMA
+from deepflow_tpu.ops.histogram import LogHistSpec
+
+SK = SketchConfig(
+    num_groups=4, hll_precision=8, cms_depth=3, cms_width=512,
+    hist=LogHistSpec(bins=64, vmin=1.0, gamma=1.2),
+    topk_rows=2, topk_cols=128, pending=10,
+)
+
+
+def _wm(capacity=1 << 11, delay=2, stats_ring=1, sketch=SK):
+    return WindowManager(
+        WindowConfig(capacity=capacity, delay=delay, stats_ring=stats_ring,
+                     sketch=sketch)
+    )
+
+
+def _doc_batch(keys: np.ndarray, t: int, byte_w=100.0, rtt=None):
+    """Raw doc rows for WindowManager.ingest keyed by small int ids:
+    ip0_w3 carries the key (client identity == flow identity here, so
+    distinct clients == distinct keys in the oracle)."""
+    n = len(keys)
+    keys = np.asarray(keys, np.uint32)
+    tags = np.zeros((TAG_SCHEMA.num_fields, n), np.uint32)
+    tags[TAG_SCHEMA.index("ip0_w3")] = keys
+    tags[TAG_SCHEMA.index("server_port")] = 443
+    tags[TAG_SCHEMA.index("protocol")] = 6
+    tags[TAG_SCHEMA.index("l3_epc_id1")] = keys % 5
+    meters = np.zeros((FLOW_METER.num_fields, n), np.float32)
+    meters[FLOW_METER.index("byte_tx")] = byte_w
+    meters[FLOW_METER.index("rtt_sum")] = (
+        rtt if rtt is not None else np.full(n, 10.0, np.float32)
+    )
+    meters[FLOW_METER.index("rtt_count")] = 1.0
+    ts = np.full(n, t, np.uint32)
+    # caller-side doc fingerprint — any injective map of the key works
+    hi = keys * np.uint32(2654435761) + np.uint32(1)
+    lo = keys ^ np.uint32(0x9E3779B9)
+    return (ts, jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(tags),
+            jnp.asarray(meters), jnp.ones(n, bool))
+
+
+T0 = 1_700_000_000
+
+
+def _run(wm, batches):
+    """[(keys, t)] → flushed windows (incl. flush_all)."""
+    out = []
+    for keys, t in batches:
+        out.extend(wm.ingest(*_doc_batch(keys, t)))
+    out.extend(wm.flush_all())
+    return out
+
+
+def test_per_window_blocks_match_numpy_oracle():
+    rng = np.random.default_rng(50)
+    per_window = {t: rng.integers(0, 300, 400).astype(np.uint32)
+                  for t in (T0, T0 + 1, T0 + 2)}
+    wm = _wm()
+    flushed = _run(wm, [(k, t) for t, k in per_window.items()])
+    assert [f.window_idx for f in flushed] == sorted(per_window)
+    for f in flushed:
+        blk = f.sketches
+        assert blk is not None and blk.window == f.window_idx
+        keys = per_window[f.window_idx]
+        true_distinct = len(np.unique(keys))
+        assert blk.n_updates == len(keys)
+        # HLL in-envelope (p=8 → ~6.5% stderr; seeded draw well inside 15%)
+        assert abs(blk.distinct() - true_distinct) / true_distinct < 0.15
+        # exact rows agree (no shed at this capacity): block and stash
+        # describe the same window
+        assert f.count == true_distinct
+        # CMS overestimate-only against true per-key counts, keyed by
+        # the SAME fingerprint the exact rows carry
+        uniq, counts = np.unique(keys, return_counts=True)
+        hi = uniq * np.uint32(2654435761) + np.uint32(1)
+        lo = uniq ^ np.uint32(0x9E3779B9)
+        est = blk.estimate(hi, lo)
+        true_bytes = counts * 100
+        assert (est >= true_bytes).all()
+        assert (est <= true_bytes * 1.5 + 500).all()
+        # top-K inversion recovers the window's heaviest keys
+        top = blk.topk(5)
+        heavy_true = set(uniq[np.argsort(-counts)][:3].tolist())
+        heavy_rec = {t_["id_a"] for t_ in top}
+        assert len(heavy_true & heavy_rec) >= 2
+        # latency quantile from the t-digest export path
+        assert abs(blk.quantile(0.5) - 10.0) / 10.0 < 0.25
+
+
+def test_shed_degrades_detail_not_coverage():
+    """THE tentpole acceptance shape: a stash far smaller than the key
+    space sheds exact rows, but the closed window's sketch block still
+    answers distinct-count / frequency / top-K in-bound."""
+    rng = np.random.default_rng(51)
+    n_keys = 3000
+    keys = np.concatenate([
+        rng.permutation(n_keys).astype(np.uint32),  # uniform scan
+        np.repeat(np.arange(8, dtype=np.uint32), 200),  # planted heavies
+    ])
+    rng.shuffle(keys)
+    # finer HLL than the shared config: p=11 puts 3k keys in the
+    # linear-counting regime (error ≪ 1%), the production-shaped knob
+    sk = SketchConfig(
+        num_groups=4, hll_precision=11, cms_depth=3, cms_width=512,
+        hist=LogHistSpec(bins=64, vmin=1.0, gamma=1.2),
+        topk_rows=2, topk_cols=128, pending=10,
+    )
+    wm = _wm(capacity=256, sketch=sk)  # stash holds <10% of the key space
+    flushed = _run(wm, [(keys, T0), (keys[:64], T0 + 4)])
+    f = flushed[0]
+    assert f.window_idx == T0
+    # the exact tier shed: far fewer rows than distinct keys...
+    assert f.count <= 256 < n_keys
+    assert int(np.asarray(wm.state.dropped_overflow)) > 0
+    blk = f.sketches
+    assert blk is not None
+    # ...but sketch coverage is total: every row reached the plane
+    assert blk.n_updates == len(keys)
+    true_distinct = len(np.unique(keys))
+    assert abs(blk.distinct() - true_distinct) / true_distinct < 0.1
+    # planted heavy hitters all recovered, in order of weight
+    top = blk.topk(8)
+    assert {t["id_a"] for t in top} == set(range(8))
+
+
+def test_stats_ring_blocks_bit_exact_vs_per_batch():
+    """K-ring mode (stats_ring=4) defers host syncs; flushed sketch
+    blocks must stay BIT-EXACT vs per-batch fetching — same pin the
+    exact rows already have (tests/test_feeder.py)."""
+    rng = np.random.default_rng(52)
+    batches = [(rng.integers(0, 200, 256).astype(np.uint32), t)
+               for t in (T0, T0, T0 + 1, T0 + 3, T0 + 4, T0 + 4, T0 + 7)]
+    outs = {}
+    for k in (1, 4):
+        wm = _wm(stats_ring=k)
+        outs[k] = _run(wm, [(np.array(ks, np.uint32), t) for ks, t in batches])
+    assert [f.window_idx for f in outs[1]] == [f.window_idx for f in outs[4]]
+    for a, b in zip(outs[1], outs[4]):
+        assert a.count == b.count
+        np.testing.assert_array_equal(a.key_hi, b.key_hi)
+        if a.sketches is None:
+            assert b.sketches is None
+            continue
+        assert b.sketches is not None
+        assert a.sketches.n_updates == b.sketches.n_updates
+        np.testing.assert_array_equal(a.sketches.hll, b.sketches.hll)
+        np.testing.assert_array_equal(a.sketches.cms, b.sketches.cms)
+        np.testing.assert_array_equal(a.sketches.hist, b.sketches.hist)
+        np.testing.assert_array_equal(a.sketches.tk_votes, b.sketches.tk_votes)
+        np.testing.assert_array_equal(a.sketches.tk_hi, b.sketches.tk_hi)
+
+
+def test_giant_jump_mid_rows_are_counted_shed():
+    """One batch spanning far more than R windows below its own close
+    bound: the mid-gap rows cannot each get a ring slot — they must be
+    COUNTED out of the sketch tier (CB_SKETCH_SHED), never silently
+    merged into a neighbour window, and the exact stash still takes
+    them."""
+    wm = _wm()
+    # open the span
+    list(wm.ingest(*_doc_batch(np.arange(10, dtype=np.uint32), T0)))
+    # one batch scattered over 40 windows, newest 40 windows ahead:
+    # windows below close_w but ≥ R past the base lose sketch coverage
+    n = 200
+    ts = np.repeat(np.arange(T0, T0 + 40, dtype=np.uint32), 5)
+    keys = np.arange(n, dtype=np.uint32)
+    b = list(_doc_batch(keys, T0))
+    b[0] = ts
+    flushed = list(wm.ingest(*b))
+    flushed += wm.flush_all()
+    c = wm.get_counters()
+    assert c["sketch_shed"] > 0
+    # exact tier unaffected by the sketch shed: every (window, key)
+    # row flushed — batch 1 contributes keys 0..9 at T0, batch 2's
+    # window-T0 rows (keys 0..4) merge into them, the rest are unique
+    assert sum(f.count for f in flushed) == 10 + (40 - 1) * 5
+    # windows that DID get slots carry blocks; shed windows may be bare
+    assert any(f.sketches is not None for f in flushed)
+
+
+def test_pipeline_flow_path_blocks_and_cb_lane():
+    """L4Pipeline with the plane on: blocks surface through
+    pop_closed_sketches, the CB v4 lane proves updates ran in the fused
+    dispatch, and the fused step never retraces."""
+    from deepflow_tpu.aggregator.pipeline import L4Pipeline, PipelineConfig
+    from deepflow_tpu.datamodel.batch import FlowBatch
+    from deepflow_tpu.ingest.replay import SyntheticFlowGen
+
+    pipe = L4Pipeline(
+        PipelineConfig(window=WindowConfig(capacity=1 << 12, sketch=SK),
+                       batch_size=256)
+    )
+    gen = SyntheticFlowGen(num_tuples=150, seed=53)
+    for i, t in enumerate((T0, T0 + 1, T0 + 2, T0 + 5, T0 + 6)):
+        pipe.ingest(FlowBatch.from_records(gen.records(128, t)))
+    pipe.drain()
+    blocks = pipe.pop_closed_sketches()
+    assert len(blocks) >= 4
+    assert all(b.n_updates > 0 for b in blocks)
+    c = pipe.get_counters()
+    assert c["sketch_rows"] > 0, "CB_SKETCH_ROWS lane never moved"
+    assert c["sketch_shed"] == 0
+    assert c["jit_retraces"] == 0
+
+
+def test_sharded_plane_merges_to_single_device_truth():
+    """Cross-mesh merge-on-close: the host-merged per-window block of a
+    2-device run equals the 1-device run on the same batch for every
+    order-independent lane (register max / integer counter add)."""
+    from deepflow_tpu.ingest.replay import SyntheticFlowGen
+    from deepflow_tpu.parallel.mesh import make_mesh
+    from deepflow_tpu.parallel.sharded import (
+        ShardedConfig,
+        ShardedPipeline,
+        ShardedWindowManager,
+    )
+
+    cfg = ShardedConfig(
+        capacity_per_device=1 << 10, num_services=8, hll_precision=7,
+        cms_depth=2, cms_width=256,
+        hist=LogHistSpec(bins=32, vmin=1.0, gamma=1.3),
+        topk_cols=64, sketch_pending=8,
+    )
+    gen = SyntheticFlowGen(num_tuples=300, seed=54)
+    batches = [gen.flow_batch(128, t) for t in (T0, T0 + 1, T0 + 4)]
+    blocks = {}
+    for n_dev in (1, 2):
+        wm = ShardedWindowManager(ShardedPipeline(make_mesh(n_dev), cfg))
+        for fb in batches:
+            wm.ingest(fb.tags, fb.meters, fb.valid)
+        wm.drain()
+        blocks[n_dev] = {b.window: b for b in wm.pop_closed_sketches()}
+        assert wm.get_counters()["sketch_blocks_closed"] >= 3
+    assert set(blocks[1]) == set(blocks[2])
+    for w, a in blocks[1].items():
+        b = blocks[2][w]
+        assert a.n_updates == b.n_updates
+        np.testing.assert_array_equal(a.hll, b.hll)
+        np.testing.assert_array_equal(a.cms, b.cms)
+        np.testing.assert_array_equal(a.hist, b.hist)
+        # top-K bucket state is shard-dependent; the recovered heavy
+        # set must still overlap strongly
+        top_a = {t["key_hi"] for t in a.topk(5)}
+        top_b = {t["key_hi"] for t in b.topk(5)}
+        assert len(top_a & top_b) >= 3
+
+
+def test_querier_e2e_sql_and_promql_over_shed_window():
+    """Acceptance e2e: high-cardinality traffic into a stash that
+    sheds; SQL and PromQL both answer distinct-count, quantile and
+    top-K for the closed window from the sketch tier — no exact-row
+    dependence."""
+    from deepflow_tpu.integration.dfstats import (
+        DEEPFLOW_SYSTEM_DB,
+        DEEPFLOW_SYSTEM_TABLE,
+        SKETCH_METRIC_DISTINCT,
+        SKETCH_METRIC_QUANTILE,
+        SKETCH_METRIC_TOPK,
+        sketch_system_sink,
+    )
+    from deepflow_tpu.querier.engine import QueryEngine
+    from deepflow_tpu.querier.promql import query_instant
+    from deepflow_tpu.storage.store import ColumnarStore
+
+    rng = np.random.default_rng(55)
+    n_keys = 2000
+    keys = np.concatenate([
+        rng.permutation(n_keys).astype(np.uint32),
+        np.repeat(np.arange(5, dtype=np.uint32), 300),
+    ])
+    rng.shuffle(keys)
+    wm = _wm(capacity=128)  # guaranteed shed
+    flushed = _run(wm, [(keys, T0)])
+    blocks = [f.sketches for f in flushed if f.sketches is not None]
+    assert blocks and int(np.asarray(wm.state.dropped_overflow)) > 0
+
+    store = ColumnarStore()
+    sketch_system_sink(store, interval=wm.config.interval)(blocks)
+
+    # SQL: window-level distinct count from the sketch tier
+    eng = QueryEngine(store)
+    res = eng.execute(
+        "SELECT value FROM deepflow_system.deepflow_system WHERE "
+        f"metric = '{SKETCH_METRIC_DISTINCT}' AND labels = 'service=all' "
+        f"AND time = {T0}"
+    )
+    assert res.rows == 1
+    true_distinct = len(np.unique(keys))
+    got = float(res.values["value"][0])
+    assert abs(got - true_distinct) / true_distinct < 0.1
+    # SQL: quantile rows exist per active service
+    res_q = eng.execute(
+        "SELECT value FROM deepflow_system.deepflow_system WHERE "
+        f"metric = '{SKETCH_METRIC_QUANTILE}'"
+    )
+    assert res_q.rows > 0 and (res_q.values["value"] > 0).all()
+    # SQL: top-K lane, ranked
+    res_t = eng.execute(
+        "SELECT value FROM deepflow_system.deepflow_system WHERE "
+        f"metric = '{SKETCH_METRIC_TOPK}' ORDER BY value DESC LIMIT 5"
+    )
+    assert res_t.rows == 5
+
+    # PromQL: instant distinct + the topk() surface
+    inst = query_instant(
+        store, SKETCH_METRIC_DISTINCT + '{service="all"}', T0,
+        db=DEEPFLOW_SYSTEM_DB, table=DEEPFLOW_SYSTEM_TABLE,
+    )
+    assert len(inst) == 1
+    assert abs(inst[0]["value"] - true_distinct) / true_distinct < 0.1
+    top = query_instant(
+        store, f"topk(5, {SKETCH_METRIC_TOPK})", T0,
+        db=DEEPFLOW_SYSTEM_DB, table=DEEPFLOW_SYSTEM_TABLE,
+    )
+    assert len(top) == 5
+    vals = [r["value"] for r in top]
+    assert vals == sorted(vals, reverse=True)
+    # the planted heavies dominate the recovered ranking
+    heavy_ips = {r["labels"]["ip"] for r in top}
+    assert heavy_ips <= {str(i) for i in range(5)}
+
+
+def test_sketchless_manager_unchanged():
+    """sketch=None keeps the exact-only contract: 2-tuple flush
+    entries, no sketch state, no new lanes moving."""
+    wm = _wm(sketch=None)
+    flushed = _run(wm, [(np.arange(50, dtype=np.uint32), T0),
+                        (np.arange(50, dtype=np.uint32), T0 + 4)])
+    assert wm.sk is None
+    assert all(f.sketches is None for f in flushed)
+    c = wm.get_counters()
+    assert c["sketch_rows"] == 0 and c["sketch_shed"] == 0
+
+
+def test_make_ingest_step_sketch_variant():
+    """The bench-facing make_ingest_step(sketch_config=...) signature:
+    append carries the plane through the same traced step and claims
+    per-window ring slots."""
+    import jax
+
+    from deepflow_tpu.aggregator.fanout import FANOUT_LANES, FanoutConfig
+    from deepflow_tpu.aggregator.pipeline import make_ingest_step
+    from deepflow_tpu.aggregator.sketchplane import sketch_init
+    from deepflow_tpu.aggregator.stash import accum_init, stash_init
+    from deepflow_tpu.ingest.replay import SyntheticFlowGen
+
+    append, fold = make_ingest_step(
+        FanoutConfig(), interval=1, sketch_config=SK, delay=2
+    )
+    append = jax.jit(append, donate_argnums=(0, 1, 3))
+    gen = SyntheticFlowGen(num_tuples=100, seed=70)
+    fb = gen.flow_batch(128, T0)
+    tags = {k: jnp.asarray(v) for k, v in fb.tags.items()}
+    stash = stash_init(1 << 10, TAG_SCHEMA, FLOW_METER)
+    acc = accum_init(2 * FANOUT_LANES * 128, TAG_SCHEMA, FLOW_METER)
+    sk = sketch_init(SK, 4)
+    stash, acc, sk = append(
+        stash, acc, jnp.int32(0), sk, tags, jnp.asarray(fb.meters),
+        jnp.asarray(fb.valid), jnp.uint32(0),
+    )
+    assert int(np.asarray(sk.rows)) == int(fb.valid.sum())
+    assert (np.asarray(sk.win) != 0xFFFFFFFF).sum() >= 1  # slot claimed
+
+
+def test_sketch_sink_skips_quantiles_without_latency_samples():
+    """Review pin: a service with HLL activity but an all-zero latency
+    histogram (UDP-only traffic, rtt_count=0) must produce NO quantile
+    series — a fake 0 ms row is indistinguishable from real zero
+    latency."""
+    from deepflow_tpu.aggregator.sketchplane import WindowSketchBlock
+    from deepflow_tpu.integration.dfstats import (
+        SKETCH_METRIC_QUANTILE,
+        sketch_block_rows,
+    )
+
+    g, m = SK.num_groups, SK.hll_m
+    hll = np.zeros((g, m), np.int32)
+    hll[0, 3] = 4  # service 0 saw clients...
+    hll[1, 7] = 2  # ...service 1 too
+    hist = np.zeros((g, SK.hist.bins), np.int64)
+    hist[1, 5] = 9  # ...but only service 1 has latency samples
+    blk = WindowSketchBlock(
+        window=T0, config=SK, n_updates=13, hll=hll,
+        cms=np.zeros((SK.cms_depth, SK.cms_width), np.int64), hist=hist,
+        tk_hi=np.zeros(0, np.uint32), tk_lo=np.zeros(0, np.uint32),
+        tk_ida=np.zeros(0, np.uint32), tk_idb=np.zeros(0, np.uint32),
+        tk_votes=np.zeros(0, np.int64),
+    )
+    rows = sketch_block_rows(blk, 1)
+    q_services = {r[2]["service"] for r in rows if r[1] == SKETCH_METRIC_QUANTILE}
+    assert q_services == {"1"}
+    assert all(r[3] > 0 for r in rows if r[1] == SKETCH_METRIC_QUANTILE)
+
+
+def test_held_sketch_blocks_are_bounded_drop_oldest():
+    """Review pin: an undrained pop_closed_sketches must not leak a
+    block per closed window — beyond max_held_sketches the oldest drop
+    and are COUNTED."""
+    from deepflow_tpu.aggregator.pipeline import L4Pipeline, PipelineConfig
+    from deepflow_tpu.datamodel.batch import FlowBatch
+    from deepflow_tpu.ingest.replay import SyntheticFlowGen
+
+    pipe = L4Pipeline(
+        PipelineConfig(window=WindowConfig(capacity=1 << 12, sketch=SK),
+                       batch_size=256)
+    )
+    pipe.max_held_sketches = 2
+    gen = SyntheticFlowGen(num_tuples=100, seed=71)
+    for i in range(7):  # one window closes per batch after warmup
+        pipe.ingest(FlowBatch.from_records(gen.records(64, T0 + i)))
+    pipe.drain()
+    c = pipe.get_counters()
+    assert c["sketch_blocks_held"] <= 2
+    assert c["sketch_blocks_dropped"] >= 1
+    held = pipe.pop_closed_sketches()
+    assert len(held) <= 2
+    # the survivors are the NEWEST windows
+    assert held == sorted(held, key=lambda b: b.window)
+
+
+def test_closing_rows_never_alias_into_older_open_slot():
+    """Review pin (r12 second pass): a batch whose own t_min jumps
+    ahead of a window still open from an earlier batch must NOT fold
+    mod-R-aliasing rows into that older slot — the collision-free span
+    anchors at the oldest LIVE ring slot, and out-of-span closing rows
+    are counted-shed. Before the fix, window 0's block absorbed window
+    4's rows (n_updates 5, polluted HLL/CMS/top-K) with shed == 0."""
+    wm = _wm(delay=2)  # R = 4: windows 0 and 4 share ring slot 0
+    out = list(wm.ingest(*_doc_batch(np.array([1, 2, 3], np.uint32), 0)))
+    b = list(_doc_batch(np.array([10, 11, 12, 20, 21, 30, 31], np.uint32), 0))
+    b[0] = np.array([1, 2, 3, 4, 4, 7, 7], np.uint32)
+    out += wm.ingest(*b)
+    out += wm.flush_all()
+    by_win = {f.window_idx: f for f in out}
+    # window 0 closed with ONLY its own 3 rows in the sketch block
+    assert by_win[0].count == 3
+    assert by_win[0].sketches is not None
+    assert by_win[0].sketches.n_updates == 3
+    assert abs(by_win[0].sketches.distinct() - 3) < 1.5
+    # window 4's rows were mid-gap: exact rows flushed, sketch coverage
+    # counted out (no silently-contaminated block anywhere)
+    assert by_win[4].count == 2
+    assert by_win[4].sketches is None
+    assert wm.get_counters()["sketch_shed"] == 2
+    # in-span windows keep clean per-window blocks
+    for w in (1, 2, 3, 7):
+        assert by_win[w].sketches.n_updates == by_win[w].count
+
+
+def test_promql_rejects_unbalanced_parens():
+    """Review pin: the topk() regex extension must not let a dropped or
+    extra paren parse silently."""
+    from deepflow_tpu.querier.promql import PromQLError, query_instant
+    from deepflow_tpu.storage.store import ColumnarStore
+    from deepflow_tpu.integration.dfstats import ensure_system_table
+
+    store = ColumnarStore()
+    ensure_system_table(store)
+    for bad in ("topk(5, metric", "sum(metric))", "metric)"):
+        with pytest.raises(PromQLError, match="parenthes"):
+            query_instant(store, bad, T0, db="deepflow_system",
+                          table="deepflow_system")
+    # balanced forms still parse
+    assert query_instant(store, "topk(5, metric)", T0, db="deepflow_system",
+                         table="deepflow_system") == []
