@@ -1,0 +1,216 @@
+"""Rollup-cascade A/B bench (ISSUE 9): double-ingest vs cascade on the
+§14 feeder-shaped dual-granularity workload, plus a long-range query
+benchmark.
+
+Part A — ingest: the same synthetic flow stream (10k 5-tuples, 1s
+cadence with periodic window advances) through
+
+  * `double`  — DoubleIngestPipeline: the pre-ISSUE-9 implementation,
+    a full second device dispatch per batch into a parallel 1m
+    pipeline;
+  * `cascade` — DualGranularityPipeline over the rollup cascade: ONE
+    fused dispatch per batch, the 1m series folded on device from
+    closed 1s windows at each advance.
+
+Reports rec/s, host fetches/batch and device dispatches/batch for
+each; the acceptance criterion is ≥1.5× cascade/double ingest
+throughput on the CPU grid (the double-ingest pays the whole fused
+step twice — sort, fanout, fingerprint — per batch).
+
+Part B — long-range query: a 1h span of per-second rows vs the
+cascade's 1m tier, answered through the querier's tier routing
+(`network` + interval(time, 60) → network_1m). Reports rows scanned
+and wall time per query; the acceptance criterion is tier row count
+≤ span/60 per series.
+
+Protocol + committed CPU numbers: PERF.md §18 (on-chip columns
+reserved). Knobs: CASCADEBENCH_BATCHES, CASCADEBENCH_BATCH,
+CASCADEBENCH_TUPLES, CASCADEBENCH_ADV (batches per window advance),
+CASCADEBENCH_REPS (interleaved reps, median reported),
+CASCADEBENCH_CAP_LOG2, CASCADEBENCH_SPAN_S. Emits one JSON record on
+the last stdout line (bench_all.py c10 re-emits it)."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deepflow_tpu.aggregator.pipeline import (  # noqa: E402
+    DoubleIngestPipeline,
+    DualGranularityPipeline,
+    PipelineConfig,
+)
+from deepflow_tpu.aggregator.window import WindowConfig  # noqa: E402
+from deepflow_tpu.datamodel.batch import FlowBatch  # noqa: E402
+from deepflow_tpu.ingest.replay import SyntheticFlowGen  # noqa: E402
+from deepflow_tpu.utils.spans import SPAN_INGEST_DISPATCH  # noqa: E402
+
+T0 = 1_700_000_040
+
+
+def _ingest_ab(n_batches: int, batch: int, tuples: int) -> dict:
+    gen = SyntheticFlowGen(num_tuples=tuples, seed=7)
+    # warmup stream compiles EVERY code path before timing — the fused
+    # step, the capacity fold, the advance flush, and (for the cascade)
+    # the tier fold + tier flush at a minute close; without it compile
+    # seconds land inside the timing and swamp the A/B
+    warm = [
+        FlowBatch.from_records(gen.records(batch, t))
+        for t in (T0, T0 + 1, T0 + 2, T0 + 30, T0 + 70, T0 + 71)
+    ]
+    # timed stream: the §14 feeder cadence — steady bucket-sized
+    # batches, one window advance per `adv` batches, crossing a minute
+    # boundary mid-run so the cascade's tier close cost is inside the
+    # measurement
+    adv = int(os.environ.get("CASCADEBENCH_ADV", "8"))
+    t_base = T0 + 100
+    batches = [
+        FlowBatch.from_records(gen.records(batch, t_base + i // adv))
+        for i in range(n_batches)
+    ]
+    # capacity holds the full doc-key space of a minute so neither
+    # variant sheds — under overflow the two implementations
+    # legitimately diverge (different rows survive) and the flushed-row
+    # sanity check below would be meaningless
+    cap = 1 << int(os.environ.get("CASCADEBENCH_CAP_LOG2", "14"))
+    cfg = PipelineConfig(window=WindowConfig(capacity=cap), batch_size=batch)
+    reps = int(os.environ.get("CASCADEBENCH_REPS", "3"))
+
+    def run_once(name, mk):
+        pipe = mk(cfg)
+        for fb in warm:
+            pipe.ingest(fb)
+        t0 = time.perf_counter()
+        docs = 0
+        for fb in batches:
+            docs += sum(db.size for _fl, db in pipe.ingest(fb))
+        docs += sum(db.size for _fl, db in pipe.drain())
+        dt = time.perf_counter() - t0
+        if name == "double":
+            fetches = (pipe.second.wm.host_fetches
+                       + pipe.minute.wm.host_fetches)
+            dispatches = (
+                pipe.second.tracer.summary()[SPAN_INGEST_DISPATCH]["count"]
+                + pipe.minute.tracer.summary()[SPAN_INGEST_DISPATCH]["count"]
+            )
+        else:
+            fetches = pipe.pipe.wm.host_fetches
+            dispatches = (
+                pipe.pipe.tracer.summary()[SPAN_INGEST_DISPATCH]["count"]
+            )
+        n_total = len(warm) + n_batches
+        return {
+            "rec_s": round(batch * n_batches / dt, 1),
+            "wall_s": round(dt, 3),
+            "flushed_rows": docs,
+            "host_fetches": fetches,
+            "fetches_per_batch": round(fetches / n_total, 3),
+            "dispatches_per_batch": round(dispatches / n_total, 3),
+        }
+
+    # interleave the variants and report each one's MEDIAN rec_s rep —
+    # the build container's CPU is noisy (±30% rep-to-rep), and an A/B
+    # where one variant eats a contention spike is not a measurement
+    out = {}
+    runs = {"double": [], "cascade": []}
+    for _ in range(reps):
+        for name, mk in (("double", DoubleIngestPipeline),
+                         ("cascade", DualGranularityPipeline)):
+            runs[name].append(run_once(name, mk))
+    for name, rs in runs.items():
+        rs.sort(key=lambda r: r["rec_s"])
+        out[name] = {**rs[len(rs) // 2], "rec_s_reps": [r["rec_s"] for r in rs]}
+    out["speedup_cascade_vs_double"] = round(
+        out["cascade"]["rec_s"] / out["double"]["rec_s"], 3
+    )
+    return out
+
+
+def _query_bench(span_s: int) -> dict:
+    """1h-span range query at 1m step: 1s replay vs tier-selected."""
+    from deepflow_tpu.querier.engine import QueryEngine
+    from deepflow_tpu.storage.store import (
+        ColumnarStore,
+        ColumnSpec,
+        TableSchema,
+    )
+
+    store = ColumnarStore()
+    n_series = 8
+    for name, iv in (("network_1s", 1), ("network_1m", 60)):
+        store.create_table("flow_metrics", TableSchema(
+            name,
+            (ColumnSpec("time", "u4"), ColumnSpec("server_port", "u4"),
+             ColumnSpec("byte_tx", "f4")),
+            partition_s=3600,
+        ))
+        n = span_s // iv
+        t = np.repeat(np.arange(n, dtype=np.uint32) * iv, n_series)
+        store.insert("flow_metrics", name, {
+            "time": t,
+            "server_port": np.tile(
+                np.arange(n_series, dtype=np.uint32), n
+            ),
+            "byte_tx": np.full(n * n_series, float(iv), np.float32),
+        })
+    eng = QueryEngine(store)
+    sql_step = ("select interval(time, 60) as t, server_port, "
+                "Sum(byte_tx) as b from {} group by t, server_port")
+    out = {}
+    for label, table, rows_scanned in (
+        ("replay_1s", "network.1s", span_s * n_series),
+        ("tier_1m", "network", (span_s // 60) * n_series),
+    ):
+        q = sql_step.format(table)
+        eng.execute(q)  # warm the scan cache path
+        t0 = time.perf_counter()
+        res = eng.execute(q)
+        out[label] = {
+            "wall_ms": round((time.perf_counter() - t0) * 1e3, 2),
+            "rows_scanned": rows_scanned,
+            "result_rows": res.rows,
+        }
+    out["rows_ratio"] = round(
+        out["replay_1s"]["rows_scanned"] / out["tier_1m"]["rows_scanned"], 1
+    )
+    out["speedup_tier_vs_replay"] = round(
+        out["replay_1s"]["wall_ms"] / max(out["tier_1m"]["wall_ms"], 1e-3), 2
+    )
+    return out
+
+
+def main():
+    # defaults mirror the §14 feeder workload: ~2k active 5-tuples,
+    # bucket-sized batches, ~4k records/s (one window advance per 8
+    # batches of 512)
+    n_batches = int(os.environ.get("CASCADEBENCH_BATCHES", "384"))
+    batch = int(os.environ.get("CASCADEBENCH_BATCH", "512"))
+    tuples = int(os.environ.get("CASCADEBENCH_TUPLES", "2000"))
+    span_s = int(os.environ.get("CASCADEBENCH_SPAN_S", "3600"))
+    out = {
+        "bench": "cascadebench",
+        "backend": os.environ.get("JAX_PLATFORMS", "default"),
+        "n_batches": n_batches,
+        "batch": batch,
+        "tuples": tuples,
+        "span_s": span_s,
+    }
+    try:
+        out["ingest"] = _ingest_ab(n_batches, batch, tuples)
+        out["query"] = _query_bench(span_s)
+    except Exception as e:  # partial-JSON convention (bench.py stance)
+        out["partial"] = True
+        out["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -482,13 +482,44 @@ def config9(quick: bool):
          partial=rec.get("partial", False), error=rec.get("error"))
 
 
+def config10(quick: bool):
+    """Rollup cascade A/B (ISSUE 9): double-ingest vs cascade on the
+    §14 feeder-shaped dual-granularity workload via
+    bench/cascadebench.py (protocol + committed numbers: PERF.md §18,
+    CASCADEBENCH_r01.json). The vs line is the cascade/double ingest
+    speedup (acceptance ≥1.5× on the CPU grid); the long-range query
+    A/B (1h span at 1s replay vs tier-selected 1m) rides the detail."""
+    import os
+    import subprocess
+
+    env = {**os.environ}
+    if quick:
+        env.update(CASCADEBENCH_BATCHES="32", CASCADEBENCH_REPS="1")
+    out = subprocess.run(
+        [sys.executable, "bench/cascadebench.py"],
+        capture_output=True, text=True, timeout=1800, env=env,
+    )
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    if rec.get("partial"):
+        emit("c10_rollup_cascade", 0, "error", 0, error=rec.get("error"))
+        return
+    ing, q = rec["ingest"], rec["query"]
+    emit("c10_rollup_cascade", ing["cascade"]["rec_s"], "records/s",
+         ing["speedup_cascade_vs_double"],
+         double=ing["double"], cascade=ing["cascade"],
+         query_rows_ratio=q["rows_ratio"],
+         query_speedup=q["speedup_tier_vs_replay"],
+         batch=rec["batch"], n_batches=rec["n_batches"],
+         tuples=rec["tuples"])
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--cpu", action="store_true")
     p.add_argument("--quick", action="store_true")
     args = p.parse_args()
     for fn in (config1, config2, config3, config4, config5, config6, config7,
-               config8, config9):
+               config8, config9, config10):
         try:
             fn(args.quick)
         except Exception as e:  # one config must not kill the others

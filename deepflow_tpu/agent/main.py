@@ -30,6 +30,7 @@ from ..aggregator.fanout import FanoutConfig
 from ..aggregator.pipeline import DualGranularityPipeline, L7Pipeline, PipelineConfig
 from ..aggregator.window import WindowConfig
 from ..datamodel.batch import FlowBatch
+from ..datamodel.code import DocumentFlag
 from ..flowlog.aggr import MinuteAggr, ThrottlingQueue
 from ..flowlog.codec import encode_rows
 from ..ingest.codec import encode_docbatch
@@ -177,8 +178,10 @@ class Agent:
         if self.policy_meters is not None:
             usage = self.policy_meters.flush(now)
             if usage is not None:
-                # traffic_policy docs are minute-granularity
-                self._send_docs(usage, self.metrics.minute.flags)
+                # traffic_policy docs are minute-granularity (NONE =
+                # not PER_SECOND; since ISSUE 9 the dual pipeline has
+                # no separate minute sub-pipeline to borrow flags from)
+                self._send_docs(usage, DocumentFlag.NONE)
         emissions = self.flow_map.tick(now)
         if emissions.size:
             self._ingest_l4(emissions)
@@ -291,7 +294,8 @@ class Agent:
         if self.policy_meters is not None:
             usage = self.policy_meters.flush(1 << 31)
             if usage is not None:
-                self._send_docs(usage, self.metrics.minute.flags)
+                # minute-granularity, same flag stance as the tick path
+                self._send_docs(usage, DocumentFlag.NONE)
         for flags, db in self.metrics.drain():
             self._send_docs(db, flags)
         for db in self.l7_metrics.drain():

@@ -47,11 +47,23 @@ import numpy as np
 
 from .. import chaos
 from ..datamodel.schema import MeterSchema, TagSchema
+from .cascade import (
+    CascadeConfig,
+    pending_block_arrays,
+    restore_pending_blocks,
+)
 from .sketchplane import SketchConfig, SketchState, sketch_init
 from .stash import AccumState, StashState, pack_u32_columns
 from .window import WindowConfig, WindowManager
 
-_VERSION = 4
+# v5 (ISSUE 9): + rollup-cascade tier state — per-tier stash planes
+# (casc_t<i>_packed, same packed-u32 layout as the main stash), host
+# watermarks / device counter lanes in meta, and the open parents'
+# partially-merged sketch blocks (cascblk_* arrays). v4-and-earlier
+# files load with the tiers re-initialized + a LOUD log (open tier
+# windows' partial aggregates restart; the journal replay rebuilds them
+# where it covers the span).
+_VERSION = 5
 _MIN_READ_VERSION = 2  # v2 = pre-digest layout, still loadable
 
 _log = logging.getLogger(__name__)
@@ -113,6 +125,82 @@ def _restore_sketch(meta: dict, arrays: dict, cfg: SketchConfig,
         rows=scal(meta["sketch_rows"], jnp.uint32),
         shed=scal(meta["sketch_shed"], jnp.uint32),
     )
+
+
+def _cascade_save(pending: list[dict], tiers: list, watermarks: list,
+                  lanes_dev, config: CascadeConfig, *, sharded: bool,
+                  tier_windows: int) -> tuple[dict, dict]:
+    """(meta, arrays) for the cascade's tier state (checkpoint v5):
+    per-tier packed stash planes, host watermarks, the device counter
+    lanes and the open parents' partially-merged sketch blocks."""
+    pack = _pack_stash_sharded if sharded else _pack_stash
+    arrays = {
+        f"casc_t{i}_packed": np.asarray(pack(t)) for i, t in enumerate(tiers)
+    }
+    if sharded:
+        for i, t in enumerate(tiers):
+            arrays[f"casc_t{i}_dropped"] = np.asarray(t.dropped_overflow)
+    pend_meta, pend_arrays = pending_block_arrays(pending)
+    arrays.update(pend_arrays)
+    meta = {
+        "cascade": config.meta(),
+        "cascade_watermarks": [int(w) for w in watermarks],
+        "cascade_lanes": np.asarray(lanes_dev).tolist(),
+        "cascade_pending": pend_meta,
+        "cascade_tier_windows": int(tier_windows),
+    }
+    if not sharded:
+        meta["cascade_dropped"] = [
+            int(np.asarray(t.dropped_overflow)) for t in tiers
+        ]
+    return meta, arrays
+
+
+def _restore_cascade_tiers(meta: dict, arrays: dict, config: CascadeConfig,
+                           num_tags: int, path, *, sharded: bool,
+                           sketch_config) -> "tuple[list, list, jnp.ndarray, list[dict]] | None":
+    """→ (tier stashes, watermarks, lanes, pending blocks) from a v5
+    checkpoint, or None (with a LOUD log) when the file predates the
+    cascade — the caller keeps its freshly-initialized tiers and the
+    open tier windows' partial aggregates restart from here."""
+    if "casc_t0_packed" not in arrays:
+        _log.warning(
+            "checkpoint %s (version %s) carries no cascade tier state — "
+            "re-initializing the 1m/1h rollup tiers empty; open tier "
+            "windows' partial aggregates restart from this point",
+            path, meta.get("version"),
+        )
+        return None
+    saved = CascadeConfig.from_meta(meta["cascade"])
+    if saved != config:
+        raise ValueError(
+            f"checkpoint {path} cascade config {saved} != manager cascade "
+            f"config {config} — tier shapes/intervals disagree"
+        )
+    tiers = []
+    for i in range(len(config.intervals)):
+        mat = jnp.asarray(arrays[f"casc_t{i}_packed"])
+        if sharded:
+            tiers.append(_unpack_stash_sharded(
+                mat, jnp.asarray(arrays[f"casc_t{i}_dropped"], jnp.int32),
+                num_tags=num_tags,
+            ))
+        else:
+            tiers.append(_unpack_stash(
+                mat, np.int32(meta["cascade_dropped"][i]), num_tags=num_tags,
+            ))
+    lanes = jnp.asarray(np.asarray(meta["cascade_lanes"], np.uint32))
+    pending: list[dict] = [{} for _ in config.intervals]
+    if meta.get("cascade_pending"):
+        if sketch_config is None:
+            raise ValueError(
+                f"checkpoint {path} holds pending cascade sketch blocks "
+                "but the manager has no sketch config to type them"
+            )
+        restore_pending_blocks(
+            pending, meta["cascade_pending"], arrays, sketch_config
+        )
+    return tiers, list(meta["cascade_watermarks"]), lanes, pending
 
 
 @jax.jit
@@ -348,6 +436,29 @@ def save_window_state(wm: WindowManager, path: str | Path, *, extra_meta=None):
                 )
             arrays.update(_sketch_arrays(wm.sk))
             meta.update(_sketch_meta(wm.sk, wm.config.sketch))
+        if wm.cascade is not None:
+            # v5: tier stashes + watermarks + lanes + pending parent
+            # blocks — settle() above drained every in-flight advance,
+            # so the tier state is exactly the post-advance device
+            # truth. Closed tier windows still held (including any the
+            # settle itself just produced — async_drain can close a
+            # minute during it) are NOT in the snapshot: they left the
+            # tier stash, so they ride the in-flight return and the
+            # CALLER must emit them, exactly the tier-0 contract.
+            in_flight = in_flight + wm.pop_tier_windows()
+            # tier accumulator rings fold into their stashes first —
+            # the same "ring rows must reach the stash before the
+            # snapshot" rule the main ingest ring follows, so the rings
+            # themselves never serialize
+            wm.cascade.settle_rings()
+            c_meta, c_arrays = _cascade_save(
+                wm.cascade.pending_blocks, wm.cascade.tiers,
+                wm.cascade.watermarks, wm.cascade.lanes_dev,
+                wm.config.cascade, sharded=False,
+                tier_windows=wm.cascade.tier_windows_flushed,
+            )
+            meta.update(c_meta)
+            arrays.update(c_arrays)
         if extra_meta:
             meta.update(extra_meta)
         _write_checkpoint(path, meta, arrays)
@@ -357,11 +468,16 @@ def save_window_state(wm: WindowManager, path: str | Path, *, extra_meta=None):
 def load_window_state(
     path: str | Path, tag_schema: TagSchema, meter_schema: MeterSchema,
     *, sketch_config: SketchConfig | None = None,
+    cascade_config: CascadeConfig | None = None,
 ) -> WindowManager:
     """Rebuild a WindowManager from a checkpoint. The sketch plane
     restores from v4 files automatically; `sketch_config` asks for the
     plane explicitly when resuming a pre-v4 file into a sketch-enabled
-    deployment (re-initialized with a loud log — never a crash)."""
+    deployment (re-initialized with a loud log — never a crash). The
+    cascade's tier state restores from v5 files the same way;
+    `cascade_config` asks for the cascade explicitly when resuming a
+    pre-v5 file into a cascade-enabled deployment (tiers re-initialized
+    with a loud log)."""
     meta, arrays = _read_checkpoint(path)
     _check_version(meta, path)
     if meta.get("kind", "window") != "window":
@@ -372,6 +488,8 @@ def load_window_state(
         )
     if sketch_config is None and "sketch" in meta:
         sketch_config = SketchConfig.from_meta(meta["sketch"])
+    if cascade_config is None and "cascade" in meta:
+        cascade_config = CascadeConfig.from_meta(meta["cascade"])
     cfg = WindowConfig(
         interval=meta["interval"],
         delay=meta["delay"],
@@ -381,6 +499,7 @@ def load_window_state(
         stats_ring=meta.get("stats_ring", 1),
         fold_mode=meta.get("fold_mode", "full"),
         sketch=sketch_config,
+        cascade=cascade_config,
     )
     wm = WindowManager(cfg, tag_schema, meter_schema)
     t = tag_schema.num_fields
@@ -414,6 +533,17 @@ def load_window_state(
         wm.sk = _restore_sketch(meta, arrays, cfg.sketch, cfg.ring, path)
         wm.sketch_rows = int(meta.get("sketch_rows", 0))
         wm.sketch_shed = int(meta.get("sketch_shed", 0))
+    if cfg.cascade is not None:
+        got = _restore_cascade_tiers(
+            meta, arrays, cfg.cascade, t, path, sharded=False,
+            sketch_config=cfg.sketch,
+        )
+        if got is not None:
+            casc = wm.cascade
+            casc.tiers, casc.watermarks, casc.lanes_dev, casc.pending_blocks = got
+            casc.tier_windows_flushed = int(meta.get("cascade_tier_windows", 0))
+            wm.cascade_rows = int(meta["cascade_lanes"][0])
+            wm.cascade_shed = int(meta["cascade_lanes"][1])
     # the save settled (ring drained), so the restored host span IS
     # the device gate state — mirror it back onto the device
     wm._sync_device_sw()
@@ -428,10 +558,13 @@ def save_sharded_state(swm, path: str | Path, *, extra_meta=None) -> list:
     """Snapshot a ShardedWindowManager (kind="sharded"). Folds the
     accumulator ring first (sharded flushes are synchronous, so unlike
     async_drain nothing else is deferred), packs every device stash in
-    one vmapped call, and writes sketch planes alongside. Returns []
-    for signature symmetry with save_window_state."""
+    one vmapped call, and writes sketch planes alongside. Returns the
+    held closed tier windows ((interval, DocBatch) pairs — host-side
+    only, NOT in the snapshot; the caller must emit them), [] without a
+    cascade — the save_window_state in-flight contract."""
     from ..utils.spans import SPAN_CHECKPOINT_SAVE
 
+    in_flight: list = []
     with swm.tracer.span(SPAN_CHECKPOINT_SAVE):
         swm._fold()  # ring rows must reach the stash before the snapshot
         arrays = {
@@ -460,10 +593,27 @@ def save_sharded_state(swm, path: str | Path, *, extra_meta=None) -> list:
         }
         meta.update(_sketch_meta(swm.sketches, c.sketch_config()))
         meta["sketch_ring"] = c.sketch_ring
+        if swm._tier_ratios:
+            # held tier windows are host-side only (not in the
+            # snapshot) — return them so the caller emits them, the
+            # same in-flight contract as save_window_state
+            in_flight = swm.pop_tier_docbatches()
+            swm.settle_tier_rings()  # ring rows reach the stash first
+            c_meta, c_arrays = _cascade_save(
+                swm._tier_pending_blocks, swm.tier_stashes,
+                swm.tier_watermarks, swm.cascade_lanes,
+                CascadeConfig(
+                    intervals=swm._cascade_intervals,
+                    capacity=c.cascade_capacity,
+                ),
+                sharded=True, tier_windows=swm.tier_windows_flushed,
+            )
+            meta.update(c_meta)
+            arrays.update(c_arrays)
         if extra_meta:
             meta.update(extra_meta)
         _write_checkpoint(path, meta, arrays)
-    return []
+    return in_flight
 
 
 def restore_sharded_state(swm, path: str | Path):
@@ -537,6 +687,31 @@ def restore_sharded_state(swm, path: str | Path):
     spec = NamedSharding(swm.pipe.mesh, P(swm.pipe.axes))
     swm.stash = jax.tree.map(lambda x: jax.device_put(x, spec), stash)
     swm.sketches = jax.tree.map(lambda x: jax.device_put(x, spec), sketches)
+    if swm._tier_ratios:
+        got = _restore_cascade_tiers(
+            meta, arrays,
+            CascadeConfig(
+                intervals=swm._cascade_intervals,
+                capacity=swm.pipe.config.cascade_capacity,
+            ),
+            t, path, sharded=True, sketch_config=swm._sk_cfg,
+        )
+        if got is not None:
+            tiers, wms, lanes, pending = got
+            swm.tier_stashes = [
+                jax.tree.map(lambda x: jax.device_put(x, spec), ts)
+                for ts in tiers
+            ]
+            # rings settled at save — restore them empty (lazy re-init)
+            swm.tier_accs = [None] * len(tiers)
+            swm.tier_fills = [None] * len(tiers)
+            swm.tier_watermarks = wms
+            swm.cascade_lanes = jax.device_put(lanes, spec)
+            swm._tier_pending_blocks = pending
+            swm.tier_windows_flushed = int(meta.get("cascade_tier_windows", 0))
+            lanes_np = np.asarray(meta["cascade_lanes"], np.int64)
+            swm.cascade_rows = int(lanes_np[:, 0].sum())
+            swm.cascade_shed = int(lanes_np[:, 1].sum())
     swm.acc = None  # re-sized on the first post-restore batch
     swm.fill = 0
     swm._fold_rows_dev = None

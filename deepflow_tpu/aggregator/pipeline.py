@@ -281,6 +281,21 @@ class RollupPipeline:
         self.closed_sketches: list = []
         self.max_held_sketches = 512
         self.sketch_blocks_dropped = 0
+        # rollup-cascade tier outputs (ISSUE 9): merged tier sketch
+        # blocks held for the sketch sink, same bounded stance
+        self.closed_tier_sketches: list = []
+        self.tier_sketch_blocks_dropped = 0
+        if config.window.cascade is not None:
+            # the server's datasource listing reflects which tiers this
+            # cascade serves (dfctl datasource / REST /v1/datasources);
+            # lazy import — the aggregator must not hard-depend on the
+            # server layer
+            from ..server.datasource import register_cascade_tiers
+
+            register_cascade_tiers(
+                self.meter_schema.name, config.window.cascade.intervals,
+                owner=self,
+            )
         # self-telemetry registration (reference RegisterCountable stance:
         # every component registers at construction; weakly held, so
         # short-lived pipelines deregister themselves)
@@ -328,7 +343,7 @@ class RollupPipeline:
             )
 
         def step(acc, offset, start_window, stash_valid, stash_evict,
-                 feeder_shed, fold_rows, sk, tag_mat, meters, valid):
+                 feeder_shed, fold_rows, casc_lanes, sk, tag_mat, meters, valid):
             tags = {k: tag_mat[i] for i, k in enumerate(names)}
             aux = None
             if cap_u is not None:
@@ -351,6 +366,7 @@ class RollupPipeline:
                 feeder_shed=feeder_shed, fold_rows=fold_rows,
                 sketch_rows=None if sk is None else sk.rows,
                 sketch_shed=None if sk is None else sk.shed,
+                cascade_rows=casc_lanes[0], cascade_shed=casc_lanes[1],
             )
             acc = _append_impl(
                 acc, window, hi, lo, doc_tags, doc_meters, gated, offset
@@ -364,13 +380,14 @@ class RollupPipeline:
             # to the pre-ISSUE-8 step: None is not a pytree leaf we want
             # in the dispatch path
             def step_plain(acc, offset, start_window, stash_valid, stash_evict,
-                           feeder_shed, fold_rows, tag_mat, meters, valid):
+                           feeder_shed, fold_rows, casc_lanes, tag_mat,
+                           meters, valid):
                 return step(acc, offset, start_window, stash_valid,
-                            stash_evict, feeder_shed, fold_rows, None,
-                            tag_mat, meters, valid)
+                            stash_evict, feeder_shed, fold_rows, casc_lanes,
+                            None, tag_mat, meters, valid)
 
             return jax.jit(step_plain, donate_argnums=(0,))
-        return jax.jit(step, donate_argnums=(0, 7))
+        return jax.jit(step, donate_argnums=(0, 8))
 
     def _pad_target(self, rows: int) -> int:
         """Static pad size for a batch of `rows`: the smallest bucket
@@ -443,18 +460,19 @@ class RollupPipeline:
         def dispatch(acc, offset, start_window):
             # stash lanes read at dispatch time (post any fold) — device
             # handles, no transfer; they fill the counter block's
-            # occupancy/eviction/fold_rows lanes inside the same fused
-            # call. The sketch plane rides the same dispatch when on.
+            # occupancy/eviction/fold_rows/cascade lanes inside the same
+            # fused call. The sketch plane rides the same dispatch when on.
             st = self.wm.state
+            casc = self.wm._cascade_lanes()
             if self.wm.sk is not None:
                 return self._step(
                     acc, offset, start_window, st.valid, st.dropped_overflow,
-                    shed, self.wm._fold_rows_dev, self.wm.sk,
+                    shed, self.wm._fold_rows_dev, casc, self.wm.sk,
                     staged.tag_mat, staged.meters, staged.valid,
                 )
             return self._step(
                 acc, offset, start_window, st.valid, st.dropped_overflow,
-                shed, self.wm._fold_rows_dev,
+                shed, self.wm._fold_rows_dev, casc,
                 staged.tag_mat, staged.meters, staged.valid,
             )
 
@@ -488,6 +506,31 @@ class RollupPipeline:
         out, self.closed_sketches = self.closed_sketches, []
         return out
 
+    def pop_tier_windows(self) -> list[FlushedWindow]:
+        """Drain the cascade's closed tier windows (ISSUE 9) — raw
+        FlushedWindow form with tier ≥ 1 and the tier interval set."""
+        return self.wm.pop_tier_windows()
+
+    def pop_tier_docbatches(self) -> list[tuple[int, DocBatch]]:
+        """Closed cascade tier windows as (tier_interval_s, DocBatch)
+        pairs, oldest first. Merged tier sketch blocks are captured
+        into `closed_tier_sketches` (a sketch-only tier window — every
+        exact row shed — contributes a block but no DocBatch, the same
+        coverage contract as tier 0)."""
+        from .sketchplane import hold_blocks
+
+        out = []
+        blocks = []
+        for f in self.wm.pop_tier_windows():
+            if f.sketches is not None:
+                blocks.append(f.sketches)
+            if f.count:
+                out.append((f.interval, self._to_docbatch(f)))
+        self.tier_sketch_blocks_dropped += hold_blocks(
+            self.closed_tier_sketches, blocks, self.max_held_sketches
+        )
+        return out
+
     def _to_docbatch(self, f: FlushedWindow) -> DocBatch:
         ts = np.full((f.count,), f.start_time, dtype=np.uint32)
         return DocBatch(
@@ -508,6 +551,8 @@ class RollupPipeline:
         # a rising dropped count means nobody drains pop_closed_sketches
         out["sketch_blocks_held"] = len(self.closed_sketches)
         out["sketch_blocks_dropped"] = self.sketch_blocks_dropped
+        out["tier_sketch_blocks_held"] = len(self.closed_tier_sketches)
+        out["tier_sketch_blocks_dropped"] = self.tier_sketch_blocks_dropped
         return out
 
     def telemetry(self) -> dict:
@@ -537,16 +582,137 @@ class RollupPipeline:
 
 
 class DualGranularityPipeline:
-    """SECOND + MINUTE rollups from one flow stream — the reference runs
-    one SubQuadGen per granularity over the same TaggedFlow queue
-    (MetricsType::SECOND|MINUTE, quadruple_generator.rs:275-298) and the
-    1m docs land in the *.1m tables that feed the downsampler chain
-    (datasource/handle.go 1m→1h→1d).
+    """SECOND + MINUTE rollups from one flow stream — ONE device
+    dispatch per batch (ISSUE 9).
+
+    The reference runs one SubQuadGen per granularity over the same
+    TaggedFlow queue (MetricsType::SECOND|MINUTE,
+    quadruple_generator.rs:275-298) and the 1m docs land in the *.1m
+    tables that feed the downsampler chain (datasource/handle.go
+    1m→1h→1d). The r6–r12 reproduction paid for that with a SECOND full
+    device ingest per batch; this shim instead rides the rollup cascade
+    (aggregator/cascade.py): the minute series is the 1m tier — a
+    device-side fold of closed 1s windows — so dual-granularity traffic
+    costs one fused dispatch per batch plus a per-advance tier fold.
+    The old double-ingest survives as `DoubleIngestPipeline`, kept as
+    the conformance oracle and the cascadebench A/B baseline.
 
     ingest() returns (flags, DocBatch) pairs: PER_SECOND_METRICS for 1s
     windows, NONE for 1m — exactly what encode_docbatch/table routing
-    (metrics_tables.route_table_ids) key off.
+    (metrics_tables.route_table_ids) key off. Minute docs for a minute
+    M surface once every 1s window of M has closed (≈ delay seconds
+    after the minute ends) — earlier than the old minute pipe's
+    minute_delay, never later than the data allows.
+
+    One documented semantic change: minute ADMISSION now equals the 1s
+    delay — a row too late for its second is too late for its minute
+    (the cascade folds closed seconds; there is no separate minute
+    gate). The old pipeline admitted rows up to `minute_delay` late
+    into 1m docs its own 1s tier had already dropped; `minute_delay`
+    stays in the signature for call-site compatibility but only widens
+    nothing. Under identical streams whose lateness stays within the 1s
+    delay, minute meters are bit-exact vs the double-ingest
+    (tests/test_cascade.py pins it, late minute-boundary rows included).
     """
+
+    def __init__(
+        self,
+        config: PipelineConfig = PipelineConfig(),
+        *,
+        minute_delay: int = 10,
+        app: bool = False,
+        cascade: "CascadeConfig | None" = None,
+    ):
+        from .cascade import CascadeConfig
+
+        cls = L7Pipeline if app else L4Pipeline
+        if config.window.cascade is None:
+            # the minute tier keeps the 1s stash's capacity — the same
+            # per-granularity bound the old minute pipe had
+            casc = cascade or CascadeConfig(
+                intervals=(60,), capacity=config.window.capacity
+            )
+            config = dataclasses.replace(
+                config,
+                window=dataclasses.replace(config.window, cascade=casc),
+            )
+        elif cascade is not None and cascade != config.window.cascade:
+            raise ValueError(
+                f"conflicting cascade configs: the window config carries "
+                f"{config.window.cascade} but cascade={cascade} was also "
+                "passed — silently preferring one would drop tiers"
+            )
+        if 60 not in config.window.cascade.intervals:
+            raise ValueError(
+                "DualGranularityPipeline needs a 1m cascade tier (its "
+                f"contract IS the minute series); got intervals="
+                f"{config.window.cascade.intervals}"
+            )
+        self.pipe = cls(config)
+        self.minute_delay = minute_delay  # compat knob — see docstring
+        # coarser-than-minute tier batches (1h…) do NOT ride the
+        # (flags, DocBatch) stream: route_table_ids only distinguishes
+        # PER_SECOND vs NONE, so emitting them there would land hourly
+        # docs in the *_1m tables and double-count the minute series.
+        # They accumulate here for store-side writers (the derived
+        # network_1h tables the datasource listing names).
+        self.coarse_tiers: list[tuple[int, DocBatch]] = []
+
+    # compat alias: telemetry consumers address `.second`
+    @property
+    def second(self) -> RollupPipeline:
+        return self.pipe
+
+    def _tier_docs(self) -> list[tuple[DocumentFlag, DocBatch]]:
+        from .sketchplane import hold_blocks
+
+        out = []
+        coarse = []
+        for interval, db in self.pipe.pop_tier_docbatches():
+            if interval == 60:
+                out.append((DocumentFlag.NONE, db))
+            else:
+                coarse.append((interval, db))
+        # bounded drop-oldest like every other held buffer — an
+        # undrained coarse-tier consumer must not leak a batch per hour
+        hold_blocks(self.coarse_tiers, coarse, 512)
+        return out
+
+    def ingest(self, batch) -> list[tuple[DocumentFlag, DocBatch]]:
+        out = [(self.pipe.flags, db) for db in self.pipe.ingest(batch)]
+        return out + self._tier_docs()
+
+    def drain(self) -> list[tuple[DocumentFlag, DocBatch]]:
+        out = [(self.pipe.flags, db) for db in self.pipe.drain()]
+        return out + self._tier_docs()
+
+    @property
+    def counters(self) -> dict:
+        c = self.pipe.counters
+        # the "minute" face survives for dashboards that key on it; the
+        # minute plane is now the cascade's lanes inside the single
+        # pipeline's counters
+        return {
+            "second": c,
+            "minute": {
+                "cascade_rows": c.get("cascade_rows", 0),
+                "cascade_shed": c.get("cascade_shed", 0),
+                "tier_windows": c.get("cascade_tier_windows", 0),
+            },
+        }
+
+    def telemetry(self) -> dict:
+        t = self.pipe.telemetry()
+        return {"second": t, "minute": {"counters": self.counters["minute"]}}
+
+
+class DoubleIngestPipeline:
+    """The pre-ISSUE-9 dual-granularity implementation: a full second
+    device ingest into a parallel minute pipeline. Kept ONLY as the
+    conformance oracle (tests/test_cascade.py pins cascade 1m meters
+    bit-exact against it) and the cascadebench A/B baseline — new code
+    wants `DualGranularityPipeline`, which produces the same
+    (flags, DocBatch) stream from one dispatch per batch."""
 
     def __init__(
         self,
@@ -558,7 +724,7 @@ class DualGranularityPipeline:
         cls = L7Pipeline if app else L4Pipeline
         self.second = cls(config)
         minute_window = dataclasses.replace(
-            config.window, interval=60, delay=minute_delay
+            config.window, interval=60, delay=minute_delay, cascade=None
         )
         self.minute = cls(dataclasses.replace(config, window=minute_window))
 

@@ -46,6 +46,7 @@ import numpy as np
 from .. import chaos
 from ..datamodel.schema import FLOW_METER, TAG_SCHEMA, MeterSchema, TagSchema
 from ..ops.hashing import fingerprint64
+from .cascade import CascadeConfig, TierCascade, TierFlush
 from .sketchplane import (
     SketchConfig,
     SketchState,
@@ -113,8 +114,12 @@ def host_fetch(x) -> np.ndarray:
 # per-window sketch plane folded (the lane asserting sketch updates
 # actually ran in the fused dispatch) and rows the plane counted-shed
 # (mid-gap jumps, pending-buffer overflow); zero with the plane off.
+# v5 (ISSUE 9): + cascade_rows / cascade_shed — cumulative rows the
+# rollup cascade's tier folds consumed (closed child-window rows merged
+# into 1m/1h tier stashes) and cumulative tier-stash overflow sheds;
+# zero with the cascade off. Rides the same fetch as every other lane.
 
-COUNTER_BLOCK_VERSION = 4
+COUNTER_BLOCK_VERSION = 5
 (
     CB_VERSION,  # constant COUNTER_BLOCK_VERSION
     CB_T_MAX,  # max valid timestamp (pre-gate)
@@ -130,12 +135,15 @@ COUNTER_BLOCK_VERSION = 4
     CB_FOLD_ROWS,  # rows the last fold's keyed sort touched
     CB_SKETCH_ROWS,  # cumulative rows folded into the sketch plane
     CB_SKETCH_SHED,  # cumulative rows the sketch plane counted-shed
-) = range(14)
-CB_LEN = 14
+    CB_CASCADE_ROWS,  # cumulative rows the cascade's tier folds consumed
+    CB_CASCADE_SHED,  # cumulative tier-stash overflow sheds
+) = range(16)
+CB_LEN = 16
 CB_FIELDS = (
     "version", "t_max", "t_min", "n_valid", "n_late", "prereduce_shed",
     "excess_word_hits", "stash_occupancy", "stash_evictions", "ring_fill",
     "feeder_shed", "fold_rows", "sketch_rows", "sketch_shed",
+    "cascade_rows", "cascade_shed",
 )
 
 
@@ -178,6 +186,8 @@ def batch_counter_block(
     fold_rows=None,
     sketch_rows=None,
     sketch_shed=None,
+    cascade_rows=None,
+    cascade_shed=None,
 ):
     """`batch_stats` widened into the versioned counter block (traced).
 
@@ -205,7 +215,8 @@ def batch_counter_block(
             stats,
             jnp.stack([u32(excess_hits), occ, u32(stash_evictions),
                        u32(ring_fill), u32(feeder_shed), u32(fold_rows),
-                       u32(sketch_rows), u32(sketch_shed)]),
+                       u32(sketch_rows), u32(sketch_shed),
+                       u32(cascade_rows), u32(cascade_shed)]),
         ]
     )
     return gated, window, block
@@ -213,17 +224,20 @@ def batch_counter_block(
 
 @partial(jax.jit, donate_argnums=(0,), static_argnames=("interval",))
 def _raw_append_step(acc, offset, start_window, stash_valid, stash_evict,
-                     feeder_shed, fold_rows, timestamp, key_hi, key_lo, tags,
-                     meters, valid, *, interval):
+                     feeder_shed, fold_rows, casc_lanes, timestamp, key_hi,
+                     key_lo, tags, meters, valid, *, interval):
     """One jitted call per raw doc batch: late gate + counter block +
     ring append. `stash_valid`/`stash_evict`/`fold_rows` are
     device-resident lanes folded into the block — inputs already on
     device, no transfer. `feeder_shed` is the feeder's upstream drop
-    count for this batch (a host scalar riding the upload direction)."""
+    count for this batch (a host scalar riding the upload direction);
+    `casc_lanes` the cascade's device [rows, shed] vector (ISSUE 9 —
+    zeros when no cascade is configured)."""
     gated, window, block = batch_counter_block(
         timestamp, valid, start_window, interval,
         stash_valid=stash_valid, stash_evictions=stash_evict, ring_fill=offset,
         feeder_shed=feeder_shed, fold_rows=fold_rows,
+        cascade_rows=casc_lanes[0], cascade_shed=casc_lanes[1],
     )
     acc = _append_impl(acc, window, key_hi, key_lo, tags, meters, gated, offset)
     return acc, block
@@ -338,12 +352,13 @@ def sketch_span_bounds(start_window, ts, valid, *, interval: int, delay: int):
 
 @partial(
     jax.jit,
-    donate_argnums=(0, 7),
+    donate_argnums=(0, 8),
     static_argnames=("interval", "delay", "ix", "spec"),
 )
 def _raw_append_step_sk(acc, offset, start_window, stash_valid, stash_evict,
-                        feeder_shed, fold_rows, sk, timestamp, key_hi, key_lo,
-                        tags, meters, valid, *, interval, delay, ix, spec):
+                        feeder_shed, fold_rows, casc_lanes, sk, timestamp,
+                        key_hi, key_lo, tags, meters, valid,
+                        *, interval, delay, ix, spec):
     """`_raw_append_step` with the per-window sketch plane fused in
     (ISSUE 8): the SAME jit dispatch updates HLL/CMS/histogram/top-K
     slots for every accepted row — key identity is the caller's doc
@@ -371,6 +386,7 @@ def _raw_append_step_sk(acc, offset, start_window, stash_valid, stash_evict,
         stash_valid=stash_valid, stash_evictions=stash_evict, ring_fill=offset,
         feeder_shed=feeder_shed, fold_rows=fold_rows,
         sketch_rows=sk.rows, sketch_shed=sk.shed,
+        cascade_rows=casc_lanes[0], cascade_shed=casc_lanes[1],
     )
     acc = _append_impl(acc, window, key_hi, key_lo, tags, meters, gated, offset)
     return acc, block, sk
@@ -461,14 +477,40 @@ class WindowConfig:
     # on exact-stash capacity (sheds degrade detail, not coverage).
     # None = off (today's exact-only behavior, zero cost).
     sketch: SketchConfig | None = None
+    # Multi-resolution rollup cascade (ISSUE 9): fold closed windows of
+    # THIS manager into bounded coarser tiers (1m/1h) on device instead
+    # of running a second ingest per granularity. Tier closes ride the
+    # advance drain's existing fetches (≤3-fetch budget intact); tier
+    # windows surface via WindowManager.pop_tier_windows(). None = off.
+    cascade: "CascadeConfig | None" = None
 
     def __post_init__(self):
         check_fold_mode(self.fold_mode)
+        if self.cascade is not None:
+            self.cascade.validate_base(self.interval)
 
     @property
     def ring(self) -> int:
         # number of simultaneously-open windows
         return self.delay // self.interval + 2
+
+
+@dataclasses.dataclass
+class _FlushEntry:
+    """One dispatched-but-not-yet-fetched window advance: the packed
+    exact flush handles plus (optionally) the sketch plane's pending
+    blocks and the cascade's closed tier flushes. `_drain_flush` fetches
+    the whole entry in the same two transfers regardless of what rode
+    along."""
+
+    packed: jnp.ndarray  # [S, 3+T+M] u32 device handle
+    total: jnp.ndarray  # scalar i32 device handle
+    lo: int
+    hi: int
+    pend: jnp.ndarray | None = None  # [P, WIDE] u32 (sketch plane on)
+    pend_win: jnp.ndarray | None = None  # [P] u32
+    pend_n: jnp.ndarray | None = None  # scalar i32
+    tiers: list[TierFlush] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -491,6 +533,12 @@ class FlushedWindow:
     # the exact stash shed every row of this window but the sketch tier
     # still covered it (degradation of detail, not of coverage)
     sketches: WindowSketchBlock | None = None
+    # rollup-cascade provenance (ISSUE 9): 0 = the manager's own
+    # resolution; N ≥ 1 = the Nth cascade tier, with `interval` that
+    # tier's seconds-per-window (window_idx and start_time are already
+    # in tier units — consumers never rescale)
+    tier: int = 0
+    interval: int = 0
 
 
 class WindowManager:
@@ -536,6 +584,8 @@ class WindowManager:
         # merge mode drains through the compacting range flush so the
         # stash keeps the canonical layout the rank-merge requires
         self._flush_compact = config.fold_mode == "merge"
+        # cached zero [rows, shed] lane vector (cascade off)
+        self._zero_lanes = jnp.zeros((2,), jnp.uint32)
         # per-window sketch plane (ISSUE 8): device state + the static
         # column-index tuple the fused step closes over; CB-lane mirrors
         self.sk: SketchState | None = None
@@ -548,6 +598,20 @@ class WindowManager:
         if config.sketch is not None:
             self._sketch_ix = sketch_tag_indices(tag_schema, meter_schema)
             self.sk = sketch_init(config.sketch, config.ring)
+        # multi-resolution rollup cascade (ISSUE 9): device tier stashes
+        # + host watermarks/pending sketch merges; CB v5 lane mirrors
+        self.cascade: TierCascade | None = None
+        self.cascade_rows = 0
+        self.cascade_shed = 0
+        # closed tier windows awaiting a consumer (pop_tier_windows) —
+        # bounded drop-oldest-counted like every other held buffer
+        self.tier_flushed: list[FlushedWindow] = []
+        self.max_held_tier_windows = 4096
+        self.tier_windows_dropped = 0
+        if config.cascade is not None:
+            self.cascade = TierCascade(
+                config.cascade, config.interval, tag_schema, meter_schema
+            )
         self.n_advances = 0
         # device↔host transfer accounting (the host_fetch seam)
         self.host_fetches = 0
@@ -601,57 +665,81 @@ class WindowManager:
         return arr
 
     # -- device→host drains ---------------------------------------------
-    def _drain_flush(self, entry) -> list[FlushedWindow]:
+    def _drain_flush(self, entry: "_FlushEntry") -> list[FlushedWindow]:
         """Fetch ONE packed flush result and split it into windows.
 
         Two transfers regardless of row/window count — with the sketch
-        plane enabled the SAME two transfers also carry the closed
-        sketch blocks: the scalar fetch widens to [row count, pending
-        block count] and the row fetch becomes one concatenated u32
-        transfer (flush rows ‖ packed blocks ‖ block window ids), so
-        the ≤3-fetch budget is untouched (tests/test_perf_gate.py)."""
-        if len(entry) == 2:  # exact-only path
-            packed, total_dev = entry
-            total = int(self._fetch(total_dev))
-            if total == 0:
-                return []
-            rows = self._fetch(packed[:total])
-            return self._split_flushed(rows, total)
+        plane and/or the rollup cascade enabled the SAME two transfers
+        also carry the closed sketch blocks and the closed TIER windows'
+        rows: the scalar fetch widens to [row count, pending block
+        count, tier row counts…] and the row fetch becomes one
+        concatenated u32 transfer (flush rows ‖ packed blocks ‖ block
+        window ids ‖ tier rows per tier), so the ≤3-fetch budget is
+        untouched (tests/test_perf_gate.py)."""
+        has_sketch = entry.pend is not None
+        scalars = [jnp.asarray(entry.total, jnp.int32)]
+        if has_sketch:
+            scalars.append(jnp.asarray(entry.pend_n, jnp.int32))
+        scalars += [jnp.asarray(tf.total, jnp.int32) for tf in entry.tiers]
+        if len(scalars) == 1:
+            total, n_blocks, tier_totals = int(self._fetch(scalars[0])), 0, []
+        else:
+            vec = self._fetch(jnp.stack(scalars))
+            o = 2 if has_sketch else 1
+            total = int(vec[0])
+            n_blocks = int(vec[1]) if has_sketch else 0
+            tier_totals = [int(v) for v in vec[o:]]
+        if not has_sketch and not entry.tiers and total == 0:
+            # pure exact-only drain with nothing flushed. The sketch and
+            # cascade paths must NOT return here even with every count
+            # zero: previously-held blocks may still marry this drain's
+            # [lo, hi) range, and a tier window whose exact rows were
+            # all shed (sketch-only coverage) still closes below.
+            return []
+        row_cols = entry.packed.shape[1]
+        wide = entry.pend.shape[1] if has_sketch else 0
+        if total == 0 and n_blocks == 0 and not any(tier_totals):
+            flat = np.zeros((0,), np.uint32)  # nothing to transfer
+        else:
+            parts = [entry.packed[:total].reshape(-1)]
+            if has_sketch:
+                parts += [entry.pend[:n_blocks].reshape(-1),
+                          entry.pend_win[:n_blocks]]
+            for tf, t in zip(entry.tiers, tier_totals):
+                parts.append(tf.packed[:t].reshape(-1))
+            if len(parts) == 1:
+                # nothing rode along — fetch the 2D rows directly (the
+                # reshape+concatenate would compile a kernel per
+                # distinct `total`, a real tax at one advance/second)
+                flat = self._fetch(entry.packed[:total]).reshape(-1)
+            else:
+                flat = self._fetch(jnp.concatenate(parts))
+        o = 0
 
-        packed, total_dev, pend, pend_win, pend_n, lo, hi = entry
-        scal = self._fetch(
-            jnp.stack([jnp.asarray(total_dev, jnp.int32),
-                       jnp.asarray(pend_n, jnp.int32)])
-        )
-        total, n_blocks = int(scal[0]), int(scal[1])
+        def take(n: int) -> np.ndarray:
+            nonlocal o
+            out = flat[o : o + n]
+            o += n
+            return out
+
+        rows = take(total * row_cols).reshape(total, row_cols)
         flushed = []
-        if total or n_blocks:
-            row_cols = packed.shape[1]
-            wide = pend.shape[1]
-            flat = self._fetch(
-                jnp.concatenate([
-                    packed[:total].reshape(-1),
-                    pend[:n_blocks].reshape(-1),
-                    pend_win[:n_blocks],
-                ])
-            )
-            rows = flat[: total * row_cols].reshape(total, row_cols)
-            block_rows = flat[
-                total * row_cols : total * row_cols + n_blocks * wide
-            ].reshape(n_blocks, wide)
-            wins = flat[total * row_cols + n_blocks * wide :]
+        if has_sketch:
+            block_rows = take(n_blocks * wide).reshape(n_blocks, wide)
+            wins = take(n_blocks)
             for blk in unpack_drained(block_rows, wins, self.config.sketch):
                 have = self._sketch_blocks.get(blk.window)
                 self._sketch_blocks[blk.window] = (
                     blk if have is None else have.merge(blk)
                 )
-            if total:
-                flushed = self._split_flushed(rows, total)
+        if total:
+            flushed = self._split_flushed(rows, total)
         # marry blocks to this drain's window range; blocks whose exact
         # rows were all shed become sketch-only windows (count == 0)
         for f in flushed:
             f.sketches = self._sketch_blocks.pop(f.window_idx, None)
         exact_wins = {f.window_idx for f in flushed}
+        lo, hi = entry.lo, entry.hi
         for w in sorted(self._sketch_blocks):
             if lo <= w < hi and w not in exact_wins:
                 blk = self._sketch_blocks.pop(w)
@@ -670,6 +758,23 @@ class WindowManager:
                     )
                 )
         flushed.sort(key=lambda f: f.window_idx)
+        if self.cascade is not None:
+            # this drain's closed child blocks feed the parent merge
+            # BEFORE tier windows are built, so a parent closing in the
+            # same drain sees every child (merge order is immaterial —
+            # the r12 associativity pins)
+            for f in flushed:
+                if f.sketches is not None:
+                    self.cascade.feed_block(0, f.window_idx, f.sketches)
+            tier_wins: list[FlushedWindow] = []
+            for tf, t in zip(entry.tiers, tier_totals):
+                t_rows = take(t * row_cols).reshape(t, row_cols)
+                tier_wins.extend(self.cascade.take_tier_windows(tf, t_rows, t))
+            from .sketchplane import hold_blocks
+
+            self.tier_windows_dropped += hold_blocks(
+                self.tier_flushed, tier_wins, self.max_held_tier_windows
+            )
         return flushed
 
     def _split_flushed(self, rows: np.ndarray, total: int) -> list[FlushedWindow]:
@@ -737,6 +842,23 @@ class WindowManager:
     def window_of(self, timestamp):
         return timestamp // self.config.interval
 
+    def _cascade_lanes(self) -> jnp.ndarray:
+        """Device [rows, shed] vector for the counter block's v5 lanes —
+        the cascade's when configured, a cached zero vector otherwise
+        (same handle every dispatch, so no per-batch upload)."""
+        if self.cascade is not None:
+            return self.cascade.lanes_dev
+        return self._zero_lanes
+
+    def pop_tier_windows(self) -> list[FlushedWindow]:
+        """Drain the cascade's closed tier windows (1m/1h…), oldest
+        first. Each FlushedWindow carries tier ≥ 1 and its tier
+        `interval`; count == 0 with a sketch block attached means the
+        exact tier stash shed the window but the merged child sketches
+        still cover it."""
+        out, self.tier_flushed = self.tier_flushed, []
+        return out
+
     # -- stats processing (the ONE per-batch host sync) ------------------
     def _process_stats(self, stats_dev) -> None:
         """Fetch one batch's packed counter block and replay it through
@@ -793,6 +915,8 @@ class WindowManager:
             # cumulative device scalars — mirror, don't accumulate
             self.sketch_rows = vec[CB_SKETCH_ROWS]
             self.sketch_shed = vec[CB_SKETCH_SHED]
+            self.cascade_rows = vec[CB_CASCADE_ROWS]
+            self.cascade_shed = vec[CB_CASCADE_SHED]
         elif len(vec) == 5:  # legacy [t_max, t_min, n_valid, n_late, aux]
             t_max, t_min, n_valid, n_late, aux = vec
         else:
@@ -839,21 +963,27 @@ class WindowManager:
                     compact=self._flush_compact,
                 )
                 self._pending_flush.append(
-                    self._with_sketch_entry(
+                    self._make_flush_entry(
                         packed, total, self.start_window, new_start
                     )
                 )
                 self.start_window = new_start
                 self.n_advances += 1
 
-    def _with_sketch_entry(self, packed, total, lo: int, hi: int):
-        """Build one _pending_flush entry: the exact flush pair alone,
-        or widened with the sketch plane's pending-drain handles (one
-        extra DISPATCH, zero extra fetches — _drain_flush bundles)."""
-        if self.sk is None:
-            return (packed, total)
-        self.sk, pend, pend_win, pend_n = sketch_drain(self.sk, np.uint32(hi))
-        return (packed, total, pend, pend_win, pend_n, lo, hi)
+    def _make_flush_entry(self, packed, total, lo: int, hi: int) -> "_FlushEntry":
+        """Build one _pending_flush entry: the exact flush handles,
+        widened with the sketch plane's pending-drain handles and the
+        cascade's tier fold+flush handles (extra DISPATCHES on the
+        advance path only, zero extra fetches — _drain_flush bundles
+        everything into the existing two transfers)."""
+        entry = _FlushEntry(packed=packed, total=total, lo=int(lo), hi=int(hi))
+        if self.sk is not None:
+            self.sk, entry.pend, entry.pend_win, entry.pend_n = sketch_drain(
+                self.sk, np.uint32(hi)
+            )
+        if self.cascade is not None:
+            entry.tiers = self.cascade.on_advance(packed, total, int(hi))
+        return entry
 
     # -- ingest ----------------------------------------------------------
     def ingest(
@@ -885,7 +1015,8 @@ class WindowManager:
                 st = self.state
                 return _raw_append_step_sk(
                     acc, offset, start_window, st.valid, st.dropped_overflow,
-                    jnp.uint32(feeder_shed), self._fold_rows_dev, self.sk,
+                    jnp.uint32(feeder_shed), self._fold_rows_dev,
+                    self._cascade_lanes(), self.sk,
                     timestamp, key_hi, key_lo, tags, meters, valid,
                     interval=interval, delay=self.config.delay,
                     ix=self._sketch_ix, spec=self.config.sketch.hist,
@@ -900,6 +1031,7 @@ class WindowManager:
                 return _raw_append_step(
                     acc, offset, start_window, st.valid, st.dropped_overflow,
                     jnp.uint32(feeder_shed), self._fold_rows_dev,
+                    self._cascade_lanes(),
                     timestamp, key_hi, key_lo, tags, meters, valid,
                     interval=interval,
                 )
@@ -1046,7 +1178,7 @@ class WindowManager:
             self.state, np.uint32(0), _U32_MAX, compact=self._flush_compact
         )
         self._pending_flush.append(
-            self._with_sketch_entry(packed, total, 0, int(_U32_MAX))
+            self._make_flush_entry(packed, total, 0, int(_U32_MAX))
         )
         flushed += self._settle_ready()
         for f in flushed:
@@ -1102,6 +1234,16 @@ class WindowManager:
             # actually ran inside the fused dispatch
             "sketch_rows": self.sketch_rows,
             "sketch_shed": self.sketch_shed,
+            # rollup-cascade lanes (ISSUE 9, CB v5): cumulative closed
+            # child rows the tier folds consumed / tier-stash overflow
+            # sheds, as of the last fetched block; plus the host-side
+            # tier-window accounting (held > 0 and rising dropped means
+            # nobody drains pop_tier_windows)
+            "cascade_rows": self.cascade_rows,
+            "cascade_shed": self.cascade_shed,
+            "tier_windows_held": len(self.tier_flushed),
+            "tier_windows_dropped": self.tier_windows_dropped,
+            **(self.cascade.get_counters() if self.cascade is not None else {}),
         }
 
     @property

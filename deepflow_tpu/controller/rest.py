@@ -167,6 +167,11 @@ class RestServer:
                 ]
             )
         elif u.path == "/v1/datasources":
+            # store-side downsampler jobs + the tiers the rollup cascade
+            # serves natively on device (ISSUE 9) — one listing so the
+            # operator sees every granularity and who materializes it
+            from ..server.datasource import list_cascade_tiers
+
             h._json(
                 [
                     {
@@ -174,9 +179,11 @@ class RestServer:
                         "base_table": d.base_table,
                         "interval": d.interval,
                         "retention_hours": d.retention_hours,
+                        "served_by": "downsampler",
                     }
                     for d in df.downsampler.list()
                 ]
+                + list_cascade_tiers()
             )
         elif u.path == "/v1/counters":
             from ..utils.stats import default_collector

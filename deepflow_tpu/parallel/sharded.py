@@ -129,9 +129,22 @@ class ShardedConfig:
     # the sorted accumulator against the standing stash order and
     # span-bounds the advance fold. Bit-exact (tests/test_merge_fold.py).
     fold_mode: str = "full"
+    # multi-resolution rollup cascade (ISSUE 9): coarser-tier intervals
+    # maintained PER DEVICE as folds of that device's closed windows
+    # (host-merge at drain — the same per-device-exact stance as tier
+    # 0); () = off. Tier flush rows ride the advance drain's bundled
+    # transfers, so the ≤3-fetch budget is unchanged.
+    cascade: tuple[int, ...] = ()
+    cascade_capacity: int = 1 << 12
 
     def __post_init__(self):
         check_fold_mode(self.fold_mode)
+        if self.cascade:
+            from ..aggregator.cascade import CascadeConfig
+
+            CascadeConfig(
+                intervals=self.cascade, capacity=self.cascade_capacity
+            ).validate_base(self.interval)
 
     def sketch_config(self) -> SketchConfig:
         return SketchConfig(
@@ -161,6 +174,9 @@ class ShardedPipeline:
         self._flush = self._build_flush()
         self._flush_range = self._build_flush_range()
         self._sketch_drain = self._build_sketch_drain()
+        # per-ratio tier-fold kernels (ISSUE 9), built on first use —
+        # the cascade fires only on window advances
+        self._tier_fold_cache: dict[int, object] = {}
 
     # -- state ----------------------------------------------------------
     def init_state(self) -> tuple[StashState, SketchPlanes]:
@@ -485,6 +501,125 @@ class ShardedPipeline:
             jnp.asarray(hi_window, dtype=jnp.uint32),
         )
 
+    # -- rollup cascade (ISSUE 9) ---------------------------------------
+    def init_tier_state(self) -> tuple[list[StashState], jnp.ndarray]:
+        """Per-device tier stashes (one per cascade interval) + the
+        per-device [D, 2] cascade counter lanes, replicated/sharded like
+        every other device plane."""
+        c = self.config
+        d = self.n_devices
+        spec = NamedSharding(self.mesh, P(self.axes))
+
+        def shard(x):
+            return jax.device_put(
+                jnp.broadcast_to(x[None], (d,) + x.shape), spec
+            )
+
+        tiers = [
+            jax.tree.map(
+                shard, stash_init(c.cascade_capacity, TAG_SCHEMA, FLOW_METER)
+            )
+            for _ in c.cascade
+        ]
+        lanes = jax.device_put(jnp.zeros((d, 2), jnp.uint32), spec)
+        return tiers, lanes
+
+    def init_tier_acc(self, child_rows: int) -> tuple[AccumState, jnp.ndarray]:
+        """Per-device tier accumulator ring + [D] fill cursors (the
+        cascade's append/amortize ring — aggregator/cascade.tier_step),
+        sized to the child stash."""
+        d = self.n_devices
+        spec = NamedSharding(self.mesh, P(self.axes))
+        acc = accum_init(child_rows, TAG_SCHEMA, FLOW_METER)
+        acc = jax.tree.map(
+            lambda x: jax.device_put(
+                jnp.broadcast_to(x[None], (d,) + x.shape), spec
+            ),
+            acc,
+        )
+        fills = jax.device_put(jnp.zeros((d,), jnp.int32), spec)
+        return acc, fills
+
+    def tier_step_fn(self, ratio: int):
+        """shard_map'd cascade tier step for one child→parent ratio:
+        (tier_stash [D,…], acc [D,…], fill [D], lanes [D, 2], packed
+        [D, S, 3+T+M], total [D], hi) → (tier_stash, acc, fill, lanes).
+        One jitted kernel per ratio, cached — the same append-or-fold
+        step as the single-chip cascade (tier_step), run independently
+        per device (exact tiers never merge across devices; cross-shard
+        aggregation stays a query-layer concern, the tier-0 stance)."""
+        fn = self._tier_fold_cache.get(("step", ratio))
+        if fn is not None:
+            return fn
+        from ..aggregator.cascade import _tier_step_impl, tier_prefix
+
+        sum_cols = tuple(int(i) for i in np.nonzero(FLOW_METER.sum_mask)[0])
+        max_cols = tuple(int(i) for i in np.nonzero(FLOW_METER.max_mask)[0])
+        nt = TAG_SCHEMA.num_fields
+
+        def dev(tier, acc, fill, lanes, packed, total, hi):
+            tier1 = jax.tree.map(lambda x: x[0], tier)
+            acc1 = jax.tree.map(lambda x: x[0], acc)
+            new_tier, new_acc, new_fill, new_lanes = _tier_step_impl(
+                tier1, acc1, fill[0], lanes[0], packed[0], total[0], hi,
+                ratio=ratio, num_tags=nt,
+                sum_cols_t=sum_cols, max_cols_t=max_cols,
+                prefix=tier_prefix(packed.shape[1]),
+            )
+            expand = lambda x: x[None]
+            return (
+                jax.tree.map(expand, new_tier),
+                jax.tree.map(expand, new_acc),
+                new_fill[None], new_lanes[None],
+            )
+
+        pspec = P(self.axes)
+        mapped = shard_map(
+            dev,
+            mesh=self.mesh,
+            in_specs=(pspec, pspec, pspec, pspec, pspec, pspec, P()),
+            out_specs=(pspec, pspec, pspec, pspec),
+        )
+        fn = jax.jit(mapped, donate_argnums=(0, 1, 3))
+        self._tier_fold_cache[("step", ratio)] = fn
+        return fn
+
+    def tier_ring_fold_fn(self):
+        """shard_map'd tier ring fold: merge each device's tier
+        accumulator into its tier stash (runs before every tier flush
+        and at checkpoint — the settle rule)."""
+        fn = self._tier_fold_cache.get("ring_fold")
+        if fn is not None:
+            return fn
+        from ..aggregator.cascade import _ring_fold_impl
+
+        sum_cols = tuple(int(i) for i in np.nonzero(FLOW_METER.sum_mask)[0])
+        max_cols = tuple(int(i) for i in np.nonzero(FLOW_METER.max_mask)[0])
+
+        def dev(tier, acc, lanes):
+            tier1 = jax.tree.map(lambda x: x[0], tier)
+            acc1 = jax.tree.map(lambda x: x[0], acc)
+            new_tier, new_acc, new_lanes = _ring_fold_impl(
+                tier1, acc1, lanes[0], sum_cols, max_cols
+            )
+            expand = lambda x: x[None]
+            return (
+                jax.tree.map(expand, new_tier),
+                jax.tree.map(expand, new_acc),
+                new_lanes[None],
+            )
+
+        pspec = P(self.axes)
+        mapped = shard_map(
+            dev,
+            mesh=self.mesh,
+            in_specs=(pspec, pspec, pspec),
+            out_specs=(pspec, pspec, pspec),
+        )
+        fn = jax.jit(mapped, donate_argnums=(0, 1, 2))
+        self._tier_fold_cache["ring_fold"] = fn
+        return fn
+
 
 class ShardedWindowManager:
     """Host-driven window controller for the mesh path — the sharded twin
@@ -531,6 +666,38 @@ class ShardedWindowManager:
         self.max_held_sketches = 512
         self.sketch_blocks_closed = 0
         self.sketch_blocks_dropped = 0
+        # rollup cascade (ISSUE 9): per-device tier stashes + watermarks
+        # + the [D, 2] device counter lanes; host mirrors ride the
+        # advance drain's bundled totals fetch
+        self._cascade_intervals = tuple(pipe.config.cascade)
+        self.tier_stashes: list = []
+        self.tier_accs: list = []
+        self.tier_fills: list = []
+        self.tier_watermarks: list[int] = []
+        self._tier_ratios: list[int] = []
+        self.cascade_lanes = None
+        self.cascade_rows = 0
+        self.cascade_shed = 0
+        self._tier_pending_blocks: list[dict] = []
+        self.tier_flushed: list = []  # [(interval_s, DocBatch)]
+        self.max_held_tier_windows = 4096
+        self.tier_windows_dropped = 0
+        self.tier_windows_flushed = 0
+        self.closed_tier_sketches: list = []
+        self.tier_sketch_blocks_dropped = 0
+        if self._cascade_intervals:
+            res = (self.interval,) + self._cascade_intervals
+            self._tier_ratios = [
+                res[i + 1] // res[i] for i in range(len(self._cascade_intervals))
+            ]
+            self.tier_stashes, self.cascade_lanes = pipe.init_tier_state()
+            self.tier_accs = [None] * len(self._cascade_intervals)
+            self.tier_fills = [None] * len(self._cascade_intervals)
+            self.tier_watermarks = [0] * len(self._cascade_intervals)
+            self._tier_pending_blocks = [{} for _ in self._cascade_intervals]
+            from ..server.datasource import register_cascade_tiers
+
+            register_cascade_tiers("flow", self._cascade_intervals, owner=self)
         # device↔host transfer accounting through the shared host_fetch
         # seam (aggregator/window.py) — the perf gate shims that seam
         # and asserts the per-ingest budget on this path too
@@ -606,6 +773,15 @@ class ShardedWindowManager:
             "sketch_blocks_closed": self.sketch_blocks_closed,
             "sketch_blocks_held": len(self.closed_sketches),
             "sketch_blocks_dropped": self.sketch_blocks_dropped,
+            # rollup-cascade lanes (ISSUE 9): summed-over-devices rows
+            # the tier folds consumed / tier-stash sheds (mirrored at
+            # advance drains via the bundled totals fetch), plus the
+            # host-side tier-window accounting
+            "cascade_rows": self.cascade_rows,
+            "cascade_shed": self.cascade_shed,
+            "cascade_tier_windows": self.tier_windows_flushed,
+            "tier_windows_held": len(self.tier_flushed),
+            "tier_windows_dropped": self.tier_windows_dropped,
         }
 
     def pop_closed_sketches(self) -> list:
@@ -665,37 +841,126 @@ class ShardedWindowManager:
             self.sketches, hi
         )
         d = self.pipe.n_devices
-        # fold_rows + sketch pend counts ride the totals fetch — one
-        # [3D] scalar vector, zero additional host syncs
+        # rollup cascade (ISSUE 9): fold this drain's packed flush rows
+        # into the per-device tier stashes and flush every tier window
+        # that closed — pure dispatches; outputs join the two bundled
+        # transfers below. Each entry: (tier idx, interval, packed
+        # [D, St, C], totals [D], lo_t, hi_t).
+        #
+        # TWIN CONTRACT with TierCascade.on_advance (cascade.py): this
+        # loop mirrors it over [D]-shaped state — lazy ring sizing with
+        # a pre-growth fold, tier_step, the hi_t <= watermark early
+        # break, the MANDATORY ring fold before every tier flush, and
+        # tier chaining. A semantic change to either loop must land in
+        # both (the kernels themselves are already shared).
+        tier_flushes = []
+        if self._tier_ratios:
+            src, src_total, src_hi = packed, totals, int(hi)
+            for i, ratio in enumerate(self._tier_ratios):
+                from ..aggregator.cascade import tier_ring_rows
+
+                child_rows = src.shape[1]
+                ring_rows = tier_ring_rows(child_rows)
+                if (self.tier_accs[i] is None
+                        or self.tier_accs[i].slot.shape[1] < ring_rows):
+                    if self.tier_accs[i] is not None:
+                        # fold pending rows before replacing the ring
+                        (self.tier_stashes[i], _old,
+                         self.cascade_lanes) = self.pipe.tier_ring_fold_fn()(
+                            self.tier_stashes[i], self.tier_accs[i],
+                            self.cascade_lanes,
+                        )
+                    self.tier_accs[i], self.tier_fills[i] = (
+                        self.pipe.init_tier_acc(ring_rows)
+                    )
+                step_fn = self.pipe.tier_step_fn(ratio)
+                (self.tier_stashes[i], self.tier_accs[i],
+                 self.tier_fills[i], self.cascade_lanes) = step_fn(
+                    self.tier_stashes[i], self.tier_accs[i],
+                    self.tier_fills[i], self.cascade_lanes,
+                    src, src_total, jnp.uint32(src_hi),
+                )
+                hi_t = src_hi // ratio
+                if hi_t <= self.tier_watermarks[i]:
+                    break  # nothing closed here → nothing deeper either
+                # flushed parents must see every appended child row
+                (self.tier_stashes[i], self.tier_accs[i],
+                 self.cascade_lanes) = self.pipe.tier_ring_fold_fn()(
+                    self.tier_stashes[i], self.tier_accs[i],
+                    self.cascade_lanes,
+                )
+                self.tier_fills[i] = jax.tree.map(
+                    jnp.zeros_like, self.tier_fills[i]
+                )
+                lo_t = self.tier_watermarks[i]
+                self.tier_stashes[i], t_packed, t_totals = self.pipe.flush_range(
+                    self.tier_stashes[i], np.uint32(lo_t), np.uint32(hi_t)
+                )
+                tier_flushes.append(
+                    (i, self._cascade_intervals[i], t_packed, t_totals,
+                     lo_t, hi_t)
+                )
+                self.tier_watermarks[i] = hi_t
+                src, src_total, src_hi = t_packed, t_totals, hi_t
+        # fold_rows + sketch pend counts + cascade lanes + tier totals
+        # ride the totals fetch — ONE scalar vector, zero additional
+        # host syncs regardless of tier count
         fr_dev = self._fold_rows_dev
         if fr_dev is None:
             fr_dev = jnp.zeros((d,), jnp.uint32)
-        bundled = self._fetch(
-            jnp.concatenate(
-                [totals, fr_dev.astype(jnp.int32), pend_n.astype(jnp.int32)]
-            )
-        )  # [3D]
+        scal_parts = [totals, fr_dev.astype(jnp.int32),
+                      pend_n.astype(jnp.int32)]
+        if self._tier_ratios:
+            scal_parts.append(self.cascade_lanes.astype(jnp.int32).reshape(-1))
+        scal_parts += [tf[3] for tf in tier_flushes]
+        bundled = self._fetch(jnp.concatenate(scal_parts))
         totals_np = bundled[:d]
         self.fold_rows = int(bundled[d : 2 * d].sum())
-        pend_np = bundled[2 * d :]
+        pend_np = bundled[2 * d : 3 * d]
+        o = 3 * d
+        if self._tier_ratios:
+            lanes_np = bundled[o : o + 2 * d].reshape(d, 2)
+            self.cascade_rows = int(lanes_np[:, 0].sum())
+            self.cascade_shed = int(lanes_np[:, 1].sum())
+            o += 2 * d
+        tier_totals_np = [bundled[o + j * d : o + (j + 1) * d]
+                          for j in range(len(tier_flushes))]
         max_t = int(totals_np.max())
         max_p = int(pend_np.max())
-        if max_t == 0 and max_p == 0:
+        tier_max = [int(t.max()) for t in tier_totals_np]
+        if max_t == 0 and max_p == 0 and not tier_flushes:
+            # nothing flushed and no tier closed. With tier_flushes
+            # non-empty the drain must continue even when every count
+            # is zero: the watermarks already advanced, so a tier
+            # window whose exact rows were all shed (sketch-only
+            # coverage) must release its merged parent block NOW or it
+            # leaks forever.
             return []
         row_cols = packed.shape[2]
         wide = pend.shape[2]
-        flat = self._fetch(
-            jnp.concatenate([
+        if max_t == 0 and max_p == 0 and not any(tier_max):
+            flat = np.zeros((0,), np.uint32)  # nothing to transfer
+        else:
+            flat_parts = [
                 packed[:, :max_t].reshape(-1),
                 pend[:, :max_p].reshape(-1),
                 pend_win[:, :max_p].reshape(-1),
-            ])
-        )
+            ]
+            for (_, _, t_packed, _, _, _), tm in zip(tier_flushes, tier_max):
+                flat_parts.append(t_packed[:, :tm].reshape(-1))
+            flat = self._fetch(jnp.concatenate(flat_parts))
         nb = d * max_t * row_cols
         npend = d * max_p * wide
         block = flat[:nb].reshape(d, max_t, row_cols)
         pend_rows = flat[nb : nb + npend].reshape(d, max_p, wide)
-        pend_wins = flat[nb + npend :].reshape(d, max_p)
+        pend_wins = flat[nb + npend : nb + npend + d * max_p].reshape(d, max_p)
+        tier_blocks = []
+        to = nb + npend + d * max_p
+        for tm in tier_max:
+            tier_blocks.append(
+                flat[to : to + d * tm * row_cols].reshape(d, tm, row_cols)
+            )
+            to += d * tm * row_cols
         merged: dict[int, object] = {}
         for dev in range(d):
             n = int(pend_np[dev])
@@ -709,32 +974,115 @@ class ShardedWindowManager:
         self.sketch_blocks_dropped += hold_blocks(
             self.closed_sketches, ordered, self.max_held_sketches
         )
+        if self._tier_ratios:
+            # closed child blocks feed the parent merge BEFORE tier
+            # windows are built, so a parent closing in this same drain
+            # sees every child (merge order immaterial — r12 pins)
+            for blk in ordered:
+                self._feed_tier_block(0, blk.window, blk)
+            self._take_tier_windows(tier_flushes, tier_totals_np, tier_blocks)
         if max_t == 0:
             return []
         per_dev = [
             unpack_flush_rows(block[d, : int(t)], TAG_SCHEMA.num_fields)
             for d, t in enumerate(totals_np)
         ]
+        flushed = self._group_rows_by_window(per_dev, self.interval)
+        for db in flushed:
+            self.total_flushed += db.size
+        return flushed
+
+    def _group_rows_by_window(self, per_dev, interval: int):
+        """Device-major regroup of unpacked flush rows into per-window
+        DocBatches — the same row order the per-window flush_window loop
+        produced. Shared by the tier-0 drain and the cascade tiers."""
+        from ..datamodel.batch import DocBatch
+        from ..datamodel.schema import FLOW_METER, TAG_SCHEMA
+
         flushed = []
         for w in sorted({int(w) for win, *_ in per_dev for w in np.unique(win)}):
-            # device-major concat within the window — the same row order
-            # the per-window flush_window loop produced
             tag_parts = [tags[win == w] for win, _, _, tags, _ in per_dev]
             met_parts = [met[win == w] for win, _, _, _, met in per_dev]
             tags_out = np.concatenate(tag_parts)
             n = tags_out.shape[0]
-            self.total_flushed += n
             flushed.append(
                 DocBatch(
                     tags=tags_out,
                     meters=np.concatenate(met_parts),
-                    timestamp=np.full((n,), w * self.interval, dtype=np.uint32),
+                    timestamp=np.full((n,), w * interval, dtype=np.uint32),
                     valid=np.ones((n,), dtype=bool),
                     tag_schema=TAG_SCHEMA,
                     meter_schema=FLOW_METER,
                 )
             )
         return flushed
+
+    def _feed_tier_block(self, tier: int, window: int, blk) -> None:
+        """Merge one closed child block into its parent's pending merge
+        (the single-chip TierCascade.feed_block twin — the shared
+        merge_into_parent helper keeps the two paths one semantics)."""
+        from ..aggregator.cascade import merge_into_parent
+
+        if tier >= len(self._tier_ratios):
+            return
+        merge_into_parent(
+            self._tier_pending_blocks[tier], window,
+            self._tier_ratios[tier], blk,
+        )
+
+    def _take_tier_windows(self, tier_flushes, tier_totals_np, tier_blocks):
+        """Fetched tier rows → per-window tier DocBatches (host-merged
+        across devices, window order) + the parents' merged sketch
+        blocks; closed tier blocks cascade one level up."""
+        from ..aggregator.stash import unpack_flush_rows as _unpack
+
+        for (i, interval, _p, _t, lo_t, hi_t), t_np, rows in zip(
+            tier_flushes, tier_totals_np, tier_blocks
+        ):
+            per_dev = [
+                _unpack(rows[dev, : int(t)], TAG_SCHEMA.num_fields)
+                for dev, t in enumerate(t_np)
+            ]
+            batches = self._group_rows_by_window(per_dev, interval)
+            self.tier_windows_flushed += len(batches)
+            self.tier_windows_dropped += hold_blocks(
+                self.tier_flushed, [(interval, db) for db in batches],
+                self.max_held_tier_windows,
+            )
+            # marry + release this range's merged parent blocks
+            pend = self._tier_pending_blocks[i]
+            closed_blocks = []
+            for w in sorted(pend):
+                if lo_t <= w < hi_t:
+                    closed_blocks.append(pend.pop(w))
+            for blk in closed_blocks:
+                self._feed_tier_block(i + 1, blk.window, blk)
+            self.tier_sketch_blocks_dropped += hold_blocks(
+                self.closed_tier_sketches, closed_blocks,
+                self.max_held_sketches,
+            )
+
+    def pop_tier_docbatches(self) -> list:
+        """Drain the cascade's closed tier windows as (tier_interval_s,
+        DocBatch) pairs, oldest first (ISSUE 9). Merged tier sketch
+        blocks accumulate in `closed_tier_sketches`."""
+        out, self.tier_flushed = self.tier_flushed, []
+        return out
+
+    def settle_tier_rings(self) -> None:
+        """Fold every tier accumulator ring into its stash (checkpoint
+        rule — ring rows must reach the stash before a snapshot, so the
+        rings never serialize)."""
+        for i in range(len(self.tier_stashes)):
+            if self.tier_accs[i] is not None:
+                (self.tier_stashes[i], self.tier_accs[i],
+                 self.cascade_lanes) = self.pipe.tier_ring_fold_fn()(
+                    self.tier_stashes[i], self.tier_accs[i],
+                    self.cascade_lanes,
+                )
+                self.tier_fills[i] = jax.tree.map(
+                    jnp.zeros_like, self.tier_fills[i]
+                )
 
     def ingest(self, tags, meters, valid):
         """Feed one flow batch (leading dim divisible by device count);

@@ -79,7 +79,7 @@ class QueryEngine:
         q = parse(sql)
         if isinstance(q, Show):
             return self._run_show(q)
-        db, table = self._resolve_table(q.table)
+        db, table = self._resolve_table(q.table, step=_requested_step(q))
         schema = self.store.schema(db, table)
         colnames = set(schema.column_names())
 
@@ -167,7 +167,7 @@ class QueryEngine:
         return self._run_plain(q, ctx, schema)
 
     # -- helpers --------------------------------------------------------
-    def _resolve_table(self, name: str) -> tuple[str, str]:
+    def _resolve_table(self, name: str, step: int | None = None) -> tuple[str, str]:
         # accept db.table / table.granularity / bare table
         cand = name.replace(".", "_")
         parts = name.split(".", 1)
@@ -178,6 +178,23 @@ class QueryEngine:
                     return db, t
             if cand in self.store.tables(db):
                 return db, cand
+        # tier selection (ISSUE 9): a BARE family name ("network")
+        # resolves to the coarsest granularity table that satisfies the
+        # query's interval step, so month-scale range queries read the
+        # cascade's bounded 1m/1h tiers instead of replaying 1s rows.
+        # Explicit granularities ("network.1s") never reroute — they
+        # resolved above.
+        from .translation import TIER_SUFFIX_S, select_datasource_tier
+
+        for db in self.store.databases():
+            avail = {}
+            for suffix, s in TIER_SUFFIX_S.items():
+                t = f"{cand}_{suffix}"
+                if t in self.store.tables(db):
+                    avail[t] = s
+            pick = select_datasource_tier(avail, step)
+            if pick is not None:
+                return db, pick
         raise SQLError(f"no such table {name!r}")
 
     def _expand(self, table: str, expr, in_agg: bool = False):
@@ -789,6 +806,28 @@ def _expr_name(e) -> str:
     if isinstance(e, InList):
         return f"{_expr_name(e.expr)} in (...)"
     return str(e)
+
+
+def _requested_step(q: Query) -> int | None:
+    """The query's time-bucket step from a GROUP BY interval(time, N)
+    (pre-expansion AST; GROUP BY may name a select alias of the
+    interval expression) — the tier-selection input: a query bucketing
+    at ≥60s never needs sub-minute rows."""
+    aliases = {it.alias: it.expr for it in q.select if it.alias}
+    for e in q.group_by:
+        if isinstance(e, Ident):
+            e = aliases.get(e.name, e)
+        if (
+            isinstance(e, Func)
+            and e.name == "interval"
+            and len(e.args) == 2
+            and isinstance(e.args[1], Literal)
+        ):
+            try:
+                return int(e.args[1].value)
+            except (TypeError, ValueError):
+                return None
+    return None
 
 
 def _time_range(where) -> tuple[int, int] | None:

@@ -40,6 +40,43 @@ _ENUMS = {
 
 FLOW_TAG_DB = "flow_tag"
 
+# -- datasource tier selection (ISSUE 9) ------------------------------------
+# The rollup cascade maintains bounded 1m/1h tiers alongside the 1s
+# tables; the datasource manager materializes more. A range query whose
+# step is coarse should read the COARSEST tier whose resolution
+# satisfies the step instead of replaying 1s rows — a month at 1h
+# resolution is ~720 tier rows per series, not 2.6M second rows. The
+# querier routes a BARE family name ("network") through here; an
+# explicit granularity ("network.1s") stays pinned.
+
+TIER_SUFFIX_S = {"1s": 1, "1m": 60, "1h": 3600, "1d": 86400}
+
+
+def select_datasource_tier(
+    available: dict[str, int], step: int | None
+) -> str | None:
+    """Pick a table from `available` ({table_name: interval_s}).
+
+    The coarsest tier whose interval both fits within and divides
+    `step` wins (divisibility keeps output buckets aligned with tier
+    rows — a 90s step over a 1m tier would split tier rows across
+    buckets). step None (no interval grouping) reads the finest tier:
+    detail queries must not silently coarsen. A step FINER than every
+    available tier returns None — answering a 30s-bucket query from
+    60s rows would produce a silently wrong series, so the caller's
+    no-such-table error is the correct outcome."""
+    if not available:
+        return None
+    by_interval = sorted(available.items(), key=lambda kv: kv[1])
+    if step is None:
+        return by_interval[0][0]
+    if by_interval[0][1] > step:
+        return None  # even the finest tier is coarser than the step
+    fits = [
+        (name, s) for name, s in by_interval if s <= step and step % s == 0
+    ]
+    return (fits[-1] if fits else by_interval[0])[0]
+
 
 class Translator:
     def __init__(self, store):

@@ -36,6 +36,70 @@ from .metrics_tables import METER_OF_TABLE, METRICS_DB, MetricsTableID, TABLE_NA
 
 _INTERVALS = {"1m": 60, "1h": 3600, "1d": 86400}
 
+# -- cascade-served tiers (ISSUE 9) -----------------------------------------
+# The rollup cascade (aggregator/cascade.py) maintains 1m/1h tiers as
+# device-side folds of closed finer windows — those granularities are
+# SERVED without a datasource job. Pipelines register their tiers here
+# at construction so the operator-facing datasource listings (dfctl
+# datasource, REST /v1/datasources, the debug UDP "datasources" cmd)
+# reflect which granularities the cascade covers vs which the
+# store-side Downsampler materializes. Suffixes come from the
+# querier's TIER_SUFFIX_S so a listed tier name is exactly the name
+# bare-family tier routing can resolve (a non-standard interval is
+# listed as "<N>s" but is NOT bare-name routable — query it by its
+# explicit table name).
+
+_FAMILIES_OF_METER = {
+    "flow": ("network", "network_map"),
+    "app": ("application", "application_map"),
+    "usage": ("traffic_policy",),
+}
+_CASCADE_TIERS: dict[tuple[str, int], dict] = {}
+# live registrants per tier (weakly held — the stats-registry stance:
+# a torn-down pipeline's tiers leave the listing with it). A tier
+# registered without an owner is permanent (operator/config-driven).
+_CASCADE_OWNERS: dict[tuple[str, int], object] = {}
+
+
+def register_cascade_tiers(meter_name: str, intervals, owner=None) -> None:
+    """Record that a cascade now serves `intervals` (seconds) for every
+    table family of `meter_name` ("flow"/"app"/"usage"). Idempotent —
+    re-registering the same tier refreshes it. With `owner` (the
+    serving pipeline/manager), the registration is weakly held and the
+    tier drops out of the listing when the owner is collected."""
+    import weakref
+
+    from ..querier.translation import TIER_SUFFIX_S
+
+    suffix_of_s = {s: n for n, s in TIER_SUFFIX_S.items()}
+    for family in _FAMILIES_OF_METER.get(meter_name, (meter_name,)):
+        for s in intervals:
+            suffix = suffix_of_s.get(int(s), f"{int(s)}s")
+            key = (family, int(s))
+            _CASCADE_TIERS[key] = {
+                "name": f"{family}_{suffix}",
+                "base_table": f"{family}_1s",
+                "interval": suffix,
+                "served_by": "cascade",
+            }
+            owners = _CASCADE_OWNERS.setdefault(key, weakref.WeakSet())
+            if owner is None:
+                _CASCADE_OWNERS[key] = None  # permanent
+            elif owners is not None:
+                owners.add(owner)
+
+
+def list_cascade_tiers() -> list[dict]:
+    """Listing rows for the cascade-served tiers (stable order);
+    weakly-owned tiers whose every registrant died are dropped."""
+    out = []
+    for key in sorted(_CASCADE_TIERS):
+        owners = _CASCADE_OWNERS.get(key)
+        if owners is not None and not len(owners):
+            continue  # every registering pipeline is gone
+        out.append(dict(_CASCADE_TIERS[key]))
+    return out
+
 
 @dataclasses.dataclass
 class DataSource:

@@ -83,6 +83,8 @@ class DebugServer:
             dsm = self.context.get("downsampler")
             if dsm is None:
                 return {"error": "no downsampler attached"}
+            from .datasource import list_cascade_tiers
+
             return {
                 "datasources": [
                     {
@@ -90,8 +92,17 @@ class DebugServer:
                         "base": d.base_table,
                         "interval": d.interval,
                         "watermark": d.watermark,
+                        "served_by": "downsampler",
                     }
                     for d in dsm.list()
+                ]
+                # tiers the rollup cascade serves on device (ISSUE 9):
+                # no watermark — the tier closes with its last child
+                # window, there is no store-side scan to track
+                + [
+                    {"name": r["name"], "base": r["base_table"],
+                     "interval": r["interval"], "served_by": "cascade"}
+                    for r in list_cascade_tiers()
                 ]
             }
         if cmd == "ping":

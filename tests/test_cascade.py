@@ -1,0 +1,579 @@
+"""ISSUE 9 multi-resolution rollup cascade: 1m/1h tiers as device-side
+folds of closed 1s windows, replacing the double-ingest.
+
+Pins: cascade 1m meters bit-exact vs the old `DoubleIngestPipeline`
+oracle (incl. late rows spanning a minute boundary), tier sketch blocks
+== merge of their children (the r12 associativity pins make order
+immaterial), the hour tier as a fold of minutes, counted tier sheds,
+the sharded per-device fold + host merge, counter dogfooding over SQL +
+PromQL, the querier's tier routing, and the datasource listings."""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import reduce
+
+import numpy as np
+import pytest
+
+from deepflow_tpu.aggregator.cascade import CascadeConfig
+from deepflow_tpu.aggregator.pipeline import (
+    DoubleIngestPipeline,
+    DualGranularityPipeline,
+    L4Pipeline,
+    PipelineConfig,
+)
+from deepflow_tpu.aggregator.sketchplane import SketchConfig
+from deepflow_tpu.aggregator.window import WindowConfig
+from deepflow_tpu.datamodel.batch import FlowBatch
+from deepflow_tpu.datamodel.code import DocumentFlag
+from deepflow_tpu.datamodel.schema import FLOW_METER, TAG_SCHEMA
+from deepflow_tpu.ingest.replay import SyntheticFlowGen
+from deepflow_tpu.ops.histogram import LogHistSpec
+
+T0 = 1_700_000_040  # 40s into a minute so the first 1m window closes fast
+
+_SK = SketchConfig(
+    num_groups=4, hll_precision=7, cms_depth=2, cms_width=256,
+    hist=LogHistSpec(bins=32, vmin=1.0, gamma=1.3),
+    topk_rows=2, topk_cols=64, pending=8,
+)
+
+
+def _stream(pipe, spans, *, n=100, tuples=50, seed=3):
+    gen = SyntheticFlowGen(num_tuples=tuples, seed=seed)
+    out = []
+    for t in spans:
+        out += pipe.ingest(FlowBatch.from_records(gen.records(n, t)))
+    out += pipe.drain()
+    return out
+
+
+def _canonical_rows(docbatches):
+    """Sorted (time, tags…, meters-bits…) tuples — the bit-exact
+    comparison form (meters compare as raw f32 bits, not approximately)."""
+    rows = []
+    for db in docbatches:
+        mbits = db.meters.astype(np.float32).view(np.uint32)
+        for i in range(db.size):
+            rows.append(
+                (int(db.timestamp[i]),)
+                + tuple(int(v) for v in db.tags[i])
+                + tuple(int(v) for v in mbits[i])
+            )
+    return sorted(rows)
+
+
+def _split(docs):
+    sec = [db for fl, db in docs if fl == DocumentFlag.PER_SECOND_METRICS]
+    minute = [db for fl, db in docs if fl == DocumentFlag.NONE]
+    return sec, minute
+
+
+# ---------------------------------------------------------------------------
+# oracle pin: cascade == double-ingest
+
+
+def test_cascade_minute_bit_exact_vs_double_ingest():
+    """The cascade's 1m docs are BIT-EXACT vs the old double-ingest on
+    an identical stream — including late rows that land in the previous
+    minute after the stream has crossed the boundary (admitted by the
+    1s gate: ≤ delay behind t_max)."""
+    cfg = PipelineConfig(window=WindowConfig(capacity=1 << 14), batch_size=256)
+    # T0+19/T0+21 straddle the minute boundary at T0+20; the second
+    # T0+19 batch arrives AFTER the boundary crossed but within delay=2
+    # of t_max, so both implementations admit it into minute 0
+    spans = [T0, T0 + 19, T0 + 21, T0 + 19, T0 + 30, T0 + 90]
+    new = _stream(DualGranularityPipeline(cfg), spans)
+    old = _stream(DoubleIngestPipeline(cfg), spans)
+
+    new_sec, new_min = _split(new)
+    old_sec, old_min = _split(old)
+    assert new_min and old_min
+    # 1s stream untouched by the cascade
+    assert _canonical_rows(new_sec) == _canonical_rows(old_sec)
+    # 1m stream: same rows, same tags, same meter BITS
+    assert _canonical_rows(new_min) == _canonical_rows(old_min)
+
+
+def test_cascade_single_dispatch_per_batch():
+    """The acceptance criterion's mechanism: dual-granularity ingest
+    issues ONE fused device dispatch per batch — the shim owns exactly
+    one pipeline, and its dispatch count equals the batch count (the
+    double-ingest dispatched 2×)."""
+    from deepflow_tpu.utils.spans import SPAN_INGEST_DISPATCH
+
+    cfg = PipelineConfig(window=WindowConfig(capacity=1 << 12), batch_size=256)
+    dual = DualGranularityPipeline(cfg)
+    spans = [T0 + i for i in range(6)]
+    _stream(dual, spans)
+    assert dual.pipe.tracer.summary()[SPAN_INGEST_DISPATCH]["count"] == len(spans)
+
+    old = DoubleIngestPipeline(cfg)
+    _stream(old, spans)
+    n_old = (
+        old.second.tracer.summary()[SPAN_INGEST_DISPATCH]["count"]
+        + old.minute.tracer.summary()[SPAN_INGEST_DISPATCH]["count"]
+    )
+    assert n_old == 2 * len(spans)
+
+
+def test_minute_rows_merge_across_seconds():
+    """One flow key hit in many seconds → ONE 1m row with summed
+    meters (doc fingerprints carry no timestamp, so the tier fold's
+    (parent, key) re-key merges the per-second rows)."""
+    pipe = DualGranularityPipeline(
+        PipelineConfig(window=WindowConfig(capacity=1 << 12), batch_size=256)
+    )
+    docs = _stream(pipe, [T0, T0 + 1, T0 + 2, T0 + 5], n=10, tuples=1, seed=5)
+    sec, minute = _split(docs)
+    n_min = sum(db.size for db in minute)
+    n_sec = sum(db.size for db in sec)
+    assert 0 < n_min < n_sec
+    pkt = FLOW_METER.index("packet_tx")
+    assert sum(db.meters[:, pkt].sum() for db in minute) == sum(
+        db.meters[:, pkt].sum() for db in sec
+    )
+
+
+# ---------------------------------------------------------------------------
+# sketch tier pin: merge-of-60 == the cascade's minute block
+
+
+def _assert_blocks_equal(a, b):
+    assert a.window == b.window and a.n_updates == b.n_updates
+    for lane in ("hll", "cms", "hist", "tk_votes", "tk_hi", "tk_lo",
+                 "tk_ida", "tk_idb"):
+        np.testing.assert_array_equal(
+            getattr(a, lane), getattr(b, lane), err_msg=(a.window, lane)
+        )
+
+
+def test_minute_sketch_block_equals_merge_of_children():
+    """The cascade's 1m sketch block is exactly the r12-algebra merge of
+    its closed 1s blocks (window order — but the associativity/
+    commutativity pins in tests/test_sketches.py make any order equal
+    for hll/cms/hist; candidate arrays concatenate in fold order)."""
+    cfg = PipelineConfig(
+        window=WindowConfig(
+            capacity=1 << 12, sketch=_SK,
+            cascade=CascadeConfig(intervals=(60,), capacity=1 << 12),
+        ),
+        batch_size=256,
+    )
+    pipe = L4Pipeline(cfg)
+    gen = SyntheticFlowGen(num_tuples=80, seed=11)
+    for t in (T0, T0 + 3, T0 + 8, T0 + 14, T0 + 19, T0 + 21, T0 + 90):
+        pipe.ingest(FlowBatch.from_records(gen.records(128, t)))
+    pipe.drain()
+    pipe.pop_tier_docbatches()  # routes tier blocks into the held list
+    children = pipe.pop_closed_sketches()
+    tier_blocks = pipe.closed_tier_sketches
+    assert children and tier_blocks
+
+    by_parent: dict[int, list] = {}
+    for blk in children:
+        by_parent.setdefault(blk.window // 60, []).append(blk)
+    got = {b.window: b for b in tier_blocks}
+    assert set(got) == set(by_parent)
+    for parent, blks in by_parent.items():
+        blks = sorted(blks, key=lambda b: b.window)
+        want = reduce(
+            lambda a, b: a.merge(dataclasses.replace(b, window=parent)),
+            blks[1:],
+            dataclasses.replace(blks[0], window=parent),
+        )
+        _assert_blocks_equal(got[parent], want)
+        # ...and the minute answers come straight off the merged block
+        assert got[parent].distinct() == want.distinct()
+
+
+# ---------------------------------------------------------------------------
+# hour tier + shed accounting
+
+
+def test_hour_tier_folds_minutes():
+    cfg = PipelineConfig(
+        window=WindowConfig(
+            capacity=1 << 14,
+            cascade=CascadeConfig(intervals=(60, 3600), capacity=1 << 14),
+        ),
+        batch_size=256,
+    )
+    pipe = L4Pipeline(cfg)
+    gen = SyntheticFlowGen(num_tuples=40, seed=13)
+    for t in (T0, T0 + 30, T0 + 90, T0 + 3700, T0 + 7300):
+        pipe.ingest(FlowBatch.from_records(gen.records(100, t)))
+    sec_rows = sum(db.size for db in pipe.drain())
+    tiers = pipe.pop_tier_docbatches()
+    minutes = [db for iv, db in tiers if iv == 60]
+    hours = [db for iv, db in tiers if iv == 3600]
+    assert sec_rows and minutes and hours
+    assert all((db.timestamp % 60 == 0).all() for db in minutes)
+    assert all((db.timestamp % 3600 == 0).all() for db in hours)
+    pkt = FLOW_METER.index("packet_tx")
+    m_min = sum(db.meters[:, pkt].sum() for db in minutes)
+    m_hr = sum(db.meters[:, pkt].sum() for db in hours)
+    assert m_min == m_hr > 0
+    c = pipe.get_counters()
+    # tier folds consumed the 1s rows AND the 1m rows (counted once per
+    # fold each) — strictly more fold work than 1s rows alone
+    assert c["cascade_rows"] > sec_rows
+    assert c["cascade_shed"] == 0
+
+
+def test_tier_stash_overflow_is_counted_never_silent():
+    cfg = PipelineConfig(
+        window=WindowConfig(
+            capacity=1 << 12,
+            cascade=CascadeConfig(intervals=(60,), capacity=64),
+        ),
+        batch_size=512,
+    )
+    pipe = L4Pipeline(cfg)
+    gen = SyntheticFlowGen(num_tuples=400, seed=17)
+    for t in (T0, T0 + 10, T0 + 90):
+        pipe.ingest(FlowBatch.from_records(gen.records(400, t)))
+    sec_rows = sum(db.size for db in pipe.drain())
+    tier_rows = sum(db.size for _iv, db in pipe.pop_tier_docbatches())
+    c = pipe.get_counters()
+    assert sec_rows > 0  # the 1s stream is unaffected by tier overflow
+    assert c["cascade_shed"] > 0  # a 64-row minute stash must shed
+    assert tier_rows <= 64 * 2  # bounded by tier capacity per minute
+
+
+# ---------------------------------------------------------------------------
+# sharded: per-device tier fold, host-merge at drain
+
+
+def test_sharded_cascade_minute_matches_second_rollup():
+    import jax  # noqa: F401 — mesh needs a backend
+
+    from deepflow_tpu.parallel.mesh import make_mesh
+    from deepflow_tpu.parallel.sharded import (
+        ShardedConfig,
+        ShardedPipeline,
+        ShardedWindowManager,
+    )
+
+    cfg = ShardedConfig(
+        capacity_per_device=1 << 10, num_services=16, hll_precision=6,
+        hist=LogHistSpec(bins=64, vmin=1.0, gamma=1.3),
+        cascade=(60,), cascade_capacity=1 << 10,
+    )
+    wm = ShardedWindowManager(ShardedPipeline(make_mesh(2), cfg))
+    gen = SyntheticFlowGen(num_tuples=200, seed=5)
+    docs = []
+    for t in (T0, T0 + 1, T0 + 4, T0 + 30, T0 + 90):
+        fb = gen.flow_batch(128, t)
+        docs += wm.ingest(fb.tags, fb.meters, fb.valid)
+    docs += wm.drain()
+    tiers = wm.pop_tier_docbatches()
+    assert tiers and all(iv == 60 for iv, _ in tiers)
+    assert all((db.timestamp % 60 == 0).all() for _iv, db in tiers)
+
+    # host oracle: roll the 1s docs up by (minute, full tag row) — the
+    # sharded tier keeps per-device rows, so compare SUMMED meters per
+    # (minute, tag row), which is device-layout independent. Only the
+    # SUM-semantics meter columns add linearly across seconds (MAX
+    # columns take the max — covered by the single-chip bit-exact pin).
+    sum_cols = np.nonzero(FLOW_METER.sum_mask)[0]
+
+    def grouped(dbs, bucket):
+        out: dict = {}
+        for db in dbs:
+            for i in range(db.size):
+                key = (int(db.timestamp[i]) // bucket * bucket,
+                       tuple(int(v) for v in db.tags[i]))
+                out[key] = out.get(key, 0.0) + float(db.meters[i][sum_cols].sum())
+        return out
+
+    want = grouped(docs, 60)
+    got = grouped([db for _iv, db in tiers], 60)
+    assert got == want
+    c = wm.get_counters()
+    assert c["cascade_rows"] > 0 and c["cascade_shed"] == 0
+    assert c["cascade_tier_windows"] == len(tiers)
+
+
+# ---------------------------------------------------------------------------
+# dogfooding: cascade lanes over SQL + PromQL (deepflow_system)
+
+
+def test_cascade_counters_roundtrip_sql_and_promql():
+    from deepflow_tpu.integration.dfstats import system_metric_name, system_sink
+    from deepflow_tpu.querier.engine import QueryEngine
+    from deepflow_tpu.querier.promql import query_instant
+    from deepflow_tpu.storage.store import ColumnarStore
+    from deepflow_tpu.utils.stats import StatsCollector
+
+    pipe = L4Pipeline(PipelineConfig(
+        window=WindowConfig(
+            capacity=1 << 12,
+            cascade=CascadeConfig(intervals=(60,), capacity=1 << 12),
+        ),
+        batch_size=256,
+    ))
+    gen = SyntheticFlowGen(num_tuples=50, seed=3)
+    for t in (T0, T0 + 30, T0 + 90):
+        pipe.ingest(FlowBatch.from_records(gen.records(100, t)))
+    expected = pipe.get_counters()
+    assert expected["cascade_rows"] > 0
+
+    store = ColumnarStore()
+    col = StatsCollector(interval_s=999)
+    col.register("tpu_pipeline", pipe, kind="L4Pipeline", interval="1s")
+    col.add_sink(system_sink(store))
+    col.tick(now=float(T0 + 100))
+
+    eng = QueryEngine(store)
+    for field in ("cascade_rows", "cascade_shed", "cascade_tier_windows"):
+        metric = system_metric_name("tpu_pipeline", field)
+        res = eng.execute(
+            "SELECT value FROM deepflow_system.deepflow_system "
+            f"WHERE metric = '{metric}'"
+        )
+        assert res.rows == 1, field
+        assert float(res.values["value"][0]) == float(expected[field]), field
+    out = query_instant(
+        store, system_metric_name("tpu_pipeline", "cascade_rows"),
+        T0 + 100, db="deepflow_system", table="deepflow_system",
+    )
+    assert len(out) == 1
+    assert out[0]["value"] == float(expected["cascade_rows"])
+
+
+# ---------------------------------------------------------------------------
+# querier: tier routing
+
+
+def test_querier_routes_range_queries_to_coarsest_satisfying_tier():
+    from deepflow_tpu.querier.engine import QueryEngine
+    from deepflow_tpu.storage.store import ColumnarStore, ColumnSpec, TableSchema
+
+    store = ColumnarStore()
+    span = 3 * 3600  # a 3h range at 1s vs tier resolution
+    for name, iv in (("network_1s", 1), ("network_1m", 60), ("network_1h", 3600)):
+        store.create_table("flow_metrics", TableSchema(
+            name,
+            (ColumnSpec("time", "u4"), ColumnSpec("protocol", "u4"),
+             ColumnSpec("byte_tx", "f4")),
+            partition_s=3600,
+        ))
+        n = span // iv
+        store.insert("flow_metrics", name, {
+            "time": (np.arange(n) * iv).astype(np.uint32),
+            "protocol": np.full(n, 6, np.uint32),
+            "byte_tx": np.full(n, float(iv), np.float32),
+        })
+    eng = QueryEngine(store)
+    # coarse steps read the matching tier: row count ≤ span/step per
+    # series, never a 1s replay (the acceptance criterion)
+    r = eng.execute(
+        "select interval(time, 3600) as t, Sum(byte_tx) as b "
+        "from network group by t order by t"
+    )
+    assert r.rows == 3  # 3 tier rows — not 10800 replayed seconds
+    r = eng.execute(
+        "select interval(time, 60) as t, Sum(byte_tx) as b "
+        "from network group by t"
+    )
+    assert r.rows == span // 60
+    # detail queries stay on the finest tier
+    r = eng.execute("select Count() as c from network")
+    assert int(r.values["c"][0]) == span
+    # explicit granularity is never rerouted
+    r = eng.execute(
+        "select interval(time, 3600) as t, Count() as c from network.1s group by t"
+    )
+    assert int(np.asarray(r.values["c"]).sum()) == span
+    # a step no tier divides falls back to the finest (correctness over
+    # coarseness: 90s buckets over 1m rows would split tier rows)
+    r = eng.execute(
+        "select interval(time, 90) as t, Count() as c from network group by t"
+    )
+    assert int(np.asarray(r.values["c"]).sum()) == span
+
+
+# ---------------------------------------------------------------------------
+# datasource listings
+
+
+def test_datasource_listing_reflects_cascade_tiers():
+    from deepflow_tpu.server.datasource import (
+        list_cascade_tiers,
+        register_cascade_tiers,
+    )
+
+    register_cascade_tiers("flow", (60, 3600))
+    rows = list_cascade_tiers()
+    names = {r["name"] for r in rows}
+    assert {"network_1m", "network_1h", "network_map_1m"} <= names
+    assert all(r["served_by"] == "cascade" for r in rows)
+    # constructing a cascade-enabled pipeline self-registers
+    L4Pipeline(PipelineConfig(
+        window=WindowConfig(
+            capacity=1 << 10,
+            cascade=CascadeConfig(intervals=(60,), capacity=1 << 10),
+        ),
+        batch_size=128,
+    ))
+    assert {"network_1m", "network_map_1m"} <= {
+        r["name"] for r in list_cascade_tiers()
+    }
+
+
+def test_cascade_config_validation():
+    with pytest.raises(ValueError, match="multiple"):
+        CascadeConfig(intervals=(60, 90)).validate_base(1)
+    with pytest.raises(ValueError, match="ascending"):
+        CascadeConfig(intervals=(3600, 60))
+    # 1m over a 60s base pipeline is NOT a proper multiple (equal)
+    with pytest.raises(ValueError, match="multiple"):
+        WindowConfig(interval=60, cascade=CascadeConfig(intervals=(60,)))
+
+
+# ---------------------------------------------------------------------------
+# review regression (ISSUE 9): a tier window whose children were ALL
+# sketch-only must emit at the drain that closes it even when that
+# drain transfers nothing (no exact rows anywhere, no new blocks) —
+# the early-return fast path must not leak the merged parent block
+# (the watermark has already advanced past it, so no later drain would
+# ever release it).
+
+
+def _empty_block(window: int):
+    from deepflow_tpu.aggregator.sketchplane import WindowSketchBlock
+
+    g, m = _SK.num_groups, _SK.hll_m
+    return WindowSketchBlock(
+        window=window, config=_SK, n_updates=7,
+        hll=np.zeros((g, m), np.int32),
+        cms=np.zeros((_SK.cms_depth, _SK.cms_width), np.int64),
+        hist=np.zeros((g, _SK.hist.bins), np.int64),
+        tk_hi=np.zeros((0,), np.uint32), tk_lo=np.zeros((0,), np.uint32),
+        tk_ida=np.zeros((0,), np.uint32), tk_idb=np.zeros((0,), np.uint32),
+        tk_votes=np.zeros((0,), np.int64),
+    )
+
+
+def test_sketch_only_tier_window_survives_empty_drain():
+    from deepflow_tpu.aggregator.stash import stash_flush_range
+    from deepflow_tpu.aggregator.window import WindowManager
+
+    wm = WindowManager(WindowConfig(
+        capacity=64, sketch=_SK,
+        cascade=CascadeConfig(intervals=(60,), capacity=64),
+    ))
+    # a sketch-only minute: children merged into the pending parent,
+    # zero exact rows anywhere
+    wm.cascade.feed_block(0, 59, _empty_block(59))
+    wm.state, packed, total = stash_flush_range(
+        wm.state, np.uint32(0), np.uint32(100)
+    )
+    entry = wm._make_flush_entry(packed, total, 0, 100)
+    assert entry.tiers, "hi=100 crosses the minute boundary — tier must flush"
+    flushed = wm._drain_flush(entry)
+    assert flushed == []  # no exact 1s windows — nothing to emit there
+    tiers = wm.pop_tier_windows()
+    assert len(tiers) == 1 and tiers[0].count == 0
+    assert tiers[0].window_idx == 0 and tiers[0].sketches is not None
+    assert tiers[0].sketches.n_updates == 7
+    assert not wm.cascade.pending_blocks[0], "pending parent leaked"
+
+
+def test_sketch_only_tier_window_survives_empty_drain_sharded():
+    from deepflow_tpu.parallel.mesh import make_mesh
+    from deepflow_tpu.parallel.sharded import (
+        ShardedConfig,
+        ShardedPipeline,
+        ShardedWindowManager,
+    )
+
+    cfg = ShardedConfig(
+        capacity_per_device=64, num_services=4, hll_precision=7,
+        cms_depth=2, cms_width=256,
+        hist=LogHistSpec(bins=32, vmin=1.0, gamma=1.3),
+        topk_cols=64, sketch_pending=8,
+        cascade=(60,), cascade_capacity=64,
+    )
+    wm = ShardedWindowManager(ShardedPipeline(make_mesh(2), cfg))
+    blk = _empty_block(59)
+    blk = dataclasses.replace(blk, config=wm._sk_cfg)
+    wm._feed_tier_block(0, 59, blk)
+    flushed = wm._drain_range(0, 100)
+    assert flushed == []
+    tiers = wm.pop_tier_docbatches()
+    assert tiers == []  # no exact tier rows → no DocBatch...
+    assert len(wm.closed_tier_sketches) == 1  # ...but the block released
+    assert wm.closed_tier_sketches[0].n_updates == 7
+    assert not wm._tier_pending_blocks[0], "pending parent leaked"
+
+
+def test_shim_never_routes_coarse_tiers_into_minute_tables():
+    """Review regression: route_table_ids only distinguishes PER_SECOND
+    vs NONE, so a (60, 3600) shim must emit ONLY the 1m tier as NONE —
+    hourly batches in the *_1m tables would double-count the hour."""
+    cfg = PipelineConfig(
+        window=WindowConfig(
+            capacity=1 << 14,
+            cascade=CascadeConfig(intervals=(60, 3600), capacity=1 << 14),
+        ),
+        batch_size=256,
+    )
+    pipe = DualGranularityPipeline(cfg)
+    docs = _stream(pipe, [T0, T0 + 90, T0 + 3700, T0 + 7300], n=50, tuples=20)
+    _sec, minute = _split(docs)
+    assert minute and all((db.timestamp % 60 == 0).all() for db in minute)
+    # the hourly batches surfaced out-of-band, not as NONE docs
+    assert pipe.coarse_tiers and all(iv == 3600 for iv, _ in pipe.coarse_tiers)
+    hr_rows = sum(db.size for _iv, db in pipe.coarse_tiers)
+    min_rows = sum(db.size for db in minute)
+    assert 0 < hr_rows < min_rows
+
+    # conflicting explicit cascade params fail loudly, and a cascade
+    # without a 1m tier cannot back the shim's minute contract
+    with pytest.raises(ValueError, match="conflicting"):
+        DualGranularityPipeline(
+            cfg, cascade=CascadeConfig(intervals=(60,), capacity=1 << 12)
+        )
+    with pytest.raises(ValueError, match="1m cascade tier"):
+        DualGranularityPipeline(PipelineConfig(window=WindowConfig(
+            capacity=1 << 12,
+            cascade=CascadeConfig(intervals=(3600,), capacity=1 << 12),
+        )))
+
+
+def test_tier_router_refuses_steps_finer_than_every_tier():
+    """Review regression: a step finer than the finest available tier
+    must NOT silently coarsen (60s rows in 30s buckets = a wrong
+    series) — the router returns None and the query fails loudly."""
+    from deepflow_tpu.querier.engine import QueryEngine
+    from deepflow_tpu.querier.sqlparse import SQLError
+    from deepflow_tpu.querier.translation import select_datasource_tier
+    from deepflow_tpu.storage.store import ColumnarStore, ColumnSpec, TableSchema
+
+    assert select_datasource_tier({"network_1m": 60}, 30) is None
+    assert select_datasource_tier({"network_1m": 60}, 60) == "network_1m"
+    assert select_datasource_tier({"network_1m": 60}, None) == "network_1m"
+
+    store = ColumnarStore()
+    store.create_table("flow_metrics", TableSchema(
+        "network_1m",
+        (ColumnSpec("time", "u4"), ColumnSpec("byte_tx", "f4")),
+        partition_s=3600,
+    ))
+    store.insert("flow_metrics", "network_1m", {
+        "time": np.arange(4, dtype=np.uint32) * 60,
+        "byte_tx": np.ones(4, np.float32),
+    })
+    eng = QueryEngine(store)
+    r = eng.execute(
+        "select interval(time, 60) as t, Sum(byte_tx) as b from network group by t"
+    )
+    assert r.rows == 4
+    with pytest.raises(SQLError, match="no such table"):
+        eng.execute(
+            "select interval(time, 30) as t, Sum(byte_tx) as b "
+            "from network group by t"
+        )

@@ -852,3 +852,183 @@ def test_pre_v4_sharded_checkpoint_reinits_sketch_planes_loudly(tmp_path, caplog
     fresh.ingest(fb2.tags, fb2.meters, fb2.valid)
     fresh.drain()
     assert fresh.pop_closed_sketches()
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint v5: rollup-cascade tier state (ISSUE 9). A KillPoint
+# mid-minute — after some 1s closes folded into the OPEN 1m tier,
+# before it closes — must round-trip bit-exact vs an uninterrupted
+# oracle: tier stashes, watermarks, device counter lanes AND the open
+# parents' partially-merged sketch blocks. v4-and-earlier files load
+# with the tiers re-initialized + a loud log.
+
+from deepflow_tpu.aggregator.cascade import CascadeConfig  # noqa: E402
+
+_CASC_TIMES = (T0, T0 + 5, T0 + 10, T0 + 45, T0 + 100)
+_CASC_KILL_AFTER = 2  # T0+10 ingested: seconds < T0+8 folded, minute open
+
+
+def _casc_cfg():
+    return WindowConfig(
+        capacity=1 << 11, sketch=_SK,
+        cascade=CascadeConfig(intervals=(60,), capacity=1 << 11),
+    )
+
+
+def _tier_stream_equal(got, want):
+    assert [f.window_idx for f in got] == [f.window_idx for f in want]
+    for g, w in zip(got, want):
+        assert (g.tier, g.interval, g.count) == (w.tier, w.interval, w.count)
+        np.testing.assert_array_equal(g.key_hi, w.key_hi)
+        np.testing.assert_array_equal(g.key_lo, w.key_lo)
+        np.testing.assert_array_equal(g.tags, w.tags)
+        np.testing.assert_array_equal(
+            g.meters.view(np.uint32), w.meters.view(np.uint32)
+        )
+        assert (g.sketches is None) == (w.sketches is None)
+        if g.sketches is not None:
+            _assert_blocks_equal(g.sketches, w.sketches)
+
+
+def test_cascade_tiers_roundtrip_killpoint_mid_minute(tmp_path):
+    def batches():
+        return [_sk_doc_batch(70 + i, 96, t) for i, t in enumerate(_CASC_TIMES)]
+
+    oracle = WindowManager(_casc_cfg())
+    want = []
+    for b in batches():
+        want.extend(oracle.ingest(*b))
+    want.extend(oracle.flush_all())
+    want_tiers = oracle.pop_tier_windows()
+    assert want_tiers, "stream crosses a minute boundary — tiers must close"
+
+    path = tmp_path / "casc.ckpt"
+    victim = WindowManager(_casc_cfg())
+    got, got_tiers = [], []
+    with pytest.raises(chaos.KillPoint):
+        for i, b in enumerate(batches()):
+            got.extend(victim.ingest(*b))
+            got_tiers.extend(victim.pop_tier_windows())
+            if i == _CASC_KILL_AFTER:
+                # mid-minute: the open 1m tier already holds folded 1s
+                # windows and a partially-merged parent sketch block
+                assert victim.cascade.pending_blocks[0], "no partial merge"
+                got.extend(save_window_state(victim, path))
+                raise chaos.KillPoint("process death mid-minute")
+
+    recovered = load_window_state(path, TAG_SCHEMA, FLOW_METER)
+    assert recovered.cascade is not None
+    # tier stash + lanes round-trip bit-exact
+    for lane in ("slot", "key_hi", "key_lo", "tags", "meters", "valid"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(recovered.cascade.tiers[0], lane)),
+            np.asarray(getattr(victim.cascade.tiers[0], lane)), err_msg=lane,
+        )
+    np.testing.assert_array_equal(
+        np.asarray(recovered.cascade.lanes_dev),
+        np.asarray(victim.cascade.lanes_dev),
+    )
+    assert recovered.cascade.watermarks == victim.cascade.watermarks
+    assert sorted(recovered.cascade.pending_blocks[0]) == sorted(
+        victim.cascade.pending_blocks[0]
+    )
+    # the continued run is indistinguishable from the oracle: 1s stream
+    # AND the closed tier windows (rows, meters bits, merged blocks)
+    for b in batches()[_CASC_KILL_AFTER + 1 :]:
+        got.extend(recovered.ingest(*b))
+        got_tiers.extend(recovered.pop_tier_windows())
+    got.extend(recovered.flush_all())
+    got_tiers.extend(recovered.pop_tier_windows())
+    _flush_stream_equal(got, want)
+    _tier_stream_equal(got_tiers, want_tiers)
+    assert recovered.get_counters()["cascade_rows"] == (
+        oracle.get_counters()["cascade_rows"]
+    )
+
+
+def test_cascade_tiers_roundtrip_killpoint_sharded(tmp_path):
+    from deepflow_tpu.parallel.mesh import make_mesh
+    from deepflow_tpu.parallel.sharded import (
+        ShardedConfig,
+        ShardedPipeline,
+        ShardedWindowManager,
+    )
+
+    cfg = ShardedConfig(
+        capacity_per_device=1 << 10, num_services=8, hll_precision=7,
+        cms_depth=2, cms_width=256,
+        hist=LogHistSpec(bins=32, vmin=1.0, gamma=1.3),
+        topk_cols=64, sketch_pending=8,
+        cascade=(60,), cascade_capacity=1 << 10,
+    )
+    gen = SyntheticFlowGen(num_tuples=150, seed=71)
+    batches = [gen.flow_batch(128, t) for t in _CASC_TIMES]
+
+    def run(wm, bs):
+        docs, tiers = [], []
+        for fb in bs:
+            docs.extend(wm.ingest(fb.tags, fb.meters, fb.valid))
+            tiers.extend(wm.pop_tier_docbatches())
+        docs.extend(wm.drain())
+        tiers.extend(wm.pop_tier_docbatches())
+        return docs, tiers
+
+    oracle = ShardedWindowManager(ShardedPipeline(make_mesh(2), cfg))
+    want_docs, want_tiers = run(oracle, batches)
+    assert want_tiers
+
+    path = tmp_path / "casc_sharded.ckpt"
+    victim = ShardedWindowManager(ShardedPipeline(make_mesh(2), cfg))
+    got_docs, got_tiers = [], []
+    with pytest.raises(chaos.KillPoint):
+        for i, fb in enumerate(batches):
+            got_docs.extend(victim.ingest(fb.tags, fb.meters, fb.valid))
+            got_tiers.extend(victim.pop_tier_docbatches())
+            if i == _CASC_KILL_AFTER:
+                save_sharded_state(victim, path)
+                raise chaos.KillPoint("process death mid-minute")
+
+    recovered = ShardedWindowManager(ShardedPipeline(make_mesh(2), cfg))
+    restore_sharded_state(recovered, path)
+    for lane in ("slot", "key_hi", "key_lo", "tags", "meters", "valid"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(recovered.tier_stashes[0], lane)),
+            np.asarray(getattr(victim.tier_stashes[0], lane)), err_msg=lane,
+        )
+    d2, t2 = run(recovered, batches[_CASC_KILL_AFTER + 1 :])
+    got_docs.extend(d2)
+    got_tiers.extend(t2)
+    assert [d.size for d in got_docs] == [d.size for d in want_docs]
+    assert [(iv, db.size) for iv, db in got_tiers] == [
+        (iv, db.size) for iv, db in want_tiers
+    ]
+    for (gi, g), (wi, w) in zip(got_tiers, want_tiers):
+        np.testing.assert_array_equal(g.tags, w.tags)
+        np.testing.assert_array_equal(
+            g.meters.view(np.uint32), w.meters.view(np.uint32)
+        )
+
+
+def test_pre_v5_checkpoints_reinit_cascade_tiers_loudly(tmp_path, caplog):
+    """v4-era files (no casc_* arrays) must LOAD into a cascade-enabled
+    deployment: tiers re-initialize with a loud log — never a crash."""
+    import logging
+
+    # a v4-era save: same config minus the cascade
+    wm = WindowManager(WindowConfig(capacity=1 << 10, sketch=_SK))
+    list(wm.ingest(*_sk_doc_batch(72, 64, T0)))
+    path = tmp_path / "v4.ckpt"
+    save_window_state(wm, path)
+
+    with caplog.at_level(logging.WARNING):
+        restored = load_window_state(
+            path, TAG_SCHEMA, FLOW_METER,
+            cascade_config=CascadeConfig(intervals=(60,), capacity=1 << 10),
+        )
+    assert any("no cascade tier state" in r.message for r in caplog.records)
+    assert restored.cascade is not None
+    assert restored.cascade.watermarks == [0]
+    # exact state restored; the cascade works from here on
+    restored.ingest(*_sk_doc_batch(73, 64, T0 + 100))
+    restored.flush_all()
+    assert restored.pop_tier_windows()

@@ -88,6 +88,8 @@ def test_counter_block_layout_constants():
     )
 
     from deepflow_tpu.aggregator.window import (
+        CB_CASCADE_ROWS,
+        CB_CASCADE_SHED,
         CB_FOLD_ROWS,
         CB_SKETCH_ROWS,
         CB_SKETCH_SHED,
@@ -96,14 +98,17 @@ def test_counter_block_layout_constants():
     # layout drift between the device builder and the host parser must
     # fail here, not silently mis-slice (v2 appended the feeder_shed
     # lane, ISSUE 4; v3 appended fold_rows, ISSUE 5; v4 appended the
-    # sketch_rows/sketch_shed plane lanes, ISSUE 8)
-    assert CB_VERSION == 0 and CB_LEN == 14
-    assert COUNTER_BLOCK_VERSION == 4
+    # sketch_rows/sketch_shed plane lanes, ISSUE 8; v5 appended the
+    # rollup cascade's cascade_rows/cascade_shed lanes, ISSUE 9)
+    assert CB_VERSION == 0 and CB_LEN == 16
+    assert COUNTER_BLOCK_VERSION == 5
     assert CB_STASH_OCCUPANCY == 7
     assert CB_FEEDER_SHED == 10
     assert CB_FOLD_ROWS == 11
     assert CB_SKETCH_ROWS == 12
     assert CB_SKETCH_SHED == 13
+    assert CB_CASCADE_ROWS == 14
+    assert CB_CASCADE_SHED == 15
     # the documented field-name table mirrors the index constants
     assert len(CB_FIELDS) == CB_LEN
     assert CB_FIELDS[CB_VERSION] == "version"
@@ -113,6 +118,8 @@ def test_counter_block_layout_constants():
     assert CB_FIELDS[CB_FOLD_ROWS] == "fold_rows"
     assert CB_FIELDS[CB_SKETCH_ROWS] == "sketch_rows"
     assert CB_FIELDS[CB_SKETCH_SHED] == "sketch_shed"
+    assert CB_FIELDS[CB_CASCADE_ROWS] == "cascade_rows"
+    assert CB_FIELDS[CB_CASCADE_SHED] == "cascade_shed"
 
 
 # ---------------------------------------------------------------------------
