@@ -513,13 +513,41 @@ def config10(quick: bool):
          tuples=rec["tuples"])
 
 
+def config11(quick: bool):
+    """Live read plane (ISSUE 10): snapshot overhead on the §14 feeder
+    workload + cached vs uncached repeated-query latency via
+    bench/livebench.py (protocol: PERF.md §19). The vs line is the
+    result-cache speedup on the repeated dashboard query; the snapshot
+    ingest overhead rides the detail."""
+    import os
+    import subprocess
+
+    env = {**os.environ}
+    if quick:
+        env.update(LIVEBENCH_ITERS="16", LIVEBENCH_QUERY_REPS="20")
+    out = subprocess.run(
+        [sys.executable, "bench/livebench.py"],
+        capture_output=True, text=True, timeout=1800, env=env,
+    )
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    if rec.get("partial"):
+        emit("c11_live_read", 0, "error", 0, error=rec.get("error"))
+        return
+    q = rec["query"]
+    emit("c11_live_read", q["cached_ms"], "ms/query",
+         q["speedup_cached"],
+         uncached_ms=q["uncached_ms"], series=q["series"],
+         cache=q["cache"], ingest=rec["ingest"],
+         snap_every=rec["snap_every"], iters=rec["iters"])
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--cpu", action="store_true")
     p.add_argument("--quick", action="store_true")
     args = p.parse_args()
     for fn in (config1, config2, config3, config4, config5, config6, config7,
-               config8, config9, config10):
+               config8, config9, config10, config11):
         try:
             fn(args.quick)
         except Exception as e:  # one config must not kill the others

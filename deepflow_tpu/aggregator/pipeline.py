@@ -343,7 +343,8 @@ class RollupPipeline:
             )
 
         def step(acc, offset, start_window, stash_valid, stash_evict,
-                 feeder_shed, fold_rows, casc_lanes, sk, tag_mat, meters, valid):
+                 feeder_shed, fold_rows, casc_lanes, snap_lanes, sk,
+                 tag_mat, meters, valid):
             tags = {k: tag_mat[i] for i, k in enumerate(names)}
             aux = None
             if cap_u is not None:
@@ -367,6 +368,7 @@ class RollupPipeline:
                 sketch_rows=None if sk is None else sk.rows,
                 sketch_shed=None if sk is None else sk.shed,
                 cascade_rows=casc_lanes[0], cascade_shed=casc_lanes[1],
+                snapshot_reads=snap_lanes[0], snapshot_bytes=snap_lanes[1],
             )
             acc = _append_impl(
                 acc, window, hi, lo, doc_tags, doc_meters, gated, offset
@@ -380,14 +382,14 @@ class RollupPipeline:
             # to the pre-ISSUE-8 step: None is not a pytree leaf we want
             # in the dispatch path
             def step_plain(acc, offset, start_window, stash_valid, stash_evict,
-                           feeder_shed, fold_rows, casc_lanes, tag_mat,
-                           meters, valid):
+                           feeder_shed, fold_rows, casc_lanes, snap_lanes,
+                           tag_mat, meters, valid):
                 return step(acc, offset, start_window, stash_valid,
                             stash_evict, feeder_shed, fold_rows, casc_lanes,
-                            None, tag_mat, meters, valid)
+                            snap_lanes, None, tag_mat, meters, valid)
 
             return jax.jit(step_plain, donate_argnums=(0,))
-        return jax.jit(step, donate_argnums=(0, 8))
+        return jax.jit(step, donate_argnums=(0, 9))
 
     def _pad_target(self, rows: int) -> int:
         """Static pad size for a batch of `rows`: the smallest bucket
@@ -464,15 +466,16 @@ class RollupPipeline:
             # fused call. The sketch plane rides the same dispatch when on.
             st = self.wm.state
             casc = self.wm._cascade_lanes()
+            snap = self.wm._snapshot_lanes()
             if self.wm.sk is not None:
                 return self._step(
                     acc, offset, start_window, st.valid, st.dropped_overflow,
-                    shed, self.wm._fold_rows_dev, casc, self.wm.sk,
+                    shed, self.wm._fold_rows_dev, casc, snap, self.wm.sk,
                     staged.tag_mat, staged.meters, staged.valid,
                 )
             return self._step(
                 acc, offset, start_window, st.valid, st.dropped_overflow,
-                shed, self.wm._fold_rows_dev, casc,
+                shed, self.wm._fold_rows_dev, casc, snap,
                 staged.tag_mat, staged.meters, staged.valid,
             )
 
@@ -482,6 +485,13 @@ class RollupPipeline:
 
     def drain(self) -> list[DocBatch]:
         return self._convert_flushed(self.wm.flush_all())
+
+    def snapshot_open(self, *, force: bool = False):
+        """Live read plane (ISSUE 10): pull a read-only OpenSnapshot of
+        the open window span (rate-limited; see
+        WindowManager.snapshot_open). Ingest is untouched — the read
+        happens between dispatches and costs 2 pull-path fetches."""
+        return self.wm.snapshot_open(force=force)
 
     def _convert_flushed(self, flushed: list[FlushedWindow]) -> list[DocBatch]:
         """FlushedWindows → writer DocBatches; closed sketch blocks are

@@ -438,6 +438,30 @@ def pack_u32_columns(slot, key_hi, key_lo, tags, meters, valid=None):
     )
 
 
+def _pack_window_range(state: StashState, lo, hi):
+    """Traced: pack every live row in [lo, hi) into a row-major
+    [S, 3+T+M] u32 matrix ordered by (window, stash position) — THE
+    packed-row builder shared by the mutating range flush and the
+    read-only live snapshot (ISSUE 10), so the two emit bit-identical
+    rows for the same stash by construction. Returns (mask, packed,
+    total)."""
+    lo = jnp.asarray(lo, dtype=jnp.uint32)
+    hi = jnp.asarray(hi, dtype=jnp.uint32)
+    mask = state.valid & (state.slot >= lo) & (state.slot < hi)
+    # Stable (window, position) compaction: selected rows first,
+    # ascending window, original stash order within a window. Other rows
+    # rank as SENTINEL (> any real window — slots are < hi ≤ SENTINEL).
+    rank = jnp.where(mask, state.slot, jnp.uint32(SENTINEL_SLOT))
+    iota = jnp.arange(state.capacity, dtype=jnp.int32)
+    _, order = jax.lax.sort((rank, iota), num_keys=1)
+    cols = pack_u32_columns(
+        state.slot, state.key_hi, state.key_lo, state.tags, state.meters
+    )  # [3+T+M, S]
+    packed = jnp.take(cols, order, axis=1).T  # row-major [S, 3+T+M]
+    total = jnp.sum(mask.astype(jnp.int32))
+    return mask, packed, total
+
+
 def _flush_range_impl(state: StashState, lo_window, hi_window, *, compact: bool = False):
     """Close every window in [lo_window, hi_window): compact their rows
     to the front of ONE row-major [S, 3+T+M] u32 matrix (window-id,
@@ -458,20 +482,8 @@ def _flush_range_impl(state: StashState, lo_window, hi_window, *, compact: bool 
     lo_window ≤ every live slot (the window managers' advance protocol
     guarantees it: older windows were flushed by earlier advances).
     The flushed OUTPUT is identical either way."""
-    lo = jnp.asarray(lo_window, dtype=jnp.uint32)
-    hi = jnp.asarray(hi_window, dtype=jnp.uint32)
-    mask = state.valid & (state.slot >= lo) & (state.slot < hi)
-    # Stable (window, position) compaction: flushed rows first, ascending
-    # window, original stash order within a window. Unflushed rows rank
-    # as SENTINEL (> any real window — slots are < hi ≤ SENTINEL).
-    rank = jnp.where(mask, state.slot, jnp.uint32(SENTINEL_SLOT))
+    mask, packed, total = _pack_window_range(state, lo_window, hi_window)
     iota = jnp.arange(state.capacity, dtype=jnp.int32)
-    _, order = jax.lax.sort((rank, iota), num_keys=1)
-    cols = pack_u32_columns(
-        state.slot, state.key_hi, state.key_lo, state.tags, state.meters
-    )  # [3+T+M, S]
-    packed = jnp.take(cols, order, axis=1).T  # row-major [S, 3+T+M]
-    total = jnp.sum(mask.astype(jnp.int32))
     new_slot = jnp.where(mask, jnp.uint32(SENTINEL_SLOT), state.slot)
     new_valid = state.valid & ~mask
     if compact:
@@ -493,6 +505,24 @@ def _flush_range_impl(state: StashState, lo_window, hi_window, *, compact: bool 
 stash_flush_range = jax.jit(
     _flush_range_impl, donate_argnums=(0,), static_argnames=("compact",)
 )
+
+
+def _snapshot_range_impl(state: StashState, lo_window, hi_window):
+    """READ-ONLY twin of `_flush_range_impl` (ISSUE 10 live read plane):
+    pack every live row in [lo, hi) — same order, same layout, same
+    unpack — WITHOUT reclaiming slots, advancing anything, or
+    compacting. The stash is untouched (no donation), so a snapshot can
+    interleave anywhere between ingest dispatches and the later real
+    flush of the same windows emits bit-identical rows plus whatever
+    arrived after the snapshot. Returns (packed, total)."""
+    _, packed, total = _pack_window_range(state, lo_window, hi_window)
+    return packed, total
+
+
+# NO donation: the live stash stays valid — the snapshot writes into a
+# fresh output buffer (the "double buffer": the read never aliases the
+# plane the next append dispatch consumes).
+stash_snapshot_range = jax.jit(_snapshot_range_impl)
 
 
 def unpack_flush_rows(rows: np.ndarray, num_tags: int):

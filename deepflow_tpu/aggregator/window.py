@@ -37,6 +37,7 @@ account fetch count and bytes per direction, and wrap each host stage
 from __future__ import annotations
 
 import dataclasses
+import time
 from functools import partial
 
 import jax
@@ -48,9 +49,11 @@ from ..datamodel.schema import FLOW_METER, TAG_SCHEMA, MeterSchema, TagSchema
 from ..ops.hashing import fingerprint64
 from .cascade import CascadeConfig, TierCascade, TierFlush
 from .sketchplane import (
+    SENTINEL_WIN,
     SketchConfig,
     SketchState,
     WindowSketchBlock,
+    _flatten_open,
     sketch_drain,
     sketch_init,
     sketch_plane_step,
@@ -65,6 +68,7 @@ from ..utils.retry import (
 from ..utils.spans import (
     SPAN_FLUSH_DRAIN,
     SPAN_INGEST_DISPATCH,
+    SPAN_QUERY_SNAPSHOT,
     SPAN_STATS_FETCH,
     SPAN_WINDOW_ADVANCE,
     SPAN_WINDOW_FOLD,
@@ -81,6 +85,7 @@ from .stash import (
     stash_fold_counted,
     stash_init,
     stash_merge_fold,
+    stash_snapshot_range,
     unpack_flush_rows,
 )
 
@@ -118,8 +123,15 @@ def host_fetch(x) -> np.ndarray:
 # rollup cascade's tier folds consumed (closed child-window rows merged
 # into 1m/1h tier stashes) and cumulative tier-stash overflow sheds;
 # zero with the cascade off. Rides the same fetch as every other lane.
+# v6 (ISSUE 10): + snapshot_reads / snapshot_bytes — the live read
+# plane's cumulative pull-only snapshot count and fetched bytes (host
+# scalars riding the upload direction like feeder_shed, cached as one
+# device vector so steady state re-sends the same handle), so a live
+# dashboard's read pressure is visible in the device counter plane
+# without a new fetch. u32 lanes: bytes wrap mod 2^32 like every other
+# cumulative lane; the host ints stay authoritative.
 
-COUNTER_BLOCK_VERSION = 5
+COUNTER_BLOCK_VERSION = 6
 (
     CB_VERSION,  # constant COUNTER_BLOCK_VERSION
     CB_T_MAX,  # max valid timestamp (pre-gate)
@@ -137,13 +149,15 @@ COUNTER_BLOCK_VERSION = 5
     CB_SKETCH_SHED,  # cumulative rows the sketch plane counted-shed
     CB_CASCADE_ROWS,  # cumulative rows the cascade's tier folds consumed
     CB_CASCADE_SHED,  # cumulative tier-stash overflow sheds
-) = range(16)
-CB_LEN = 16
+    CB_SNAPSHOT_READS,  # cumulative live snapshot_open() reads
+    CB_SNAPSHOT_BYTES,  # cumulative live snapshot bytes fetched (mod 2^32)
+) = range(18)
+CB_LEN = 18
 CB_FIELDS = (
     "version", "t_max", "t_min", "n_valid", "n_late", "prereduce_shed",
     "excess_word_hits", "stash_occupancy", "stash_evictions", "ring_fill",
     "feeder_shed", "fold_rows", "sketch_rows", "sketch_shed",
-    "cascade_rows", "cascade_shed",
+    "cascade_rows", "cascade_shed", "snapshot_reads", "snapshot_bytes",
 )
 
 
@@ -188,6 +202,8 @@ def batch_counter_block(
     sketch_shed=None,
     cascade_rows=None,
     cascade_shed=None,
+    snapshot_reads=None,
+    snapshot_bytes=None,
 ):
     """`batch_stats` widened into the versioned counter block (traced).
 
@@ -216,7 +232,8 @@ def batch_counter_block(
             jnp.stack([u32(excess_hits), occ, u32(stash_evictions),
                        u32(ring_fill), u32(feeder_shed), u32(fold_rows),
                        u32(sketch_rows), u32(sketch_shed),
-                       u32(cascade_rows), u32(cascade_shed)]),
+                       u32(cascade_rows), u32(cascade_shed),
+                       u32(snapshot_reads), u32(snapshot_bytes)]),
         ]
     )
     return gated, window, block
@@ -224,20 +241,24 @@ def batch_counter_block(
 
 @partial(jax.jit, donate_argnums=(0,), static_argnames=("interval",))
 def _raw_append_step(acc, offset, start_window, stash_valid, stash_evict,
-                     feeder_shed, fold_rows, casc_lanes, timestamp, key_hi,
-                     key_lo, tags, meters, valid, *, interval):
+                     feeder_shed, fold_rows, casc_lanes, snap_lanes,
+                     timestamp, key_hi, key_lo, tags, meters, valid,
+                     *, interval):
     """One jitted call per raw doc batch: late gate + counter block +
     ring append. `stash_valid`/`stash_evict`/`fold_rows` are
     device-resident lanes folded into the block — inputs already on
     device, no transfer. `feeder_shed` is the feeder's upstream drop
     count for this batch (a host scalar riding the upload direction);
     `casc_lanes` the cascade's device [rows, shed] vector (ISSUE 9 —
-    zeros when no cascade is configured)."""
+    zeros when no cascade is configured); `snap_lanes` the live read
+    plane's [reads, bytes] vector (ISSUE 10 — a cached device handle
+    rebuilt only when a snapshot actually happens)."""
     gated, window, block = batch_counter_block(
         timestamp, valid, start_window, interval,
         stash_valid=stash_valid, stash_evictions=stash_evict, ring_fill=offset,
         feeder_shed=feeder_shed, fold_rows=fold_rows,
         cascade_rows=casc_lanes[0], cascade_shed=casc_lanes[1],
+        snapshot_reads=snap_lanes[0], snapshot_bytes=snap_lanes[1],
     )
     acc = _append_impl(acc, window, key_hi, key_lo, tags, meters, gated, offset)
     return acc, block
@@ -352,12 +373,12 @@ def sketch_span_bounds(start_window, ts, valid, *, interval: int, delay: int):
 
 @partial(
     jax.jit,
-    donate_argnums=(0, 8),
+    donate_argnums=(0, 9),
     static_argnames=("interval", "delay", "ix", "spec"),
 )
 def _raw_append_step_sk(acc, offset, start_window, stash_valid, stash_evict,
-                        feeder_shed, fold_rows, casc_lanes, sk, timestamp,
-                        key_hi, key_lo, tags, meters, valid,
+                        feeder_shed, fold_rows, casc_lanes, snap_lanes, sk,
+                        timestamp, key_hi, key_lo, tags, meters, valid,
                         *, interval, delay, ix, spec):
     """`_raw_append_step` with the per-window sketch plane fused in
     (ISSUE 8): the SAME jit dispatch updates HLL/CMS/histogram/top-K
@@ -387,9 +408,48 @@ def _raw_append_step_sk(acc, offset, start_window, stash_valid, stash_evict,
         feeder_shed=feeder_shed, fold_rows=fold_rows,
         sketch_rows=sk.rows, sketch_shed=sk.shed,
         cascade_rows=casc_lanes[0], cascade_shed=casc_lanes[1],
+        snapshot_reads=snap_lanes[0], snapshot_bytes=snap_lanes[1],
     )
     acc = _append_impl(acc, window, key_hi, key_lo, tags, meters, gated, offset)
     return acc, block, sk
+
+
+# READ-ONLY open-slot sketch snapshot (ISSUE 10): the packed [R, WIDE]
+# block rows + their window ids, no donation — the plane keeps counting.
+_sketch_open_snapshot = jax.jit(lambda sk: (_flatten_open(sk), sk.win))
+
+
+def attach_open_sketch_blocks(
+    windows: "list[FlushedWindow]", merged: dict, *,
+    interval: int, num_tags: int, num_meters: int,
+) -> "list[FlushedWindow]":
+    """THE open-snapshot block-marry rule, shared by the single-chip
+    and sharded snapshot paths (ISSUE 10): attach each window's merged
+    open sketch block, synthesize a row-less partial FlushedWindow for
+    every block whose window has no exact rows (same coverage contract
+    as the drain's sketch-only windows), and return the list sorted by
+    window. `merged` is consumed."""
+    exact = {f.window_idx for f in windows}
+    for f in windows:
+        f.sketches = merged.pop(f.window_idx, None)
+    for w in sorted(merged):
+        if w in exact:
+            continue
+        windows.append(
+            FlushedWindow(
+                window_idx=w,
+                start_time=w * interval,
+                key_hi=np.zeros((0,), np.uint32),
+                key_lo=np.zeros((0,), np.uint32),
+                tags=np.zeros((0, num_tags), np.uint32),
+                meters=np.zeros((0, num_meters), np.float32),
+                count=0,
+                sketches=merged[w],
+                partial=True,
+            )
+        )
+    windows.sort(key=lambda f: f.window_idx)
+    return windows
 
 
 @partial(jax.jit, donate_argnums=(0,), static_argnames=("interval", "delay"))
@@ -483,6 +543,13 @@ class WindowConfig:
     # advance drain's existing fetches (≤3-fetch budget intact); tier
     # windows surface via WindowManager.pop_tier_windows(). None = off.
     cascade: "CascadeConfig | None" = None
+    # Live read plane (ISSUE 10): minimum wall-clock seconds between two
+    # device snapshot reads — `snapshot_open()` calls inside the window
+    # return the cached OpenSnapshot, so a dashboard storm costs at most
+    # one 2-fetch snapshot per interval (and the result cache keyed on
+    # the snapshot seq stays hot in between). Snapshots are PULL-only:
+    # nothing is read until someone asks.
+    min_snapshot_interval: float = 0.25
 
     def __post_init__(self):
         check_fold_mode(self.fold_mode)
@@ -539,6 +606,29 @@ class FlushedWindow:
     # in tier units — consumers never rescale)
     tier: int = 0
     interval: int = 0
+    # live read plane (ISSUE 10): True = a snapshot of a still-OPEN
+    # window (rows may keep arriving; the later real flush supersedes
+    # this view). Flushed windows are always partial=False.
+    partial: bool = False
+
+
+@dataclasses.dataclass
+class OpenSnapshot:
+    """One pull of the open device-resident window span (ISSUE 10).
+
+    `windows` are partial=True FlushedWindows — same row layout and
+    (window, stash position) order as the real flush, with the open
+    sketch slots attached as (partial) WindowSketchBlocks where the
+    plane is on. `seq` increments per actual device read (rate-limited
+    by `min_snapshot_interval`; cached returns keep their seq) — the
+    querier's result cache keys its live token on it, so repeated
+    dashboards hit the cache until a NEW snapshot is taken. `open_from`
+    is the open span's first second (None = nothing ingested yet)."""
+
+    windows: list["FlushedWindow"]
+    taken_monotonic: float
+    open_from: int | None = None
+    seq: int = 0
 
 
 class WindowManager:
@@ -618,6 +708,20 @@ class WindowManager:
         self.bytes_fetched = 0
         self.bytes_uploaded = 0  # callers add their packed upload sizes
         self.feeder_shed = 0  # CB_FEEDER_SHED lane mirror
+        # live read plane (ISSUE 10): host-authoritative snapshot
+        # counters + the cached [reads, bytes] device vector riding into
+        # every dispatch's counter block (rebuilt only when a snapshot
+        # actually happens — steady state re-sends the same handle, so
+        # no per-batch upload), the rate-limit cache, and the lane
+        # mirrors the device plane reported at the last fetched block
+        # (drift beyond the in-flight dispatch = bookkeeping bug)
+        self.snapshot_reads = 0
+        self.snapshot_bytes = 0
+        self.snapshot_seq = 0
+        self._snap_lanes_dev = jnp.zeros((2,), jnp.uint32)
+        self._snapshot_cache: OpenSnapshot | None = None
+        self.device_snapshot_reads = 0
+        self.device_snapshot_bytes = 0
         # transient-failure policy (ISSUE 6): dispatch + fetch are
         # retried with backoff+jitter (per-instance decorrelated rng —
         # fault injection itself stays deterministic via the chaos
@@ -777,11 +881,17 @@ class WindowManager:
             )
         return flushed
 
-    def _split_flushed(self, rows: np.ndarray, total: int) -> list[FlushedWindow]:
+    def _split_rows(
+        self, rows: np.ndarray, total: int, *, partial: bool = False
+    ) -> list[FlushedWindow]:
+        """Packed (window, stash position)-ordered rows → per-window
+        FlushedWindows. Shared by the real flush drain and the live
+        snapshot (partial=True) so both split identically."""
+        if total == 0:
+            return []
         win, key_hi, key_lo, tags, meters = unpack_flush_rows(
             rows, self.tag_schema.num_fields
         )
-        self.total_flushed += total
         flushed = []
         bounds = np.flatnonzero(np.r_[True, win[1:] != win[:-1]]).tolist() + [total]
         for a, b in zip(bounds, bounds[1:]):
@@ -795,9 +905,14 @@ class WindowManager:
                     tags=tags[a:b],
                     meters=meters[a:b],
                     count=b - a,
+                    partial=partial,
                 )
             )
         return flushed
+
+    def _split_flushed(self, rows: np.ndarray, total: int) -> list[FlushedWindow]:
+        self.total_flushed += total
+        return self._split_rows(rows, total)
 
     def _drain_ready(self, ready) -> list[FlushedWindow]:
         if not ready:
@@ -859,6 +974,105 @@ class WindowManager:
         out, self.tier_flushed = self.tier_flushed, []
         return out
 
+    # -- live read plane (ISSUE 10) --------------------------------------
+    def _snapshot_lanes(self) -> jnp.ndarray:
+        """Device [reads, bytes] vector for the counter block's v6 lanes
+        — cached, rebuilt only when a snapshot happens, so steady-state
+        dispatches re-send the same handle (no per-batch upload)."""
+        return self._snap_lanes_dev
+
+    def snapshot_open(self, *, force: bool = False) -> OpenSnapshot:
+        """Pull a read-only snapshot of the OPEN window span: every
+        stash row with slot ≥ start_window (the accumulator ring is
+        folded in first — a pure device dispatch, zero fetches, the
+        same fold the next advance would run) plus the open sketch
+        slots, fetched in the flush drain's 2-transfer shape (one
+        scalar, one concatenated row block). The stash is untouched
+        (stash_snapshot_range does not donate), so the later real flush
+        of these windows emits the same rows plus whatever arrived
+        after the snapshot — the overlay contract the querier relies
+        on: flushed rows SUPERSEDE a window's partial snapshot.
+
+        Rate-limited: within `min_snapshot_interval` seconds the cached
+        OpenSnapshot returns (same seq — result caches stay hot);
+        `force=True` bypasses. Pull-only: ingest never takes one.
+        Caveat: the eager fold means that under stash OVERFLOW a
+        snapshot can shed at the pull instead of the next natural fold
+        — same counted-shed stance, possibly earlier (fold_mode="merge"
+        deferral note in WindowConfig)."""
+        now = time.monotonic()
+        cached = self._snapshot_cache
+        if (
+            not force
+            and cached is not None
+            and now - cached.taken_monotonic < self.config.min_snapshot_interval
+        ):
+            return cached
+        with self.tracer.span(SPAN_QUERY_SNAPSHOT):
+            snap = self._read_open_snapshot(now)
+        self.snapshot_seq += 1
+        snap.seq = self.snapshot_seq
+        self._snap_lanes_dev = jnp.asarray(
+            [self.snapshot_reads & 0xFFFFFFFF, self.snapshot_bytes & 0xFFFFFFFF],
+            dtype=jnp.uint32,
+        )
+        self._snapshot_cache = snap
+        return snap
+
+    def _read_open_snapshot(self, now: float) -> OpenSnapshot:
+        if self.start_window is None:
+            self.snapshot_reads += 1
+            return OpenSnapshot(windows=[], taken_monotonic=now)
+        b0, f0 = self.bytes_fetched, self.host_fetches
+        self._fold()  # ring rows → stash (exact; zero fetches)
+        packed, total = stash_snapshot_range(
+            self.state, np.uint32(self.start_window), _U32_MAX
+        )
+        blocks = wins = None
+        if self.sk is not None:
+            blocks, wins = _sketch_open_snapshot(self.sk)
+        total_i = int(self._fetch(jnp.asarray(total, jnp.int32)))
+        row_cols = packed.shape[1]
+        if blocks is None:
+            if total_i:
+                rows = self._fetch(packed[:total_i])
+            else:
+                rows = np.zeros((0, row_cols), np.uint32)
+            windows = self._split_rows(rows, total_i, partial=True)
+        else:
+            r, wide = blocks.shape
+            flat = self._fetch(
+                jnp.concatenate(
+                    [packed[:total_i].reshape(-1), blocks.reshape(-1), wins]
+                )
+            )
+            nb = total_i * row_cols
+            rows = flat[:nb].reshape(total_i, row_cols)
+            block_rows = flat[nb : nb + r * wide].reshape(r, wide)
+            win_np = flat[nb + r * wide :]
+            windows = self._split_rows(rows, total_i, partial=True)
+            live = win_np != np.uint32(SENTINEL_WIN)
+            open_blocks = {
+                blk.window: blk
+                for blk in unpack_drained(
+                    block_rows[live], win_np[live], self.config.sketch
+                )
+            }
+            windows = attach_open_sketch_blocks(
+                windows, open_blocks,
+                interval=self.config.interval,
+                num_tags=self.tag_schema.num_fields,
+                num_meters=self.meter_schema.num_fields,
+            )
+        self.snapshot_reads += 1
+        self.snapshot_bytes += self.bytes_fetched - b0
+        assert self.host_fetches - f0 <= 2, "snapshot must stay a 2-fetch read"
+        return OpenSnapshot(
+            windows=windows,
+            taken_monotonic=now,
+            open_from=self.start_window * self.config.interval,
+        )
+
     # -- stats processing (the ONE per-batch host sync) ------------------
     def _process_stats(self, stats_dev) -> None:
         """Fetch one batch's packed counter block and replay it through
@@ -917,6 +1131,10 @@ class WindowManager:
             self.sketch_shed = vec[CB_SKETCH_SHED]
             self.cascade_rows = vec[CB_CASCADE_ROWS]
             self.cascade_shed = vec[CB_CASCADE_SHED]
+            # live-read lanes: the host ints above stay authoritative;
+            # these are what the device plane carried at that dispatch
+            self.device_snapshot_reads = vec[CB_SNAPSHOT_READS]
+            self.device_snapshot_bytes = vec[CB_SNAPSHOT_BYTES]
         elif len(vec) == 5:  # legacy [t_max, t_min, n_valid, n_late, aux]
             t_max, t_min, n_valid, n_late, aux = vec
         else:
@@ -1016,7 +1234,7 @@ class WindowManager:
                 return _raw_append_step_sk(
                     acc, offset, start_window, st.valid, st.dropped_overflow,
                     jnp.uint32(feeder_shed), self._fold_rows_dev,
-                    self._cascade_lanes(), self.sk,
+                    self._cascade_lanes(), self._snapshot_lanes(), self.sk,
                     timestamp, key_hi, key_lo, tags, meters, valid,
                     interval=interval, delay=self.config.delay,
                     ix=self._sketch_ix, spec=self.config.sketch.hist,
@@ -1031,7 +1249,7 @@ class WindowManager:
                 return _raw_append_step(
                     acc, offset, start_window, st.valid, st.dropped_overflow,
                     jnp.uint32(feeder_shed), self._fold_rows_dev,
-                    self._cascade_lanes(),
+                    self._cascade_lanes(), self._snapshot_lanes(),
                     timestamp, key_hi, key_lo, tags, meters, valid,
                     interval=interval,
                 )
@@ -1243,6 +1461,14 @@ class WindowManager:
             "cascade_shed": self.cascade_shed,
             "tier_windows_held": len(self.tier_flushed),
             "tier_windows_dropped": self.tier_windows_dropped,
+            # live read plane (ISSUE 10, CB v6): host-authoritative
+            # snapshot accounting plus the device-plane mirrors (the
+            # lanes as of the last fetched block — they trail the host
+            # ints by at most the in-flight dispatches)
+            "snapshot_reads": self.snapshot_reads,
+            "snapshot_bytes": self.snapshot_bytes,
+            "device_snapshot_reads": self.device_snapshot_reads,
+            "device_snapshot_bytes": self.device_snapshot_bytes,
             **(self.cascade.get_counters() if self.cascade is not None else {}),
         }
 

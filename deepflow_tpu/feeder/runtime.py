@@ -271,6 +271,12 @@ class PipelineFeedSink(_FlowFrameCodec):
             self._shed_carry += held_shed
             raise
 
+    def snapshot(self):
+        """Live read plane (ISSUE 10): refresh the pipeline's open
+        window snapshot (rate-limited) — the feeder's between-pump
+        scheduling hook."""
+        return self.pipeline.snapshot_open()
+
 
 class ShardedFeedSink(_FlowFrameCodec):
     """Flow records → ShardedWindowManager (one feeder per shard
@@ -299,6 +305,11 @@ class ShardedFeedSink(_FlowFrameCodec):
 
     def flush(self) -> list:
         return []
+
+    def snapshot(self):
+        """Refresh the sharded manager's open-window snapshot (the
+        feeder's between-pump live-read hook, ISSUE 10)."""
+        return self.swm.snapshot_open()
 
 
 class WindowManagerFeedSink(FrameCodecBase):
@@ -396,6 +407,15 @@ class FeederConfig:
     # and counted (held_outputs_shed lanes) — a broken downstream must
     # not grow the hold list until the process OOMs. 0 = unbounded.
     max_held_outputs: int = 256
+    # live read plane (ISSUE 10): refresh the sink's open-window
+    # snapshot every N pumps, BETWEEN dispatches — the snapshot read
+    # never interleaves into a pump's emit sequence, so the feeder's
+    # steady-state ingest fetch budget is untouched (CI-gated,
+    # test_perf_gate::test_live_read_budget). The sink must expose
+    # `snapshot()` (PipelineFeedSink/ShardedFeedSink → snapshot_open);
+    # the refresh keeps the rate-limited snapshot warm so dashboard
+    # pulls between pumps return the cached read. 0 = off (pull-only).
+    snapshot_interval_pumps: int = 0
 
 
 class FeederRuntime:
@@ -474,7 +494,13 @@ class FeederRuntime:
             "held_output_shed_records": 0,
             "checkpoint_aborts": 0,
             "replayed_frames": 0,
+            # live read plane (ISSUE 10)
+            "snapshots_taken": 0,
+            "snapshot_errors": 0,
         }
+        self._pump_count = 0
+        self.last_snapshot = None  # most recent scheduled OpenSnapshot
+        self._snapshot_err_logged = False
         # False after a checkpoint() that aborted (barrier flush or
         # snapshot save failed) — callers that prune old checkpoints or
         # journals MUST check it before treating the call as durable.
@@ -754,6 +780,28 @@ class FeederRuntime:
             # otherwise a feeder that goes idle while degraded sheds the
             # first frames that arrive after the device already recovered
             self._probe_countdown = 0
+        # live snapshot scheduling (ISSUE 10): AFTER the pump's last
+        # emit, BEFORE the next pump's first dispatch — the read-only
+        # snapshot never stalls the feed path, and snapshot_open's rate
+        # limit makes an over-eager schedule harmless. Guarded: a broken
+        # snapshot path degrades the live view, never the pump.
+        if self.config.snapshot_interval_pumps > 0:
+            self._pump_count += 1
+            if (
+                self._pump_count % self.config.snapshot_interval_pumps == 0
+                and hasattr(self.sink, "snapshot")
+            ):
+                try:
+                    self.last_snapshot = self.sink.snapshot()
+                    self._count("snapshots_taken")
+                except Exception:
+                    self._count("snapshot_errors")
+                    if not self._snapshot_err_logged:
+                        self._snapshot_err_logged = True
+                        _log.exception(
+                            "feeder %s: open-window snapshot failed — live "
+                            "reads degrade to flushed-only", self.name,
+                        )
         return out
 
     def flush(self) -> list:

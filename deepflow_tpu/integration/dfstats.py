@@ -267,3 +267,200 @@ def sketch_system_sink(store, interval: int = 1, **row_kw):
             )
 
     return sink
+
+
+# ---------------------------------------------------------------------------
+# Live read plane (ISSUE 10): the open-window overlay's dogfood
+# adapters. Two kinds of live source plug into querier/live.LiveRegistry:
+#
+#   * `live_flow_source(pipeline_or_manager)` — per-flow rows of the
+#     OPEN windows from `snapshot_open()`, in the prometheus-samples
+#     shape (one `deepflow_flow_bytes{key=…}` series per stash row).
+#     The SAME `flow_window_rows` builder serves a closed window's
+#     flushed rows (`flow_window_sink`), so the live partial and the
+#     post-flush value are bit-exact by construction once the window's
+#     traffic stops — the acceptance pin in tests/test_live_read.py.
+#   * `live_system_source(collector)` — the CURRENT counter values of
+#     every registered Countable (StatsCollector.sample — no sink, no
+#     store write), stamped at the query's upper time edge: feeder
+#     health and device counter lanes answer at sub-`delay` latency
+#     instead of waiting for the next collector tick. deepflow_tpu
+#     observing itself in real time.
+
+LIVE_METRIC_FLOW_BYTES = "deepflow_flow_bytes"
+
+
+def flow_window_rows(
+    f, *, metric: str = LIVE_METRIC_FLOW_BYTES, meter_col: int | None = None,
+    meter_schema=None,
+) -> list[tuple[int, str, dict, float]]:
+    """One (open-partial OR flushed) window's rows → samples rows: a
+    series per flow key (labels: the 64-bit fingerprint + window id),
+    value = the chosen meter column (default byte_tx). Shared by the
+    live source and the closed-window sink so the two emit identical
+    values for identical window content."""
+    if meter_col is None:
+        from ..datamodel.schema import FLOW_METER
+
+        meter_col = (meter_schema or FLOW_METER).index("byte_tx")
+    rows = []
+    for i in range(f.count):
+        rows.append(
+            (
+                f.start_time, metric,
+                {"key": f"{int(f.key_hi[i]):08x}{int(f.key_lo[i]):08x}",
+                 "window": str(f.window_idx)},
+                float(f.meters[i, meter_col]),
+            )
+        )
+    return rows
+
+
+class PipelineLiveSource:
+    """LiveRegistry provider over an object exposing `snapshot_open()`
+    (RollupPipeline, WindowManager, ShardedWindowManager): open-window
+    partial rows in the samples shape. `epoch()` returns the snapshot
+    seq — and may TAKE the (rate-limited) snapshot, so the result
+    cache's live token names exactly the generation a subsequent
+    evaluation reads.
+
+    Two correctness/efficiency guards on top of the raw snapshot:
+
+      * windows the manager has CLOSED since the (rate-limited)
+        snapshot was cached are dropped at pull time, using the
+        manager's host-side `start_window` (a plain int — no device
+        read). A closed window's flushed rows are in (or en route to)
+        the store; serving its stale partial alongside them would
+        double-count in SQL aggregates, which have no per-series
+        last-sample-wins dedup the way PromQL does.
+      * rows are BUILT once per snapshot generation and cached; a
+        range query's per-step pulls slice the prebuilt columns with a
+        numpy time mask instead of rebuilding per-row label dicts
+        O(steps × rows) times."""
+
+    def __init__(self, owner, row_builder=flow_window_rows):
+        self.owner = owner
+        self.row_builder = row_builder
+        self._built: tuple | None = None  # (seq, columns dict | None)
+
+    def _open_lo(self):
+        """The manager's CURRENT open-span start in seconds (host int;
+        None = nothing ingested) — fresher than the cached snapshot."""
+        wm = getattr(self.owner, "wm", self.owner)
+        sw = getattr(wm, "start_window", None)
+        if sw is None:
+            return None
+        interval = getattr(wm, "interval", None)
+        if interval is None:
+            interval = wm.config.interval
+        return sw * interval
+
+    def _columns(self):
+        snap = self.owner.snapshot_open()
+        if self._built is not None and self._built[0] == snap.seq:
+            return self._built[1]
+        rows = []
+        for w in snap.windows:
+            rows.extend(self.row_builder(w))
+        cols = sketch_rows_to_columns(rows) if rows else None
+        self._built = (snap.seq, cols)
+        return cols
+
+    def __call__(self, lo: int, hi: int):
+        cols = self._columns()
+        if cols is None:
+            return None
+        t = np.asarray(cols["time"], np.int64)
+        open_lo = self._open_lo()
+        # flushed supersedes: a window below the CURRENT open span has
+        # closed since the snapshot — its flushed rows own the answer
+        floor = lo if open_lo is None else max(lo, open_lo)
+        sel = (t >= floor) & (t < hi)
+        if not sel.any():
+            return None
+        if sel.all():
+            return cols
+        return {k: np.asarray(v)[sel] for k, v in cols.items()}
+
+    def epoch(self) -> int:
+        return self.owner.snapshot_open().seq
+
+    def open_from(self):
+        of = self.owner.snapshot_open().open_from
+        open_lo = self._open_lo()
+        if of is None or open_lo is None:
+            return of
+        return max(of, open_lo)
+
+
+def live_flow_source(
+    owner, *, db: str = DEEPFLOW_SYSTEM_DB, table: str = DEEPFLOW_SYSTEM_TABLE,
+    registry=None, row_builder=flow_window_rows,
+):
+    """Register an open-window flow source for (db, table); returns
+    (provider, handle) — pass the handle to registry.unregister at
+    teardown."""
+    from ..querier.live import default_live_registry
+
+    reg = default_live_registry if registry is None else registry
+    provider = PipelineLiveSource(owner, row_builder)
+    return provider, reg.register(db, table, provider)
+
+
+def flow_window_sink(store, **row_kw):
+    """→ callable(windows) writing CLOSED windows' rows through the
+    same `flow_window_rows` builder the live source uses — window
+    close = insert = store epoch bump = result-cache invalidation."""
+    ensure_system_table(store)
+
+    def sink(windows) -> None:
+        rows = []
+        for f in windows:
+            rows.extend(flow_window_rows(f, **row_kw))
+        if rows:
+            store.insert(
+                DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE,
+                sketch_rows_to_columns(rows),
+            )
+
+    return sink
+
+
+class SystemLiveSource:
+    """LiveRegistry provider pulling the CURRENT Countable counters
+    (collector.sample — no sinks, no store writes) stamped at the
+    query's upper time edge."""
+
+    def __init__(self, collector=None):
+        from ..utils.stats import default_collector
+
+        self.collector = default_collector if collector is None else collector
+        self._pulls = 0
+
+    def __call__(self, lo: int, hi: int):
+        # stamp at the query's upper edge, clamped into the u32 time
+        # column's range — an unbounded SQL range passes hi = 2^62 and
+        # an unclamped stamp would overflow the dtype (and silently
+        # drop the whole overlay via the registry's containment)
+        t = int(max(min(lo, 0xFFFFFFFF), min(hi - 1, 0xFFFFFFFF)))
+        points = self.collector.sample(now=float(t))
+        self._pulls += 1
+        cols = points_to_system_columns(points)
+        return cols if len(cols["time"]) else None
+
+    def epoch(self) -> int:
+        # counters move continuously — every pull is a new generation,
+        # so cached entries over live counters never serve stale values
+        return self._pulls
+
+
+def live_system_source(collector=None, *, registry=None):
+    """Register the self-telemetry live source on
+    deepflow_system.deepflow_system; returns (provider, handle)."""
+    from ..querier.live import default_live_registry
+
+    reg = default_live_registry if registry is None else registry
+    provider = SystemLiveSource(collector)
+    return provider, reg.register(
+        DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE, provider
+    )

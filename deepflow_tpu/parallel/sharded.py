@@ -41,6 +41,7 @@ from ..aggregator.sketchplane import (
     SketchConfig,
     SketchState,
     _drain_impl as _sketch_drain_impl,
+    _flatten_open,
     hold_blocks,
     sketch_init,
     sketch_plane_step,
@@ -56,6 +57,7 @@ from ..utils.retry import (
 from ..utils.spans import (
     SPAN_FLUSH_DRAIN,
     SPAN_INGEST_DISPATCH,
+    SPAN_QUERY_SNAPSHOT,
     SPAN_WINDOW_ADVANCE,
     SPAN_WINDOW_FOLD,
     SpanTracer,
@@ -174,6 +176,7 @@ class ShardedPipeline:
         self._flush = self._build_flush()
         self._flush_range = self._build_flush_range()
         self._sketch_drain = self._build_sketch_drain()
+        self._snapshot = self._build_snapshot()
         # per-ratio tier-fold kernels (ISSUE 9), built on first use —
         # the cascade fires only on window advances
         self._tier_fold_cache: dict[int, object] = {}
@@ -424,6 +427,38 @@ class ShardedPipeline:
         pend_win [D, P], pend_n [D])."""
         return self._sketch_drain(sketches, jnp.uint32(close_below))
 
+    # -- live read plane (ISSUE 10) --------------------------------------
+    def _build_snapshot(self):
+        """READ-ONLY per-device snapshot of the open span: the sharded
+        twin of stash.stash_snapshot_range fused with the open-slot
+        sketch flatten — one device call, NO donation (the live stash
+        and plane are untouched), outputs fetched by the manager in the
+        drain's 2-transfer shape."""
+        from ..aggregator.stash import _snapshot_range_impl
+
+        def snap(stash, sk, lo):
+            stash1 = jax.tree.map(lambda x: x[0], stash)
+            sk1 = jax.tree.map(lambda x: x[0], sk)
+            packed, total = _snapshot_range_impl(
+                stash1, lo, jnp.uint32(0xFFFFFFFF)
+            )
+            blocks = _flatten_open(sk1)
+            return packed[None], total[None], blocks[None], sk1.win[None]
+
+        pspec = P(self.axes)
+        mapped = shard_map(
+            snap,
+            mesh=self.mesh,
+            in_specs=(pspec, pspec, P()),
+            out_specs=(pspec, pspec, pspec, pspec),
+        )
+        return jax.jit(mapped)
+
+    def snapshot_open_ranges(self, stash, sketches, lo_window):
+        """Dispatch the read-only snapshot: (packed [D, S, 3+T+M],
+        totals [D], blocks [D, R, WIDE], wins [D, R])."""
+        return self._snapshot(stash, sketches, jnp.uint32(lo_window))
+
     # -- doc flush ------------------------------------------------------
     def _build_flush(self):
         from ..aggregator.stash import stash_flush
@@ -629,10 +664,12 @@ class ShardedWindowManager:
     """
 
     def __init__(self, pipe: ShardedPipeline, delay: int = 2,
-                 *, tracer: SpanTracer | None = None):
+                 *, tracer: SpanTracer | None = None,
+                 min_snapshot_interval: float = 0.25):
         self.pipe = pipe
         self.interval = pipe.config.interval
         self.delay = delay
+        self.min_snapshot_interval = min_snapshot_interval
         self._sk_cfg = pipe.config.sketch_config()
         ring_needed = delay // pipe.config.interval + 2
         if pipe.config.sketch_ring < ring_needed:
@@ -704,6 +741,14 @@ class ShardedWindowManager:
         self.host_fetches = 0
         self.bytes_fetched = 0
         self.bytes_uploaded = 0
+        # live read plane (ISSUE 10): pull-only open-span snapshots
+        # (read-only per-device pack, host-merged) — rate-limited like
+        # the single-chip twin; the sharded path has no device counter
+        # block, so the host ints are the only accounting
+        self.snapshot_reads = 0
+        self.snapshot_bytes = 0
+        self.snapshot_seq = 0
+        self._snapshot_cache = None
         # transient-failure policy (ISSUE 6) — the single-chip
         # WindowManager's twin: dispatch + fetch retry with
         # decorrelated backoff+jitter; same admission-time-only caveat
@@ -782,6 +827,9 @@ class ShardedWindowManager:
             "cascade_tier_windows": self.tier_windows_flushed,
             "tier_windows_held": len(self.tier_flushed),
             "tier_windows_dropped": self.tier_windows_dropped,
+            # live read plane (ISSUE 10): pull-only snapshot accounting
+            "snapshot_reads": self.snapshot_reads,
+            "snapshot_bytes": self.snapshot_bytes,
         }
 
     def pop_closed_sketches(self) -> list:
@@ -1083,6 +1131,111 @@ class ShardedWindowManager:
                 self.tier_fills[i] = jax.tree.map(
                     jnp.zeros_like, self.tier_fills[i]
                 )
+
+    # -- live read plane (ISSUE 10) --------------------------------------
+    def snapshot_open(self, *, force: bool = False):
+        """Pull a read-only snapshot of the open window span from every
+        device stash + open sketch slot, host-merged: exact rows
+        concatenate device-major per window (the same order the real
+        drain emits) and per-window sketch blocks merge by the r12
+        algebra (register max / counter add / candidate union). The
+        device state is untouched — no donation, no advance — so the
+        later real flush supersedes these partials row-for-row.
+
+        Same 2-transfer shape as the drain ([D] totals + one
+        concatenated row block), rate-limited by
+        `min_snapshot_interval`; returns aggregator.window.OpenSnapshot
+        with partial=True FlushedWindows."""
+        import time as _time
+
+        now = _time.monotonic()
+        cached = self._snapshot_cache
+        if (
+            not force
+            and cached is not None
+            and now - cached.taken_monotonic < self.min_snapshot_interval
+        ):
+            return cached
+        with self.tracer.span(SPAN_QUERY_SNAPSHOT):
+            snap = self._read_open_snapshot(now)
+        self.snapshot_seq += 1
+        snap.seq = self.snapshot_seq
+        self._snapshot_cache = snap
+        return snap
+
+    def _read_open_snapshot(self, now: float):
+        from ..aggregator.sketchplane import SENTINEL_WIN
+        from ..aggregator.stash import unpack_flush_rows
+        from ..aggregator.window import FlushedWindow, OpenSnapshot
+
+        if self.start_window is None:
+            self.snapshot_reads += 1
+            return OpenSnapshot(windows=[], taken_monotonic=now)
+        b0 = self.bytes_fetched
+        self._fold()  # per-device ring rows → stashes (exact, no fetch)
+        packed, totals, blocks, wins = self.pipe.snapshot_open_ranges(
+            self.stash, self.sketches, self.start_window
+        )
+        d = self.pipe.n_devices
+        totals_np = self._fetch(totals)
+        max_t = int(totals_np.max())
+        row_cols = packed.shape[2]
+        r, wide = blocks.shape[1], blocks.shape[2]
+        flat = self._fetch(
+            jnp.concatenate(
+                [
+                    packed[:, :max_t].reshape(-1),
+                    blocks.reshape(-1),
+                    wins.reshape(-1),
+                ]
+            )
+        )
+        nb = d * max_t * row_cols
+        rows = flat[:nb].reshape(d, max_t, row_cols)
+        block_rows = flat[nb : nb + d * r * wide].reshape(d, r, wide)
+        win_np = flat[nb + d * r * wide :].reshape(d, r)
+        per_dev = [
+            unpack_flush_rows(rows[dev, : int(t)], TAG_SCHEMA.num_fields)
+            for dev, t in enumerate(totals_np)
+        ]
+        windows: list[FlushedWindow] = []
+        for w in sorted({int(w) for win, *_ in per_dev for w in np.unique(win)}):
+            hi = np.concatenate([h[win == w] for win, h, _, _, _ in per_dev])
+            lo = np.concatenate([l[win == w] for win, _, l, _, _ in per_dev])
+            tg = np.concatenate([t[win == w] for win, _, _, t, _ in per_dev])
+            mt = np.concatenate([m[win == w] for win, _, _, _, m in per_dev])
+            windows.append(
+                FlushedWindow(
+                    window_idx=w,
+                    start_time=w * self.interval,
+                    key_hi=hi, key_lo=lo, tags=tg, meters=mt,
+                    count=int(tg.shape[0]), partial=True,
+                )
+            )
+        # open sketch slots: host-merge per window across devices (the
+        # r12 algebra), then the shared marry rule builds the final list
+        merged: dict[int, object] = {}
+        for dev in range(d):
+            wd = win_np[dev]
+            live = wd != np.uint32(SENTINEL_WIN)
+            for blk in unpack_drained(
+                block_rows[dev][live], wd[live], self._sk_cfg
+            ):
+                have = merged.get(blk.window)
+                merged[blk.window] = blk if have is None else have.merge(blk)
+        windows = window_mod.attach_open_sketch_blocks(
+            windows, merged,
+            interval=self.interval,
+            num_tags=TAG_SCHEMA.num_fields,
+            num_meters=FLOW_METER.num_fields,
+        )
+        self.snapshot_reads += 1
+        self.snapshot_bytes += self.bytes_fetched - b0
+        return OpenSnapshot(
+            windows=windows,
+            taken_monotonic=now,
+            open_from=self.start_window * self.interval,
+        )
 
     def ingest(self, tags, meters, valid):
         """Feed one flow batch (leading dim divisible by device count);

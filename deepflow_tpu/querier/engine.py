@@ -57,6 +57,12 @@ _AGG_WRAPPERS = {"persecond", "percentage", "derivative", "nonnegativederivative
 class Result:
     columns: list[str]
     values: dict[str, np.ndarray]
+    # live read plane (ISSUE 10): True when open-window partial rows
+    # from a registered live source contributed to this result — the
+    # values for the open span may still grow until the window closes
+    # and its flushed rows supersede the partials (stale=false,
+    # partial=true in the reference's result-marker terms)
+    partial: bool = False
 
     @property
     def rows(self) -> int:
@@ -70,16 +76,52 @@ class Result:
 
 
 class QueryEngine:
-    def __init__(self, store, translator: Translator | None = None):
+    def __init__(self, store, translator: Translator | None = None,
+                 *, live=None, cache=None):
+        from .live import default_live_registry, default_query_cache
+
         self.store = store
         self.translator = translator or Translator(store)
+        # live read plane (ISSUE 10): open-window overlay providers and
+        # the repeated-dashboard result cache (None = process defaults;
+        # cache=False disables caching for this engine)
+        self.live = default_live_registry if live is None else live
+        if cache is None or cache is True:
+            self.cache = default_query_cache
+        elif cache is False:
+            self.cache = None
+        else:
+            self.cache = cache
 
     # -- public ---------------------------------------------------------
     def execute(self, sql: str) -> Result:
         q = parse(sql)
         if isinstance(q, Show):
             return self._run_show(q)
-        db, table = self._resolve_table(q.table, step=_requested_step(q))
+        # the hoisted time range drives partition pruning, the live
+        # overlay AND live-aware tier selection — computed before
+        # resolution (it reads only the raw WHERE AST)
+        trange = _time_range(q.where) if q.where is not None else None
+        db, table = self._resolve_table(
+            q.table, step=_requested_step(q), trange=trange
+        )
+        key = token = None
+        if self.cache is not None:
+            from .live import cache_token
+
+            key = ("sql", sql, db, table, getattr(self.store, "uid", id(self.store)))
+            # token BEFORE evaluation: a pipeline provider's epoch() may
+            # take the rate-limited snapshot the evaluation then reads
+            token = cache_token(self.store, db, table, self.live)
+            hit = self.cache.lookup(key, token)
+            if hit is not None:
+                return hit
+        res = self._execute_resolved(q, db, table, trange)
+        if self.cache is not None:
+            self.cache.store(key, token, res)
+        return res
+
+    def _execute_resolved(self, q: Query, db: str, table: str, trange) -> Result:
         schema = self.store.schema(db, table)
         colnames = set(schema.column_names())
 
@@ -146,7 +188,6 @@ class QueryEngine:
         if unknown:
             raise SQLError(f"unknown columns for {table}: {sorted(unknown)}")
 
-        trange = _time_range(q.where) if q.where is not None else None
         if star:
             scan_cols = None  # SELECT * reads everything
         elif needed:
@@ -155,19 +196,58 @@ class QueryEngine:
             scan_cols = [schema.time_column]  # SELECT Count(): cheapest column
         cols = self.store.scan(db, table, time_range=trange, columns=scan_cols)
         n = len(next(iter(cols.values()))) if cols else 0
-        ctx = _EvalCtx(cols, n, table, self.translator)
 
+        # open-window overlay (ISSUE 10): append live partial rows when
+        # a provider is registered and serves every scanned column. The
+        # WHERE mask applies to them identically; the result is marked
+        # partial iff any live row survived it.
+        n_store = n
+        if self.live.has(db, table) and cols:
+            lo, hi = trange if trange is not None else (0, 1 << 62)
+            lv = self.live.columns(db, table, lo, hi)
+            if lv is not None and all(k in lv for k in cols):
+                lt = np.asarray(lv[schema.time_column], np.int64)
+                sel = (lt >= lo) & (lt < hi)
+                if sel.any():
+                    cols = {
+                        k: np.concatenate(
+                            [np.asarray(cols[k]), np.asarray(lv[k])[sel]]
+                        )
+                        for k in cols
+                    }
+                    n = n_store + int(sel.sum())
+
+        ctx = _EvalCtx(cols, n, table, self.translator)
         mask = None
         if q.where is not None:
             mask = np.asarray(ctx.eval(q.where), bool)
             ctx = ctx.masked(mask)
+        partial = bool(
+            mask[n_store:].any() if mask is not None else n > n_store
+        )
 
         if has_agg:
-            return self._run_aggregate(q, ctx, table, schema, trange)
-        return self._run_plain(q, ctx, schema)
+            res = self._run_aggregate(q, ctx, table, schema, trange)
+        else:
+            res = self._run_plain(q, ctx, schema)
+        res.partial = partial
+        return res
 
     # -- helpers --------------------------------------------------------
-    def _resolve_table(self, name: str, step: int | None = None) -> tuple[str, str]:
+    def _touches_open(self, db: str, table: str, trange) -> bool:
+        """Does a query over `trange` reach into the open span a live
+        provider serves? Unbounded upper ranges always do; bounded ones
+        only when they extend past the provider's first open second."""
+        if not self.live.has(db, table):
+            return False
+        if trange is None or trange[1] >= (1 << 61):
+            return True
+        of = self.live.open_from(db, table)
+        return of is not None and trange[1] > of
+
+    def _resolve_table(
+        self, name: str, step: int | None = None, trange=None
+    ) -> tuple[str, str]:
         # accept db.table / table.granularity / bare table
         cand = name.replace(".", "_")
         parts = name.split(".", 1)
@@ -183,7 +263,10 @@ class QueryEngine:
         # query's interval step, so month-scale range queries read the
         # cascade's bounded 1m/1h tiers instead of replaying 1s rows.
         # Explicit granularities ("network.1s") never reroute — they
-        # resolved above.
+        # resolved above. ISSUE 10: when the range touches the open
+        # span, a LIVE-covered tier beats a coarser one without
+        # coverage (the coarser rows would miss the freshest seconds
+        # the overlay exists to serve).
         from .translation import TIER_SUFFIX_S, select_datasource_tier
 
         for db in self.store.databases():
@@ -192,7 +275,10 @@ class QueryEngine:
                 t = f"{cand}_{suffix}"
                 if t in self.store.tables(db):
                     avail[t] = s
-            pick = select_datasource_tier(avail, step)
+            live_set = {
+                t for t in avail if self._touches_open(db, t, trange)
+            }
+            pick = select_datasource_tier(avail, step, live_tables=live_set)
             if pick is not None:
                 return db, pick
         raise SQLError(f"no such table {name!r}")

@@ -13,6 +13,20 @@ sample per series wins, Prometheus staleness semantics simplified).
 Range queries: query_range evaluates the instant expression at each
 step over [start, end] and returns per-series value arrays — the
 /api/v1/query_range shape.
+
+Live read plane (ISSUE 10): when a LiveRegistry (querier/live.py)
+carries a provider for (db, table), both entry points merge the
+provider's open-window partial rows with the flushed scan — a range
+query ending "now" returns rows from the currently OPEN window. Any
+result row whose value used a live sample carries `"partial": True`
+(Prometheus result-marker style; absent otherwise), and because
+flushed rows supersede a window's partials, the same query returns
+identical values unmarked once the window closes (pinned bit-exact in
+tests/test_live_read.py). Results are cached through
+live.QueryResultCache keyed on (query, db, table, time args) and
+validated against (store write epoch, live snapshot generation) — the
+repeated-dashboard path costs a dict lookup until a window closes or a
+new snapshot lands.
 """
 
 from __future__ import annotations
@@ -22,6 +36,13 @@ import re
 import numpy as np
 
 from ..storage.store import ColumnarStore
+from .live import (
+    LiveRegistry,
+    QueryResultCache,
+    cache_token,
+    default_live_registry,
+    default_query_cache,
+)
 
 _QUERY_RE = re.compile(
     r"^\s*(?:(?P<agg>sum|avg|max|min|count)\s*(?:by\s*\((?P<by>[^)]*)\)\s*)?\(\s*)?"
@@ -58,6 +79,15 @@ def _parse_matchers(text: str | None) -> list[tuple[str, str, str]]:
 from ..integration.formats import unpack_tags as _label_dict
 
 
+def _row(labels: dict, value: float, partial: bool) -> dict:
+    """One result row; `partial` is present ONLY when True (the live
+    marker must not change the shape of flushed-only results)."""
+    out = {"labels": labels, "value": value}
+    if partial:
+        out["partial"] = True
+    return out
+
+
 def query_instant(
     store: ColumnarStore,
     query: str,
@@ -66,13 +96,16 @@ def query_instant(
     lookback_s: int = 300,
     db: str = "prometheus",
     table: str = "samples",
+    live: "LiveRegistry | None" = None,
 ) -> list[dict]:
     """→ [{"labels": {...}, "value": float}] — instant vector at time t.
 
     `db`/`table` default to the remote-write store; pass
     db="deepflow_system", table="deepflow_system" to evaluate over the
     framework's own dogfooded telemetry (integration/dfstats) — the
-    table shares the samples row shape by construction."""
+    table shares the samples row shape by construction. `live` (default:
+    the process-wide registry) supplies open-window partial rows; rows
+    whose value used one carry `"partial": True`."""
     m = _QUERY_RE.match(query)
     if not m:
         raise PromQLError(f"unsupported query {query!r}")
@@ -93,10 +126,34 @@ def query_instant(
         raise PromQLError("rate() needs a [range]")
 
     cols = store.scan(db, table, time_range=(t - window, t + 1))
+    n_store = len(cols["time"]) if cols else 0
+    is_live = np.zeros(n_store, bool)
+    reg = default_live_registry if live is None else live
+    if reg.has(db, table):
+        # open-window overlay: live partial rows join the flushed scan.
+        # Flushed rows for the same (series, time) supersede at the
+        # last-sample-wins stage below (live rows sort FIRST on time
+        # ties via the is_live sort key), so a window that closed
+        # between snapshot and query never double-reports.
+        lv = reg.columns(db, table, t - window, t + 1)
+        if lv is not None and all(
+            k in lv for k in ("time", "metric", "labels", "value")
+        ):
+            lt = np.asarray(lv["time"], np.int64)
+            sel_t = (lt >= t - window) & (lt < t + 1)
+            if sel_t.any():
+                cols = {
+                    k: np.concatenate(
+                        [np.asarray(cols[k]), np.asarray(lv[k])[sel_t]]
+                    )
+                    for k in ("time", "metric", "labels", "value")
+                }
+                is_live = np.r_[is_live, np.ones(int(sel_t.sum()), bool)]
+
     sel = cols["metric"] == m.group("metric")
     labels_packed = cols["labels"]
     rows = np.nonzero(sel)[0]
-    series: dict[str, list[tuple[int, float]]] = {}
+    series: dict[str, list[tuple[int, int, float]]] = {}
     for i in rows:
         packed = str(labels_packed[i])
         lab = _label_dict(packed)
@@ -111,11 +168,16 @@ def query_instant(
                 keep = False
         if keep:
             series.setdefault(packed, []).append(
-                (int(cols["time"][i]), float(cols["value"][i]))
+                # sort key (time, rank) with rank 0 = live, 1 = flushed:
+                # on a time tie the FLUSHED sample sorts last and wins
+                # the instant value (flushed supersedes partials)
+                (int(cols["time"][i]), 0 if is_live[i] else 1,
+                 float(cols["value"][i]))
             )
 
-    # per-series instant value
+    # per-series instant value (+ whether a live sample produced it)
     per_series: dict[str, float] = {}
+    partials: dict[str, bool] = {}
     for packed, samples in series.items():
         samples.sort()
         if is_rate:
@@ -126,11 +188,13 @@ def query_instant(
             # decrease means the counter restarted from ~0, so the true
             # increase across the reset is the new value itself
             dv = 0.0
-            for (_, prev), (_, cur) in zip(samples, samples[1:]):
+            for (_, _, prev), (_, _, cur) in zip(samples, samples[1:]):
                 dv += cur - prev if cur >= prev else cur
             per_series[packed] = dv / dt if dt > 0 else 0.0
+            partials[packed] = any(rank == 0 for _, rank, _ in samples)
         else:
-            per_series[packed] = samples[-1][1]
+            per_series[packed] = samples[-1][2]
+            partials[packed] = samples[-1][1] == 0
 
     if m.group("topk"):
         # topk/bottomk(k, inner): keep the k extreme series, then fall
@@ -141,17 +205,24 @@ def query_instant(
         per_series = dict(keep)
         if agg is None:
             # rank order, not label order — the whole point of topk
-            return [{"labels": _label_dict(p), "value": v} for p, v in keep]
+            return [
+                _row(_label_dict(p), v, partials.get(p, False)) for p, v in keep
+            ]
 
     if agg is None:
         return [
-            {"labels": _label_dict(p), "value": v} for p, v in sorted(per_series.items())
+            _row(_label_dict(p), v, partials.get(p, False))
+            for p, v in sorted(per_series.items())
         ]
     groups: dict[tuple, list[float]] = {}
+    group_partial: dict[tuple, bool] = {}
     for packed, v in per_series.items():
         lab = _label_dict(packed)
         key = tuple((b, lab.get(b, "")) for b in by)
         groups.setdefault(key, []).append(v)
+        group_partial[key] = group_partial.get(key, False) or partials.get(
+            packed, False
+        )
     out = []
     for key, vals in sorted(groups.items()):
         if agg == "sum":
@@ -164,7 +235,7 @@ def query_instant(
             v = min(vals)
         else:
             v = float(len(vals))
-        out.append({"labels": dict(key), "value": v})
+        out.append(_row(dict(key), v, group_partial[key]))
     return out
 
 
@@ -178,22 +249,58 @@ def query_range(
     lookback_s: int = 300,
     db: str = "prometheus",
     table: str = "samples",
+    live: "LiveRegistry | None" = None,
+    cache: "QueryResultCache | None | bool" = None,
 ) -> list[dict]:
     """Matrix result: [{"labels": {...}, "values": [[t, v], ...]}] — the
     /api/v1/query_range evaluation (each step is an instant evaluation,
-    which is exactly Prometheus's range-query semantics)."""
+    which is exactly Prometheus's range-query semantics).
+
+    A range ending "now" includes the currently open window's partial
+    rows via the live overlay; any series that used one carries
+    `"partial": True`. Results cache through `cache` (default: the
+    process-wide live.default_query_cache; False disables) keyed on
+    (query, db, table, start, end, step) and validated against the
+    (store write epoch, live snapshot generation) token — the repeated
+    dashboard is a dict lookup until a window closes or a new snapshot
+    lands, at which point the stale entry is dropped (counted) and
+    recomputed."""
     if step <= 0:
         raise PromQLError("step must be positive")
     if end < start:
         raise PromQLError("end < start")
+    reg = default_live_registry if live is None else live
+    if cache is None or cache is True:
+        c = default_query_cache
+    elif cache is False:
+        c = None
+    else:
+        c = cache
+    key = token = None
+    if c is not None:
+        key = ("promql_range", query, db, table, start, end, step,
+               lookback_s, getattr(store, "uid", id(store)))
+        # token BEFORE evaluation: a pipeline provider's epoch() may
+        # take the rate-limited snapshot, so the generation the token
+        # names is the one the evaluation below reads
+        token = cache_token(store, db, table, reg)
+        hit = c.lookup(key, token)
+        if hit is not None:
+            return hit
     series: dict[tuple, dict] = {}
     for t in range(start, end + 1, step):
         for row in query_instant(
-            store, query, t, lookback_s=lookback_s, db=db, table=table
+            store, query, t, lookback_s=lookback_s, db=db, table=table,
+            live=reg,
         ):
-            key = tuple(sorted(row["labels"].items()))
-            s = series.get(key)
+            skey = tuple(sorted(row["labels"].items()))
+            s = series.get(skey)
             if s is None:
-                s = series[key] = {"labels": row["labels"], "values": []}
+                s = series[skey] = {"labels": row["labels"], "values": []}
             s["values"].append([t, row["value"]])
-    return [series[k] for k in sorted(series)]
+            if row.get("partial"):
+                s["partial"] = True
+    out = [series[k] for k in sorted(series)]
+    if c is not None:
+        c.store(key, token, out)
+    return out

@@ -53,7 +53,8 @@ TIER_SUFFIX_S = {"1s": 1, "1m": 60, "1h": 3600, "1d": 86400}
 
 
 def select_datasource_tier(
-    available: dict[str, int], step: int | None
+    available: dict[str, int], step: int | None,
+    live_tables: frozenset[str] | set[str] = frozenset(),
 ) -> str | None:
     """Pick a table from `available` ({table_name: interval_s}).
 
@@ -64,7 +65,15 @@ def select_datasource_tier(
     detail queries must not silently coarsen. A step FINER than every
     available tier returns None — answering a 30s-bucket query from
     60s rows would produce a silently wrong series, so the caller's
-    no-such-table error is the correct outcome."""
+    no-such-table error is the correct outcome.
+
+    `live_tables` (ISSUE 10): tables with a registered open-window live
+    source. When the query's range touches the open span (the engine
+    only passes a non-empty set then), a LIVE-covered tier that
+    satisfies the step beats a coarser tier without coverage — the
+    coarser rows would silently miss the freshest `delay` seconds that
+    the live overlay exists to serve. Among live-covered fits the
+    FINEST wins (it has the freshest open windows)."""
     if not available:
         return None
     by_interval = sorted(available.items(), key=lambda kv: kv[1])
@@ -75,6 +84,10 @@ def select_datasource_tier(
     fits = [
         (name, s) for name, s in by_interval if s <= step and step % s == 0
     ]
+    if fits and live_tables:
+        live_fits = [(name, s) for name, s in fits if name in live_tables]
+        if live_fits:
+            return live_fits[0][0]
     return (fits[-1] if fits else by_interval[0])[0]
 
 

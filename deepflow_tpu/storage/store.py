@@ -17,12 +17,15 @@ path; the on-disk layout is `<root>/<db>/<table>/p<partition>_<seq>.npz`.
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import json
 import re
 import threading
 from pathlib import Path
 
 import numpy as np
+
+_STORE_UIDS = itertools.count(1)
 
 DEFAULT_ORG_ID = 1
 _NAME_RE = re.compile(r"^[A-Za-z0-9_.]+$")
@@ -105,6 +108,11 @@ class _Table:
         self.path = path
         self.parts: dict[int, list] = {}  # partition → [np dict | Path]
         self.seq = 0
+        # monotonically increasing write epoch (ISSUE 10): bumped on
+        # every insert/drop so the querier's result cache can validate
+        # an entry with one integer compare instead of re-scanning —
+        # window close → flushed rows insert → epoch moves → stale
+        self.mutations = 0
 
 
 class ColumnarStore:
@@ -114,6 +122,11 @@ class ColumnarStore:
         self.root = Path(root) if root else None
         self._dbs: dict[str, dict[str, _Table]] = {}
         self._lock = threading.Lock()
+        # process-unique store identity: result-cache keys must never
+        # collide across two stores (id() can be reused after GC —
+        # same-looking mutation counts on a recycled address would
+        # serve one store's cached rows for another's query)
+        self.uid = next(_STORE_UIDS)
         if self.root is not None:
             self.root.mkdir(parents=True, exist_ok=True)
             self._load_existing()
@@ -213,6 +226,7 @@ class ColumnarStore:
         with self._lock:
             for pid, part in written:
                 t.parts.setdefault(pid, []).append(part)
+            t.mutations += 1
         return n
 
     def scan(
@@ -290,6 +304,16 @@ class ColumnarStore:
             for part in t.parts.pop(pid, []):
                 if isinstance(part, Path):
                     part.unlink(missing_ok=True)
+            t.mutations += 1
+
+    def mutation_count(self, db: str, table: str) -> int:
+        """Write epoch of one table (0 for a table that does not exist
+        yet — its creation bumps nothing, but the first insert does).
+        The querier's result cache validates entries against this: one
+        int compare per lookup, no scan (ISSUE 10)."""
+        with self._lock:
+            t = self._dbs.get(db, {}).get(table)
+            return 0 if t is None else t.mutations
 
     def disk_bytes(self, db: str | None = None) -> int:
         with self._lock:

@@ -50,6 +50,11 @@ SPAN_WINDOW_ADVANCE = "window.advance"  # fold + flush_range dispatch on window 
 SPAN_WINDOW_FOLD = "window.fold"
 SPAN_FLUSH_DRAIN = "flush.drain"  # packed flush fetch + per-window split
 SPAN_CHECKPOINT_SAVE = "checkpoint.save"  # window-state snapshot to .npz
+# live read plane (ISSUE 10): pull-only open-window snapshot reads and
+# result-cache lookups — separate names so a live dashboard's read
+# latency is attributable on its own instead of hiding in flush.drain
+SPAN_QUERY_SNAPSHOT = "query.snapshot"  # snapshot_open: fold + 2-fetch read
+SPAN_QUERY_CACHE = "query.cache"  # result-cache lookup (hit or miss)
 
 # Feeder-runtime stages (ISSUE 4) — emitted by feeder/runtime.py on its
 # own tracer; NOT in PIPELINE_SPAN_NAMES (a pipeline can run feederless,
@@ -65,6 +70,8 @@ PIPELINE_SPAN_NAMES = (
     SPAN_WINDOW_FOLD,
     SPAN_FLUSH_DRAIN,
     SPAN_CHECKPOINT_SAVE,
+    SPAN_QUERY_SNAPSHOT,
+    SPAN_QUERY_CACHE,
 )
 
 

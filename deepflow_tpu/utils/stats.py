@@ -126,22 +126,20 @@ class StatsCollector:
             self._sinks.append(sink)
 
     # -- ticking --------------------------------------------------------
-    def tick(self, now: float | None = None) -> list[StatsPoint]:
-        """Snapshot every live source once (also called by the thread).
-
-        Samples run outside the lock (a callback may register/deregister)
-        and are exception-guarded — one broken component must not kill
-        self-telemetry for the rest. Failures are COUNTED
-        (`n_source_errors`); a source that fails MAX_SOURCE_FAILURES
-        times in a row enters capped-exponential BACKOFF (one warning
-        log) and keeps being re-probed at 1, 2, 4, …, MAX_BACKOFF_TICKS
-        tick spacing instead of being dropped — a component whose
-        dependency comes back (a reconnected store, a recovered device)
-        resumes reporting, with the recovery counted and logged once
-        (`n_source_recoveries`). Sink callbacks are guarded the same
-        way (`n_sink_errors`): a broken export loop must not kill the
-        collector thread.
-        """
+    def sample(
+        self, now: float | None = None, *, _advance_backoff: bool = False
+    ) -> list[StatsPoint]:
+        """Snapshot every live source once WITHOUT sinking or ringing —
+        the pull-time read the live query plane uses (ISSUE 10:
+        integration/dfstats.live_system_source answers a query at
+        sub-tick latency from the CURRENT counters; writing those rows
+        through the sinks would turn every query into a store insert).
+        Shares tick()'s failure accounting, but only tick() ADVANCES
+        the backoff clock (`_advance_backoff`): a broken source's
+        capped-exponential re-probe spacing is measured in collector
+        ticks, and dashboard-rate pulls must neither drain it in
+        seconds nor hammer the broken source on the query path — while
+        backing off, pulls skip it without touching the cooldown."""
         now = time.time() if now is None else now
         points: list[StatsPoint] = []
         with self._lock:
@@ -151,8 +149,9 @@ class StatsCollector:
             if src.dead():
                 dead.append(src)
                 continue
-            if src.cooldown > 0:  # backing off — skip this tick
-                src.cooldown -= 1
+            if src.cooldown > 0:  # backing off — skip this round
+                if _advance_backoff:
+                    src.cooldown -= 1
                 continue
             try:
                 fields = src.sample()
@@ -194,6 +193,26 @@ class StatsCollector:
         with self._lock:
             if dead:
                 self._sources = [s for s in self._sources if s not in dead]
+        return points
+
+    def tick(self, now: float | None = None) -> list[StatsPoint]:
+        """`sample()` + sinks + ring (also called by the thread).
+
+        Samples run outside the lock (a callback may register/deregister)
+        and are exception-guarded — one broken component must not kill
+        self-telemetry for the rest. Failures are COUNTED
+        (`n_source_errors`); a source that fails MAX_SOURCE_FAILURES
+        times in a row enters capped-exponential BACKOFF (one warning
+        log) and keeps being re-probed at 1, 2, 4, …, MAX_BACKOFF_TICKS
+        tick spacing instead of being dropped — a component whose
+        dependency comes back (a reconnected store, a recovered device)
+        resumes reporting, with the recovery counted and logged once
+        (`n_source_recoveries`). Sink callbacks are guarded the same
+        way (`n_sink_errors`): a broken export loop must not kill the
+        collector thread.
+        """
+        points = self.sample(now, _advance_backoff=True)
+        with self._lock:
             sinks = list(self._sinks)
             self._ring.extend(points)
         for sink in sinks:

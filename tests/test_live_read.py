@@ -1,0 +1,575 @@
+"""ISSUE 10 live query plane: open-window snapshot read path + overlay
++ result cache.
+
+Consistency contract, pinned here: (1) interleaved `snapshot_open()`
+calls NEVER perturb the stream — flushed output with snapshots is
+bit-exact equal to the no-snapshot oracle for any advance interleaving
+(seeded fuzz, fold modes full+merge, stats_ring 1+K, single-chip AND
+sharded); (2) a window's snapshot rows, overlay-merged with its later
+flushed rows (flushed SUPERSEDES partials — the querier's rule), equal
+the flushed-only oracle bit-exact; and for a window whose traffic has
+quiesced, the snapshot IS the later flush, row for row. (3) The PromQL
+overlay returns open-window rows marked partial whose values pin
+bit-exact against the same window's post-flush values, unmarked. (4)
+The result cache hits on repeats, invalidates on window close (store
+epoch moves), evicts LRU at its bound, and its counters dogfood into
+deepflow_system like every other Countable.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from deepflow_tpu.aggregator.pipeline import L4Pipeline, PipelineConfig
+from deepflow_tpu.aggregator.window import WindowConfig, WindowManager
+from deepflow_tpu.datamodel.batch import FlowBatch
+from deepflow_tpu.datamodel.schema import FLOW_METER, TAG_SCHEMA
+from deepflow_tpu.ingest.replay import SyntheticFlowGen
+from deepflow_tpu.integration.dfstats import (
+    DEEPFLOW_SYSTEM_DB,
+    DEEPFLOW_SYSTEM_TABLE,
+    LIVE_METRIC_FLOW_BYTES,
+    PipelineLiveSource,
+    ensure_system_table,
+    flow_window_sink,
+    live_system_source,
+)
+from deepflow_tpu.querier.live import LiveRegistry, QueryResultCache, cache_token
+from deepflow_tpu.querier.promql import query_instant, query_range
+from deepflow_tpu.storage.store import ColumnarStore
+
+T0 = 1_700_000_000
+
+
+def _pipe(**wkw):
+    wkw.setdefault("capacity", 1 << 12)
+    wkw.setdefault("min_snapshot_interval", 0.0)
+    return L4Pipeline(
+        PipelineConfig(window=WindowConfig(**wkw), batch_size=256)
+    )
+
+
+def _db_sig(db):
+    return (int(db.timestamp[0]), db.size, db.tags.tobytes(), db.meters.tobytes())
+
+
+def _win_sig(f):
+    return (
+        f.window_idx, f.count, f.key_hi.tobytes(), f.key_lo.tobytes(),
+        f.tags.tobytes(), f.meters.tobytes(),
+    )
+
+
+# ---------------------------------------------------------------------------
+# (1) + (2): consistency pins
+
+
+def test_snapshot_of_quiesced_window_equals_later_flush():
+    """All of a window's rows ingested → snapshot → advance: the
+    snapshot rows ARE the flushed rows, bit-exact including order."""
+    pipe = _pipe()
+    gen = SyntheticFlowGen(num_tuples=200, seed=3)
+    for i in range(3):
+        pipe.ingest(FlowBatch.from_records(gen.records(128, T0 + i)))
+    snap = {w.window_idx: w for w in pipe.snapshot_open().windows}
+    assert snap and all(w.partial for w in snap.values())
+    # jump far enough that every snapshotted window closes
+    flushed = pipe.wm.ingest(
+        np.asarray([T0 + 50], np.uint32),
+        np.zeros(1, np.uint32), np.zeros(1, np.uint32),
+        np.zeros((TAG_SCHEMA.num_fields, 1), np.uint32),
+        np.zeros((FLOW_METER.num_fields, 1), np.float32),
+        np.ones(1, bool),
+    )
+    closed = {f.window_idx: f for f in flushed if f.count}
+    assert set(snap) <= set(closed)
+    for w, s in snap.items():
+        f = closed[w]
+        assert not f.partial and s.partial
+        assert _win_sig(f) == _win_sig(s), w  # bit-exact, order included
+
+
+@pytest.mark.parametrize("fold_mode", ["full", "merge"])
+@pytest.mark.parametrize("stats_ring", [1, 4])
+def test_snapshot_interleaving_never_perturbs_the_stream(fold_mode, stats_ring):
+    """Seeded fuzz (the test_merge_fold stance): identical streams with
+    and without interleaved snapshots produce identical flushed
+    DocBatches, and the overlay rule (flushed supersedes a window's
+    partials) reproduces the flushed-only oracle exactly."""
+    rng = np.random.default_rng(1234 + stats_ring)
+    gen_a = SyntheticFlowGen(num_tuples=300, seed=7)
+    gen_b = SyntheticFlowGen(num_tuples=300, seed=7)
+    live = _pipe(fold_mode=fold_mode, stats_ring=stats_ring, delay=3)
+    oracle = _pipe(fold_mode=fold_mode, stats_ring=stats_ring, delay=3)
+
+    t = T0
+    out_live, out_oracle = [], []
+    last_snapshot = {}
+    for step in range(14):
+        # random walk with occasional multi-window jumps + a stall
+        t += int(rng.choice([0, 1, 1, 2, 7]))
+        n = int(rng.integers(16, 200))
+        out_live += [_db_sig(d) for d in live.ingest(
+            FlowBatch.from_records(gen_a.records(n, t)))]
+        out_oracle += [_db_sig(d) for d in oracle.ingest(
+            FlowBatch.from_records(gen_b.records(n, t)))]
+        if rng.random() < 0.5:
+            snap = live.snapshot_open(force=True)
+            last_snapshot = {w.window_idx: w for w in snap.windows}
+    out_live += [_db_sig(d) for d in live.drain()]
+    out_oracle += [_db_sig(d) for d in oracle.drain()]
+    assert out_live == out_oracle, (fold_mode, stats_ring)
+    # counters that define the stream are untouched too
+    cl, co = live.get_counters(), oracle.get_counters()
+    for k in ("doc_in", "flushed_doc", "drop_before_window", "stash_evictions"):
+        assert cl[k] == co[k], k
+    assert cl["snapshot_reads"] > 0 and co["snapshot_reads"] == 0
+    assert cl["jit_retraces"] == 0
+    # overlay rule (the querier's merge): flushed SUPERSEDES a window's
+    # partial snapshot. After the drain every snapshotted window has
+    # flushed, so overlay-merging the last snapshot's partials with the
+    # flushed stream reproduces the flushed-only oracle exactly.
+    flushed_by_start = {sig[0]: sig for sig in out_oracle}
+    interval = oracle.config.window.interval
+    merged = {
+        w * interval: ("partial", s.count) for w, s in last_snapshot.items()
+    }
+    for sig in out_live:
+        merged[sig[0]] = sig  # flushed replaces any partial for its window
+    assert merged == flushed_by_start
+
+
+@pytest.mark.parametrize("n_dev", [1, 2])
+def test_sharded_snapshot_consistency(n_dev):
+    from deepflow_tpu.ops.histogram import LogHistSpec
+    from deepflow_tpu.parallel.mesh import make_mesh
+    from deepflow_tpu.parallel.sharded import (
+        ShardedConfig,
+        ShardedPipeline,
+        ShardedWindowManager,
+    )
+
+    def build():
+        mesh = make_mesh(n_dev)
+        cfg = ShardedConfig(
+            capacity_per_device=1 << 10, num_services=16, hll_precision=6,
+            hist=LogHistSpec(bins=64, vmin=1.0, gamma=1.3),
+        )
+        return ShardedWindowManager(
+            ShardedPipeline(mesh, cfg), min_snapshot_interval=0.0
+        )
+
+    rng = np.random.default_rng(99)
+    gen_a = SyntheticFlowGen(num_tuples=100, seed=9)
+    gen_b = SyntheticFlowGen(num_tuples=100, seed=9)
+    live, oracle = build(), build()
+    t = T0
+    out_live, out_oracle = [], []
+    quiesced_snap = None
+    for step in range(8):
+        t += int(rng.choice([0, 1, 2, 6]))
+        n = 32 * n_dev
+        fa, fb = gen_a.flow_batch(n, t), gen_b.flow_batch(n, t)
+        out_live += [_db_sig(d) for d in live.ingest(fa.tags, fa.meters, fa.valid)]
+        out_oracle += [_db_sig(d) for d in oracle.ingest(fb.tags, fb.meters, fb.valid)]
+        if rng.random() < 0.6:
+            quiesced_snap = live.snapshot_open(force=True)
+    snap = {w.window_idx: w for w in live.snapshot_open(force=True).windows}
+    out_live += [_db_sig(d) for d in live.drain()]
+    out_oracle += [_db_sig(d) for d in oracle.drain()]
+    assert out_live == out_oracle
+    assert live.get_counters()["snapshot_reads"] > 0
+    # the final pre-drain snapshot covered exactly the still-open span,
+    # and each of its windows' rows match the drained rows bit-exact
+    drained = {sig[0] // live.interval: sig for sig in out_live}
+    for w, s in snap.items():
+        sig = drained[w]
+        assert s.count == sig[1]
+        assert s.tags.tobytes() == sig[2] and s.meters.tobytes() == sig[3]
+
+
+# ---------------------------------------------------------------------------
+# (3): PromQL overlay — the acceptance pin
+
+
+def _doc_ingest(wm: WindowManager, t: int, keys: list[int], byte_tx: float):
+    n = len(keys)
+    ts = np.full(n, t, np.uint32)
+    hi = np.asarray(keys, np.uint32)
+    lo = np.asarray(keys, np.uint32) + 1
+    tags = np.zeros((TAG_SCHEMA.num_fields, n), np.uint32)
+    meters = np.zeros((FLOW_METER.num_fields, n), np.float32)
+    meters[FLOW_METER.index("byte_tx")] = byte_tx
+    return wm.ingest(ts, hi, lo, tags, meters, np.ones(n, bool))
+
+
+def test_promql_range_ending_now_returns_open_window_partial_bit_exact():
+    """THE acceptance criterion: a query_range whose range ends 'now'
+    returns rows from the currently open window marked partial; after
+    the window flushes, the same query returns the SAME values
+    unmarked."""
+    store = ColumnarStore()
+    ensure_system_table(store)
+    reg = LiveRegistry()
+    wm = WindowManager(WindowConfig(capacity=1 << 10, min_snapshot_interval=0.0))
+    reg.register(DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE, PipelineLiveSource(wm))
+    sink = flow_window_sink(store)
+
+    flushed = []
+    flushed += _doc_ingest(wm, T0, [10, 20], 100.0)
+    flushed += _doc_ingest(wm, T0 + 1, [10], 7.0)
+    # windows T0, T0+1 are open; range ends "now" (T0+1)
+    live_out = query_range(
+        store, LIVE_METRIC_FLOW_BYTES, T0, T0 + 1, 1,
+        db=DEEPFLOW_SYSTEM_DB, table=DEEPFLOW_SYSTEM_TABLE,
+        live=reg, cache=False, lookback_s=1,
+    )
+    assert live_out, "open windows invisible — the blind spot is back"
+    assert all(s.get("partial") for s in live_out)
+    live_vals = {
+        tuple(sorted(s["labels"].items())): s["values"] for s in live_out
+    }
+    # byte_tx sums for key 10: window T0 = 100, window T0+1 = 7
+    by_key = {s["labels"]["key"]: s for s in live_out
+              if s["labels"]["window"] == str(T0)}
+    assert by_key[f"{10:08x}{11:08x}"]["values"][0][1] == 100.0
+
+    # close everything; flushed rows land in the store via the SAME row
+    # builder the live source used
+    flushed += wm.flush_all()
+    sink([f for f in flushed if f.count])
+    closed_out = query_range(
+        store, LIVE_METRIC_FLOW_BYTES, T0, T0 + 1, 1,
+        db=DEEPFLOW_SYSTEM_DB, table=DEEPFLOW_SYSTEM_TABLE,
+        live=reg, cache=False, lookback_s=1,
+    )
+    closed_vals = {
+        tuple(sorted(s["labels"].items())): s["values"] for s in closed_out
+    }
+    assert not any(s.get("partial") for s in closed_out)
+    assert closed_vals == live_vals  # bit-exact across the close
+
+
+def test_promql_flushed_supersedes_partial_on_growth():
+    """Rows arriving AFTER the snapshot are invisible to the partial
+    but present post-flush — the flushed sample must supersede the
+    stale partial at the same (series, time)."""
+    store = ColumnarStore()
+    ensure_system_table(store)
+    reg = LiveRegistry()
+    wm = WindowManager(WindowConfig(capacity=1 << 10, min_snapshot_interval=0.0))
+    src = PipelineLiveSource(wm)
+    reg.register(DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE, src)
+
+    _doc_ingest(wm, T0, [10], 100.0)
+    wm.snapshot_open(force=True)
+    out1 = query_instant(
+        store, LIVE_METRIC_FLOW_BYTES, T0 + 1,
+        db=DEEPFLOW_SYSTEM_DB, table=DEEPFLOW_SYSTEM_TABLE, live=reg,
+        lookback_s=2,
+    )
+    assert out1[0]["value"] == 100.0 and out1[0].get("partial")
+    flushed = _doc_ingest(wm, T0, [10], 50.0)  # same window, more bytes
+    flushed += wm.flush_all()
+    flow_window_sink(store)([f for f in flushed if f.count])
+    out2 = query_instant(
+        store, LIVE_METRIC_FLOW_BYTES, T0 + 1,
+        db=DEEPFLOW_SYSTEM_DB, table=DEEPFLOW_SYSTEM_TABLE, live=reg,
+        lookback_s=2,
+    )
+    # flushed value (150) wins over any stale partial (100)
+    assert out2[0]["value"] == 150.0
+    assert not out2[0].get("partial")
+
+
+def test_live_system_source_sub_tick_counters():
+    """Dogfood: CURRENT Countable values answer a PromQL query without
+    waiting for a collector tick or writing the store."""
+    from deepflow_tpu.utils.stats import StatsCollector
+
+    store = ColumnarStore()
+    ensure_system_table(store)
+    reg = LiveRegistry()
+    col = StatsCollector(interval_s=999)
+    state = {"pumps": 3}
+    col.register("tpu_feeder", lambda: dict(state), name="live")
+    _, handle = live_system_source(col, registry=reg)
+
+    out = query_instant(
+        store, 'tpu_feeder_pumps{name="live"}', T0,
+        db=DEEPFLOW_SYSTEM_DB, table=DEEPFLOW_SYSTEM_TABLE, live=reg,
+    )
+    assert len(out) == 1 and out[0]["value"] == 3.0 and out[0]["partial"]
+    state["pumps"] = 9  # counters moved — the next pull sees it NOW
+    out = query_instant(
+        store, 'tpu_feeder_pumps{name="live"}', T0,
+        db=DEEPFLOW_SYSTEM_DB, table=DEEPFLOW_SYSTEM_TABLE, live=reg,
+    )
+    assert out[0]["value"] == 9.0
+    assert store.row_count(DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE) == 0
+
+
+# ---------------------------------------------------------------------------
+# (4): result cache
+
+
+def _samples_store():
+    from deepflow_tpu.storage.store import ColumnSpec, TableSchema
+
+    store = ColumnarStore()
+    store.create_table(
+        "prometheus",
+        TableSchema("samples", (
+            ColumnSpec("time", "u4"), ColumnSpec("metric", "O"),
+            ColumnSpec("labels", "O"), ColumnSpec("value", "f8"),
+        )),
+    )
+    return store
+
+
+def _insert_samples(store, t, metric, value):
+    store.insert("prometheus", "samples", {
+        "time": np.asarray([t], np.uint32),
+        "metric": np.asarray([metric], object),
+        "labels": np.asarray(["job=api"], object),
+        "value": np.asarray([value], np.float64),
+    })
+
+
+def test_result_cache_hit_miss_invalidate_evict():
+    store = _samples_store()
+    _insert_samples(store, T0, "m", 1.0)
+    cache = QueryResultCache(max_entries=2)
+    reg = LiveRegistry()
+
+    kw = dict(db="prometheus", table="samples", live=reg, cache=cache)
+    r1 = query_range(store, "m", T0, T0 + 2, 1, **kw)
+    assert cache.get_counters()["misses"] == 1
+    r2 = query_range(store, "m", T0, T0 + 2, 1, **kw)
+    assert r2 == r1
+    assert cache.get_counters()["hits"] == 1
+
+    # window close = insert = store epoch moves = stale entry dropped
+    _insert_samples(store, T0 + 1, "m", 5.0)
+    r3 = query_range(store, "m", T0, T0 + 2, 1, **kw)
+    c = cache.get_counters()
+    assert c["invalidations"] == 1 and c["misses"] == 2
+    assert r3 != r1  # recomputed over the new rows
+    assert query_range(store, "m", T0, T0 + 2, 1, **kw) == r3
+    assert cache.get_counters()["hits"] == 2
+
+    # LRU bound: a dashboard storm of distinct queries cannot grow memory
+    for q in range(5):
+        query_range(store, "m", T0, T0 + 2 + q, 1, **kw)
+    c = cache.get_counters()
+    assert c["entries"] <= 2 and c["evictions"] >= 3
+
+    # live epoch moves also invalidate: register a provider whose epoch
+    # ticks per pull (counter-style source)
+    class Src:
+        n = 0
+
+        def __call__(self, lo, hi):
+            return None
+
+        def epoch(self):
+            Src.n += 1
+            return Src.n
+
+    hits_before = cache.get_counters()["hits"]
+    reg.register("prometheus", "samples", Src())
+    query_range(store, "m", T0, T0 + 2, 1, **kw)
+    query_range(store, "m", T0, T0 + 2, 1, **kw)
+    # every pull is a new live generation → the token moves per query
+    # and cached entries over moving live counters never serve stale
+    assert cache.get_counters()["hits"] == hits_before
+
+
+def test_result_cache_counters_dogfood_roundtrip():
+    """Satellite pin: the cache registers as a Countable — its
+    hit/miss/invalidation counters are queryable via SQL AND PromQL."""
+    from deepflow_tpu.integration.dfstats import system_sink
+    from deepflow_tpu.querier.engine import QueryEngine
+    from deepflow_tpu.utils.stats import StatsCollector
+
+    cache = QueryResultCache(max_entries=8)
+    cache.lookup(("q", "x", "db", "t"), token=0)   # one miss
+    cache.store(("q", "x", "db", "t"), 0, [1])
+    assert cache.lookup(("q", "x", "db", "t"), 0) == [1]  # one hit
+
+    store = ColumnarStore()
+    col = StatsCollector(interval_s=999)
+    col.register("tpu_query_cache", cache)
+    col.add_sink(system_sink(store))
+    col.tick(now=float(T0))
+
+    eng = QueryEngine(store, cache=False)
+    for field, want in (("hits", 1.0), ("misses", 1.0), ("entries", 1.0)):
+        res = eng.execute(
+            "SELECT value FROM deepflow_system.deepflow_system "
+            f"WHERE metric = 'tpu_query_cache_{field}'"
+        )
+        assert res.rows == 1 and float(res.values["value"][0]) == want, field
+    out = query_instant(
+        store, "tpu_query_cache_hits", T0 + 1,
+        db=DEEPFLOW_SYSTEM_DB, table=DEEPFLOW_SYSTEM_TABLE,
+    )
+    assert len(out) == 1 and out[0]["value"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# SQL engine overlay + live-aware tier selection
+
+
+def test_sql_engine_overlay_marks_partial_and_settles():
+    from deepflow_tpu.querier.engine import QueryEngine
+
+    store = ColumnarStore()
+    ensure_system_table(store)
+    reg = LiveRegistry()
+    wm = WindowManager(WindowConfig(capacity=1 << 10, min_snapshot_interval=0.0))
+    reg.register(DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE, PipelineLiveSource(wm))
+    eng = QueryEngine(store, live=reg, cache=False)
+
+    flushed = _doc_ingest(wm, T0, [10, 20], 100.0)
+    sql = (
+        "SELECT Sum(value) AS total FROM deepflow_system.deepflow_system "
+        f"WHERE metric = '{LIVE_METRIC_FLOW_BYTES}'"
+    )
+    res = eng.execute(sql)
+    assert res.partial is True
+    assert float(res.values["total"][0]) == 200.0
+
+    flushed += wm.flush_all()
+    flow_window_sink(store)([f for f in flushed if f.count])
+    res2 = eng.execute(sql)
+    assert res2.partial is False  # snapshot now serves an empty span
+    assert float(res2.values["total"][0]) == 200.0  # same answer, settled
+
+
+def test_sql_overlay_no_double_count_from_stale_cached_snapshot():
+    """Review regression (ISSUE 10): with a LARGE min_snapshot_interval
+    the cached snapshot outlives a window close. The SQL engine has no
+    per-series last-sample-wins dedup, so serving the stale partial
+    alongside the window's flushed rows would DOUBLE-COUNT every
+    aggregate. The provider must drop windows below the manager's
+    CURRENT open span (a host int — no device read) at pull time."""
+    from deepflow_tpu.querier.engine import QueryEngine
+
+    store = ColumnarStore()
+    ensure_system_table(store)
+    reg = LiveRegistry()
+    wm = WindowManager(
+        WindowConfig(capacity=1 << 10, min_snapshot_interval=3600.0)
+    )
+    src = PipelineLiveSource(wm)
+    reg.register(DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE, src)
+    eng = QueryEngine(store, live=reg, cache=False)
+    sql = (
+        "SELECT Sum(value) AS total FROM deepflow_system.deepflow_system "
+        f"WHERE metric = '{LIVE_METRIC_FLOW_BYTES}'"
+    )
+
+    _doc_ingest(wm, T0, [10, 20], 100.0)
+    res = eng.execute(sql)
+    assert res.partial and float(res.values["total"][0]) == 200.0
+    # window T0 closes (advance) while the hour-long snapshot rate
+    # limit keeps the pre-close snapshot cached; flushed rows land
+    flushed = _doc_ingest(wm, T0 + 50, [99], 1.0)
+    flow_window_sink(store)([f for f in flushed if f.count])
+    res2 = eng.execute(sql)
+    # 200 flushed + nothing from the stale partial (NOT 400); the new
+    # open window at T0+50 is invisible until the next snapshot — a
+    # freshness gap bounded by min_snapshot_interval, never a double
+    assert float(res2.values["total"][0]) == 200.0
+    assert not res2.partial
+    # a fresh snapshot picks the new open window up again
+    wm.snapshot_open(force=True)
+    res3 = eng.execute(sql)
+    assert res3.partial and float(res3.values["total"][0]) == 201.0
+
+
+def test_tier_selection_prefers_live_covered_finest():
+    from deepflow_tpu.querier.engine import QueryEngine
+    from deepflow_tpu.querier.translation import select_datasource_tier
+    from deepflow_tpu.storage.store import ColumnSpec, TableSchema
+
+    avail = {"network_1s": 1, "network_1m": 60}
+    assert select_datasource_tier(avail, 60) == "network_1m"
+    assert (
+        select_datasource_tier(avail, 60, live_tables={"network_1s"})
+        == "network_1s"
+    )
+    # a live tier that does NOT satisfy the step never wins
+    assert (
+        select_datasource_tier({"network_1m": 60}, 30, live_tables={"network_1m"})
+        is None
+    )
+
+    store = ColumnarStore()
+    for t in ("network_1s", "network_1m"):
+        store.create_table("flow", TableSchema(t, (
+            ColumnSpec("time", "u4"), ColumnSpec("byte_tx", "f8"),
+        )))
+    reg = LiveRegistry()
+    eng = QueryEngine(store, live=reg, cache=False)
+    # no live source: bare-name routing reads the coarsest fitting tier
+    assert eng._resolve_table("network", step=60) == ("flow", "network_1m")
+
+    class Src:
+        def __call__(self, lo, hi):
+            return None
+
+        def open_from(self):
+            return T0
+
+    reg.register("flow", "network_1s", Src())
+    # range touches the open span → the live-covered finest tier wins
+    assert eng._resolve_table("network", step=60, trange=None) == (
+        "flow", "network_1s"
+    )
+    assert eng._resolve_table("network", step=60, trange=(0, T0 + 10)) == (
+        "flow", "network_1s"
+    )
+    # a bounded range entirely in the flushed past keeps the tier route
+    assert eng._resolve_table("network", step=60, trange=(0, T0 - 100)) == (
+        "flow", "network_1m"
+    )
+
+
+# ---------------------------------------------------------------------------
+# feeder scheduling
+
+
+def test_feeder_snapshot_scheduling_between_pumps():
+    from deepflow_tpu.feeder import (
+        FeederConfig,
+        FeederRuntime,
+        PipelineFeedSink,
+        encode_flowbatch_frames,
+    )
+    from deepflow_tpu.ingest.queues import PyOverwriteQueue
+
+    pipe = L4Pipeline(PipelineConfig(
+        window=WindowConfig(capacity=1 << 12, min_snapshot_interval=0.0),
+        batch_size=256, bucket_sizes=(64, 128),
+    ))
+    q = PyOverwriteQueue(1 << 10)
+    feeder = FeederRuntime(
+        [q], PipelineFeedSink(pipe),
+        FeederConfig(frames_per_queue=8, snapshot_interval_pumps=2),
+    )
+    gen = SyntheticFlowGen(num_tuples=100, seed=5)
+    for i in range(4):
+        for fr in encode_flowbatch_frames(
+            gen.flow_batch(64, T0 + i), max_rows_per_frame=64
+        ):
+            q.put(fr)
+        feeder.pump()
+    c = feeder.get_counters()
+    assert c["snapshots_taken"] == 2  # pumps 2 and 4
+    assert c["snapshot_errors"] == 0
+    assert feeder.last_snapshot is not None
+    assert feeder.last_snapshot.windows  # open windows visible
+    assert pipe.get_counters()["snapshot_reads"] >= 1

@@ -71,6 +71,36 @@ def test_counter_block_versioned_and_coherent():
     assert live["drop_overflow"] == c["stash_evictions"]
 
 
+def test_snapshot_lanes_ride_the_counter_block():
+    """ISSUE 10 (CB v6): snapshot_reads/snapshot_bytes ride the
+    EXISTING per-batch fetch — after a snapshot, the next dispatched
+    batch's counter block mirrors the host accounting exactly, and the
+    snapshot itself shows up in the transfer accounting (2 fetches)."""
+    pipe = _ingest_some(
+        L4Pipeline(PipelineConfig(
+            window=WindowConfig(capacity=1 << 12, min_snapshot_interval=0.0),
+            batch_size=256,
+        )),
+        n_batches=2,
+    )
+    c0 = pipe.get_counters()
+    assert c0["snapshot_reads"] == 0 and c0["device_snapshot_reads"] == 0
+    f0 = c0["host_fetches"]
+    snap = pipe.snapshot_open()
+    assert snap.windows and all(w.partial for w in snap.windows)
+    c1 = pipe.get_counters()
+    assert c1["snapshot_reads"] == 1 and c1["snapshot_bytes"] > 0
+    assert c1["host_fetches"] - f0 <= 2  # the 2-fetch pull-path read
+    # device plane still carries the pre-snapshot lanes until the next
+    # dispatch ships the rebuilt [reads, bytes] vector
+    assert c1["device_snapshot_reads"] == 0
+    gen = SyntheticFlowGen(num_tuples=200, seed=3)
+    pipe.ingest(FlowBatch.from_records(gen.records(64, T0 + 10)))
+    c2 = pipe.get_counters()
+    assert c2["device_snapshot_reads"] == c2["snapshot_reads"] == 1
+    assert c2["device_snapshot_bytes"] == c2["snapshot_bytes"] > 0
+
+
 def test_counter_block_rejects_version_drift():
     import jax.numpy as jnp
 
@@ -93,15 +123,19 @@ def test_counter_block_layout_constants():
         CB_FOLD_ROWS,
         CB_SKETCH_ROWS,
         CB_SKETCH_SHED,
+        CB_SNAPSHOT_BYTES,
+        CB_SNAPSHOT_READS,
     )
 
     # layout drift between the device builder and the host parser must
     # fail here, not silently mis-slice (v2 appended the feeder_shed
     # lane, ISSUE 4; v3 appended fold_rows, ISSUE 5; v4 appended the
     # sketch_rows/sketch_shed plane lanes, ISSUE 8; v5 appended the
-    # rollup cascade's cascade_rows/cascade_shed lanes, ISSUE 9)
-    assert CB_VERSION == 0 and CB_LEN == 16
-    assert COUNTER_BLOCK_VERSION == 5
+    # rollup cascade's cascade_rows/cascade_shed lanes, ISSUE 9; v6
+    # appended the live read plane's snapshot_reads/snapshot_bytes
+    # lanes, ISSUE 10)
+    assert CB_VERSION == 0 and CB_LEN == 18
+    assert COUNTER_BLOCK_VERSION == 6
     assert CB_STASH_OCCUPANCY == 7
     assert CB_FEEDER_SHED == 10
     assert CB_FOLD_ROWS == 11
@@ -109,6 +143,8 @@ def test_counter_block_layout_constants():
     assert CB_SKETCH_SHED == 13
     assert CB_CASCADE_ROWS == 14
     assert CB_CASCADE_SHED == 15
+    assert CB_SNAPSHOT_READS == 16
+    assert CB_SNAPSHOT_BYTES == 17
     # the documented field-name table mirrors the index constants
     assert len(CB_FIELDS) == CB_LEN
     assert CB_FIELDS[CB_VERSION] == "version"
@@ -120,6 +156,8 @@ def test_counter_block_layout_constants():
     assert CB_FIELDS[CB_SKETCH_SHED] == "sketch_shed"
     assert CB_FIELDS[CB_CASCADE_ROWS] == "cascade_rows"
     assert CB_FIELDS[CB_CASCADE_SHED] == "cascade_shed"
+    assert CB_FIELDS[CB_SNAPSHOT_READS] == "snapshot_reads"
+    assert CB_FIELDS[CB_SNAPSHOT_BYTES] == "snapshot_bytes"
 
 
 # ---------------------------------------------------------------------------
@@ -128,12 +166,19 @@ def test_counter_block_layout_constants():
 
 def test_spans_cover_pipeline_stages_and_checkpoint(tmp_path):
     from deepflow_tpu.aggregator.checkpoint import save_window_state
+    from deepflow_tpu.querier.live import QueryResultCache
 
     pipe = _ingest_some(
         L4Pipeline(PipelineConfig(window=WindowConfig(capacity=1 << 12),
                                   batch_size=256))
     )
     save_window_state(pipe.wm, tmp_path / "ckpt.npz")
+    # the live read plane's stages (ISSUE 10): snapshot_open emits
+    # query.snapshot on the pipeline tracer; a result-cache lookup
+    # emits query.cache on whatever tracer the cache carries
+    pipe.snapshot_open()
+    cache = QueryResultCache(max_entries=4, tracer=pipe.tracer)
+    assert cache.lookup(("q", "db", "t"), token=1) is None
     summary = pipe.tracer.summary()
     for name in PIPELINE_SPAN_NAMES:
         assert name in summary, f"missing span {name}: {sorted(summary)}"
@@ -210,7 +255,8 @@ def test_pipeline_counters_roundtrip_sql_and_promql():
     # -- SQL engine over deepflow_system.deepflow_system ---------------
     eng = QueryEngine(store)
     for field in ("doc_in", "flushed_doc", "drop_before_window",
-                  "stash_occupancy", "host_fetches", "bytes_fetched"):
+                  "stash_occupancy", "host_fetches", "bytes_fetched",
+                  "snapshot_reads", "snapshot_bytes"):
         metric = system_metric_name("tpu_pipeline", field)
         res = eng.execute(
             "SELECT value FROM deepflow_system.deepflow_system "
