@@ -541,13 +541,47 @@ def config11(quick: bool):
          snap_every=rec["snap_every"], iters=rec["iters"])
 
 
+def config12(quick: bool):
+    """Push query plane (ISSUE 11): dashboard-storm fan-out
+    amplification + flush→watcher invalidation latency via
+    bench/pushbench.py (protocol: PERF.md §20, committed numbers:
+    PUSHBENCH_r01.json). The vs line is the amplification at the
+    largest watcher count (acceptance ≥100× from ONE evaluation per
+    event, results pinned bit-exact vs a fresh pull); evals/sec and
+    the publish→delivery latency ride the detail rows."""
+    import os
+    import subprocess
+
+    env = {**os.environ}
+    if quick:
+        env.update(PUSHBENCH_WATCHERS="1,100", PUSHBENCH_EVENTS="8",
+                   PUSHBENCH_FLOWS="128")
+    out = subprocess.run(
+        [sys.executable, "bench/pushbench.py"],
+        capture_output=True, text=True, timeout=1800, env=env,
+    )
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    if rec.get("partial"):
+        emit("c12_push_plane", 0, "error", 0, error=rec.get("error"))
+        return
+    rows = rec["rows"]
+    last = rows[-1]
+    assert last["pinned_bit_exact"], "push-delivered result diverged from pull"
+    emit("c12_push_plane", last["deliveries_per_s"], "deliveries/s",
+         last["amplification"],
+         evals_per_s=last["evals_per_s"],
+         publish_to_last_watcher_ms=last["publish_to_last_watcher_ms"],
+         watchers=last["watchers"], rows=rows, events=rec["events"],
+         flows=rec["flows"])
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--cpu", action="store_true")
     p.add_argument("--quick", action="store_true")
     args = p.parse_args()
     for fn in (config1, config2, config3, config4, config5, config6, config7,
-               config8, config9, config10, config11):
+               config8, config9, config10, config11, config12):
         try:
             fn(args.quick)
         except Exception as e:  # one config must not kill the others

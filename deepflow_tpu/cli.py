@@ -11,6 +11,10 @@ Commands mirror the reference CLI surface that applies to this build:
   dfctl counters --port P [--module M]   live counter dump (debug UDP)
   dfctl agents --port P                  agent liveness (debug UDP)
   dfctl datasource ... (list/add)        downsampler management
+  dfctl subscriptions --port P           push-plane standing queries:
+                                         watcher counts + eval latency
+  dfctl alerts --port P                  alert rules: state, value,
+                                         last transition
   dfctl rest --port P METHOD PATH [JSON] controller REST (agent-group /
                                          domain / resource mgmt seats:
                                          resources, datasources, traces,
@@ -177,7 +181,8 @@ def main(argv=None):
             sp.add_argument("--service", required=True)
         sp.set_defaults(fn=fn)
 
-    for name in ("counters", "agents", "datasources", "ping"):
+    for name in ("counters", "agents", "datasources", "subscriptions",
+                 "alerts", "ping"):
         sp = sub.add_parser(name)
         sp.add_argument("--host", default="127.0.0.1")
         sp.add_argument("--port", type=int, required=True)

@@ -416,6 +416,13 @@ class FeederConfig:
     # the refresh keeps the rate-limited snapshot warm so dashboard
     # pulls between pumps return the cached read. 0 = off (pull-only).
     snapshot_interval_pumps: int = 0
+    # push query plane (ISSUE 11): the (db, table) the feeder's flushed
+    # outputs are attributed to when an event_bus is attached — the
+    # WindowClosed/TierClosed events the pump publishes after its last
+    # emit carry these, so standing queries over the dogfood table
+    # re-evaluate exactly when their data moved
+    event_db: str = "deepflow_system"
+    event_table: str = "deepflow_system"
 
 
 class FeederRuntime:
@@ -432,6 +439,7 @@ class FeederRuntime:
         name: str = "feeder",
         tracer: SpanTracer | None = None,
         journal=None,
+        event_bus=None,
     ):
         if not queues:
             raise ValueError("need at least one queue")
@@ -450,6 +458,11 @@ class FeederRuntime:
             service="deepflow_tpu.feeder"
         )
         self._journal = journal
+        # push query plane (ISSUE 11): flushed outputs become
+        # WindowClosed/TierClosed events AFTER the pump's last emit —
+        # the drain-side hook that turns a window close into an eager
+        # cache invalidation + one shared subscription evaluation
+        self._event_bus = event_bus
         self._weights = config.weights or (1,) * len(queues)
         self._pressure = [False] * len(queues)
         self._chunks: deque = deque()
@@ -497,6 +510,8 @@ class FeederRuntime:
             # live read plane (ISSUE 10)
             "snapshots_taken": 0,
             "snapshot_errors": 0,
+            # push query plane (ISSUE 11)
+            "events_published": 0,
         }
         self._pump_count = 0
         self.last_snapshot = None  # most recent scheduled OpenSnapshot
@@ -802,7 +817,47 @@ class FeederRuntime:
                             "feeder %s: open-window snapshot failed — live "
                             "reads degrade to flushed-only", self.name,
                         )
+                else:
+                    self._publish_snapshot_event()
+        self._publish_events(out)
         return out
+
+    # -- push events (ISSUE 11) ------------------------------------------
+    def _publish_events(self, out: list) -> None:
+        """Flushed outputs → one WindowClosed/TierClosed batch on the
+        attached bus. One publish per pump, so K windows closed by one
+        drain reach every standing query as ONE delivery (the
+        coalescing contract subscriptions/alerts pin). Guarded: the
+        event plane must never stall or fail the drain."""
+        if self._event_bus is None or not out:
+            return
+        try:
+            from ..querier.events import docbatch_events
+
+            events = docbatch_events(
+                out, db=self.config.event_db, table=self.config.event_table
+            )
+            if events:
+                n = self._event_bus.publish(events)
+                self._count("events_published", n)
+        except Exception:
+            _log.debug("feeder %s: event publish failed (contained)",
+                       self.name, exc_info=True)
+
+    def _publish_snapshot_event(self) -> None:
+        if self._event_bus is None or self.last_snapshot is None:
+            return
+        try:
+            from ..querier.events import SnapshotAdvanced
+
+            n = self._event_bus.publish(SnapshotAdvanced(
+                self.config.event_db, self.config.event_table,
+                int(getattr(self.last_snapshot, "seq", 0)),
+            ))
+            self._count("events_published", n)
+        except Exception:
+            _log.debug("feeder %s: snapshot event publish failed (contained)",
+                       self.name, exc_info=True)
 
     def flush(self) -> list:
         """Emit every pending record (tail bucket) and push anything the
@@ -823,6 +878,7 @@ class FeederRuntime:
                 with self._lock:
                     self._shed_pending += lost
                 self._enter_degraded()
+            self._publish_events(out)
             return out
 
     # -- journal recovery ------------------------------------------------
@@ -891,6 +947,7 @@ class FeederRuntime:
                 return out
             if res:
                 out.extend(res)
+                self._publish_events(res)  # barrier-flushed windows push too
             if self._journal is not None:
                 self._journal.rotate()
             self.last_checkpoint_ok = True

@@ -250,21 +250,42 @@ def sketch_rows_to_columns(rows) -> dict[str, np.ndarray]:
     }
 
 
-def sketch_system_sink(store, interval: int = 1, **row_kw):
+def sketch_system_sink(store, interval: int = 1, *, bus=None, **row_kw):
     """→ a callable(blocks) writing closed-window sketch answers into
     deepflow_system — wire a pipeline's `pop_closed_sketches()` (or a
-    ShardedWindowManager's) into it after every ingest/drain."""
+    ShardedWindowManager's) into it after every ingest/drain. With
+    `bus` set (ISSUE 11), one WindowClosed/TierClosed batch publishes
+    AFTER the insert, so heavy-hitter alert rules over the sketch
+    plane's `topk()` lane re-evaluate the moment a window's sketch
+    answers land."""
     ensure_system_table(store)
 
     def sink(blocks) -> None:
+        import contextlib
+
         rows = []
+        events = []
         for b in blocks:
             rows.extend(sketch_block_rows(b, interval, **row_kw))
-        if rows:
-            store.insert(
-                DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE,
-                sketch_rows_to_columns(rows),
-            )
+            if bus is not None:
+                from ..querier.events import TierClosed, WindowClosed
+
+                t, i = b.window * interval, int(interval)
+                events.append(
+                    WindowClosed(DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE, t, i)
+                    if i <= 1 else
+                    TierClosed(DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE, t, i)
+                )
+        # one dispatch per sink call: the insert's StoreMutation joins
+        # the data-timed close events in a single batch (bus.batch)
+        with (bus.batch() if bus is not None else contextlib.nullcontext()):
+            if rows:
+                store.insert(
+                    DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE,
+                    sketch_rows_to_columns(rows),
+                )
+            if events and bus is not None:
+                bus.publish(events)
 
     return sink
 
@@ -407,21 +428,39 @@ def live_flow_source(
     return provider, reg.register(db, table, provider)
 
 
-def flow_window_sink(store, **row_kw):
+def flow_window_sink(store, *, bus=None, **row_kw):
     """→ callable(windows) writing CLOSED windows' rows through the
     same `flow_window_rows` builder the live source uses — window
-    close = insert = store epoch bump = result-cache invalidation."""
+    close = insert = store epoch bump = result-cache invalidation.
+    With `bus` set (ISSUE 11), one WindowClosed batch publishes AFTER
+    the insert (on top of the store's own StoreMutation hook, if
+    connected): standing queries re-evaluate once per sink call with
+    the closed windows' times as the event clock."""
     ensure_system_table(store)
 
     def sink(windows) -> None:
+        import contextlib
+
         rows = []
         for f in windows:
             rows.extend(flow_window_rows(f, **row_kw))
-        if rows:
-            store.insert(
-                DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE,
-                sketch_rows_to_columns(rows),
-            )
+        # bus.batch(): the insert's StoreMutation (mutation hook) and
+        # the data-timed WindowClosed events below coalesce into ONE
+        # dispatch — one evaluation per sink call, at the data time
+        with (bus.batch() if bus is not None else contextlib.nullcontext()):
+            if rows:
+                store.insert(
+                    DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE,
+                    sketch_rows_to_columns(rows),
+                )
+            if bus is not None and windows:
+                from ..querier.events import docbatch_events
+
+                evs = docbatch_events(
+                    windows, db=DEEPFLOW_SYSTEM_DB, table=DEEPFLOW_SYSTEM_TABLE
+                )
+                if evs:
+                    bus.publish(evs)
 
     return sink
 

@@ -1,7 +1,31 @@
 """Query plane: DeepFlow-SQL subset engine over the columnar store —
-the server/querier seat (engine/clickhouse/clickhouse.go:117).
+the server/querier seat (engine/clickhouse/clickhouse.go:117) — plus
+the push-mode layers (ISSUE 11): QueryEventBus (events.py), query
+subscriptions (subscribe.py), and the alerting rule engine (alerts.py).
 """
 
 from .engine import QueryEngine
 
-__all__ = ["QueryEngine"]
+__all__ = [
+    "QueryEngine",
+    "QueryEventBus",
+    "SubscriptionManager",
+    "AlertEngine",
+    "AlertRule",
+]
+
+
+def __getattr__(name):  # lazy: keep bare-engine imports light
+    if name == "QueryEventBus":
+        from .events import QueryEventBus
+
+        return QueryEventBus
+    if name == "SubscriptionManager":
+        from .subscribe import SubscriptionManager
+
+        return SubscriptionManager
+    if name in ("AlertEngine", "AlertRule"):
+        from . import alerts
+
+        return getattr(alerts, name)
+    raise AttributeError(name)

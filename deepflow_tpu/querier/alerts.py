@@ -1,0 +1,445 @@
+"""Push-mode query plane, layer 3 (ISSUE 11): the alerting rule engine.
+
+The reference server's querier serves exactly two consumers — Grafana
+dashboards and alert rules. Subscriptions (subscribe.py) are the
+dashboard half; an alert rule is the same machinery with a comparator
+and a threshold bolted on: a standing query re-evaluated on push
+events, whose RESULT feeds a small per-rule state machine instead of a
+websocket.
+
+Rule = query (PromQL instant — including `topk()` / distinct /
+quantile queries the sketch plane answers — or SQL) + comparator +
+threshold + `for`-duration. States:
+
+    inactive ──breach──▶ pending ──held for ≥ for_s──▶ firing
+       ▲                    │                            │
+       └────no breach───────┘                       no breach
+                                                         ▼
+    resolved ◀───────────────────────────────────────────┘
+       └──breach──▶ pending  (flap suppression: a re-fire after a
+                              resolve walks the FULL pending ladder
+                              again — a flapping series cannot ring
+                              the pager at event rate)
+
+Time is the event plane's DATA time (`events.event_time` batch max),
+so `for`-durations advance deterministically under replay and tests;
+`tick(now)` drives the same evaluation from a wall clock for processes
+whose tables go quiet (a pending rule must still mature to firing when
+traffic stops precisely because it stopped).
+
+Transitions notify pluggable sinks: `log_notification_sink` (always
+available), arbitrary callbacks, and `otlp_notification_sink(exporter)`
+— alert events ride the same exporter traces lane the span tracer uses,
+so a firing rule shows up in the trace backend next to the pipeline
+stages that produced it. A raising sink is counted and DETACHED after
+`MAX_SINK_FAILURES` consecutive failures; it never stalls the drain.
+
+Dogfood: the engine registers as a Countable (`tpu_alert_rules`), with
+per-rule state codes and transition counts as flat lanes — rule states
+are queryable via SQL and PromQL
+(`tpu_alert_rules_rule_<name>_state_code`) like every other component.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import re
+import threading
+import time
+
+from ..utils.spans import SPAN_ALERT_EVAL, SpanTracer
+from ..utils.stats import register_countable
+from .events import QueryEventBus, event_time
+
+_log = logging.getLogger(__name__)
+
+STATE_INACTIVE = "inactive"
+STATE_PENDING = "pending"
+STATE_FIRING = "firing"
+STATE_RESOLVED = "resolved"
+
+#: stable numeric codes for the dogfood lanes (SQL/PromQL-queryable)
+STATE_CODES = {
+    STATE_INACTIVE: 0,
+    STATE_PENDING: 1,
+    STATE_FIRING: 2,
+    STATE_RESOLVED: 3,
+}
+
+_COMPARATORS = {
+    ">": lambda v, t: v > t,
+    ">=": lambda v, t: v >= t,
+    "<": lambda v, t: v < t,
+    "<=": lambda v, t: v <= t,
+    "==": lambda v, t: v == t,
+    "!=": lambda v, t: v != t,
+}
+
+_NAME_SAN_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+@dataclasses.dataclass(frozen=True)
+class AlertRule:
+    """One rule spec. `engine` picks evaluation: "promql" runs
+    `query_instant` at the event time over (db, table) and compares the
+    MAX series value (so `topk(k, m)`-shaped heavy-hitter rules compare
+    the biggest recovered flow); "sql" executes the statement and
+    compares the first numeric cell of the first row. No data → no
+    breach (a silent series resolves rather than pages)."""
+
+    name: str
+    query: str
+    comparator: str  # one of > >= < <= == !=
+    threshold: float
+    for_s: int = 0
+    engine: str = "promql"  # "promql" | "sql"
+    db: str = "deepflow_system"
+    table: str = "deepflow_system"
+    lookback_s: int = 300
+    labels: tuple[tuple[str, str], ...] = ()
+
+    def __post_init__(self):
+        if self.comparator not in _COMPARATORS:
+            raise ValueError(f"unknown comparator {self.comparator!r}")
+        if self.engine not in ("promql", "sql"):
+            raise ValueError(f"unknown engine {self.engine!r}")
+        if self.for_s < 0:
+            raise ValueError("for_s must be >= 0")
+
+
+class _RuleState:
+    __slots__ = ("state", "pending_since", "fired_before", "last_value",
+                 "last_eval", "last_transition", "transitions", "evals",
+                 "eval_errors", "last_partial")
+
+    def __init__(self):
+        self.state = STATE_INACTIVE
+        self.pending_since: int | None = None
+        self.fired_before = False
+        self.last_value: float | None = None
+        self.last_eval = 0
+        self.last_transition = 0
+        self.transitions = 0
+        self.evals = 0
+        self.eval_errors = 0
+        self.last_partial = False
+
+
+def log_notification_sink(event: dict) -> None:
+    """The always-on default notification lane."""
+    _log.warning(
+        "ALERT %s: rule %r value=%s threshold %s %s (t=%s)",
+        event["state"], event["rule"], event["value"], event["comparator"],
+        event["threshold"], event["time"],
+    )
+
+
+def otlp_notification_sink(exporter, *, table: str = "l7_flow_log"):
+    """→ a sink shipping alert transitions through an exporter's traces
+    lane (the same path utils/spans.export_otlp uses), one span per
+    transition: app_service = deepflow_tpu.alerts, endpoint = rule
+    name, response_duration = the for-duration the rule held."""
+    import numpy as np
+
+    seq = {"n": 0}
+
+    def sink(event: dict) -> None:
+        seq["n"] += 1
+        i = seq["n"]
+        cols = {
+            "time": np.asarray([int(event["time"])], np.uint32),
+            "start_time": np.asarray([int(event["time"])], np.uint32),
+            "response_duration": np.asarray(
+                [int(event.get("held_s", 0)) * 1_000_000], np.uint32
+            ),
+            "app_service": np.asarray(["deepflow_tpu.alerts"]),
+            "endpoint": np.asarray([f"{event['rule']}:{event['state']}"]),
+            "trace_id": np.asarray([f"{i:032x}"]),
+            "span_id": np.asarray([f"{i:016x}"]),
+            "parent_span_id": np.asarray([""]),
+        }
+        exporter.export(table, cols)
+
+    return sink
+
+
+class _Sink:
+    __slots__ = ("fn", "name", "failures", "detached")
+
+    def __init__(self, fn, name):
+        self.fn = fn
+        self.name = name
+        self.failures = 0
+        self.detached = False
+
+
+class AlertEngine:
+    """Rules over one store, evaluated on push events (and `tick`)."""
+
+    MAX_SINK_FAILURES = 4
+
+    def __init__(self, store, *, live=None, cache=None,
+                 bus: QueryEventBus | None = None,
+                 tracer: SpanTracer | None = None, name: str = "alerts",
+                 log_sink: bool = True):
+        from .live import default_live_registry
+
+        self.store = store
+        self.live = default_live_registry if live is None else live
+        self.cache = cache
+        self.tracer = tracer if tracer is not None else SpanTracer(
+            service="deepflow_tpu.alerts"
+        )
+        self.name = name
+        self._rules: dict[str, tuple[AlertRule, _RuleState]] = {}
+        self._sinks: list[_Sink] = []
+        self._lock = threading.Lock()
+        # serializes rule evaluation + state transitions: bus dispatch
+        # (a writer-flusher or feeder thread) and Server.tick run
+        # concurrently, and an unguarded pending_since read racing a
+        # transition's None-out would crash (int - None) or double-fire.
+        # RLock, separate from _lock: _notify takes _lock inside.
+        self._eval_lock = threading.RLock()
+        self.counters = {
+            "evals": 0,
+            "eval_errors": 0,
+            "notifications": 0,
+            "sink_errors": 0,
+            "sinks_detached": 0,
+            "transitions": 0,
+        }
+        if log_sink:
+            self.add_sink(log_notification_sink, name="log")
+        self._bus = bus
+        self._bus_handle = None
+        if bus is not None:
+            self._bus_handle = bus.subscribe(self.on_events, name=f"alerts:{name}")
+        self._stats_src = register_countable("tpu_alert_rules", self, name=name)
+
+    def close(self) -> None:
+        """Detach from the bus AND the stats collector — a stopped
+        engine on a shared bus must not keep firing rules against its
+        (possibly stopped) store, nor keep dogfooding frozen counters
+        next to a successor with the same name tag."""
+        if self._bus is not None and self._bus_handle is not None:
+            self._bus.unsubscribe(self._bus_handle)
+            self._bus_handle = None
+        from ..utils.stats import default_collector
+
+        default_collector.deregister(self._stats_src)
+
+    # -- registry --------------------------------------------------------
+    def add_rule(self, rule: AlertRule) -> None:
+        with self._lock:
+            if rule.name in self._rules:
+                raise ValueError(f"duplicate rule {rule.name!r}")
+            self._rules[rule.name] = (rule, _RuleState())
+
+    def remove_rule(self, name: str) -> None:
+        with self._lock:
+            self._rules.pop(name, None)
+
+    def add_sink(self, fn, *, name: str = "?") -> _Sink:
+        s = _Sink(fn, name)
+        with self._lock:
+            self._sinks.append(s)
+        return s
+
+    # -- evaluation ------------------------------------------------------
+    def on_events(self, events) -> None:
+        """Bus handler: ONE evaluation per matching rule per batch —
+        K window closes in one drain cost one rule evaluation."""
+        with self._lock:
+            rules = list(self._rules.values())
+        if not rules:
+            return
+        now = max((t for t in (event_time(e) for e in events) if t is not None),
+                  default=None)
+        touched = {
+            (getattr(e, "db", None), getattr(e, "table", None)) for e in events
+        }
+        for rule, st in rules:
+            if (rule.db, rule.table) in touched:
+                self._evaluate(rule, st, now)
+
+    def tick(self, now: int | None = None, *, all_rules: bool = False) -> None:
+        """Wall-clock evaluation — the quiet-table path: a pending rule
+        matures to firing (and a firing one resolves) even when no
+        event arrives because traffic stopped. Only PENDING and FIRING
+        rules evaluate by default: an inactive/resolved rule can only
+        change on a breach, which requires new data, which publishes an
+        event — re-running every rule's query per tick would be the
+        per-poll cost the push plane exists to retire (`all_rules=True`
+        restores the sweep for event-less deployments). Unlike the
+        event path, `now=None` here resolves to the WALL clock — the
+        whole point of the tick is that real time kept moving."""
+        now = int(time.time()) if now is None else int(now)
+        with self._lock:
+            rules = list(self._rules.values())
+        for rule, st in rules:
+            if all_rules or st.state in (STATE_PENDING, STATE_FIRING):
+                self._evaluate(rule, st, now)
+
+    def evaluate_rule(self, name: str, *, now: int | None = None):
+        with self._lock:
+            rule, st = self._rules[name]
+        return self._evaluate(rule, st, now)
+
+    def _query_value(self, rule: AlertRule, now: int) -> tuple[float | None, bool]:
+        """→ (value, partial): the scalar the comparator sees, and
+        whether a live open-window partial produced it."""
+        if rule.engine == "promql":
+            from .promql import query_instant
+
+            rows = query_instant(
+                self.store, rule.query, int(now), lookback_s=rule.lookback_s,
+                db=rule.db, table=rule.table, live=self.live,
+            )
+            if not rows:
+                return None, False
+            best = max(rows, key=lambda r: r["value"])
+            return float(best["value"]), any(r.get("partial") for r in rows)
+        from .engine import QueryEngine
+
+        engine = QueryEngine(self.store, live=self.live, cache=False)
+        res = engine.execute(rule.query)
+        if not res.rows:
+            return None, False
+        for c in res.columns:
+            try:
+                return float(res.values[c][0]), res.partial
+            except (TypeError, ValueError):
+                continue
+        return None, res.partial
+
+    def _evaluate(self, rule: AlertRule, st: _RuleState, now: int | None):
+        # now=None (an event batch with no data-timed event, e.g. pure
+        # SnapshotAdvanced): re-evaluate at the rule's LAST data time —
+        # under replay the wall clock is far from the data and would
+        # silently resolve a firing rule over an empty range
+        with self._eval_lock:
+            if now is None:
+                now = st.last_eval or int(time.time())
+            now = int(now)
+            try:
+                with self.tracer.span(SPAN_ALERT_EVAL):
+                    value, partial = self._query_value(rule, now)
+            except Exception:
+                st.eval_errors += 1
+                with self._lock:
+                    self.counters["eval_errors"] += 1
+                return st.state
+            st.evals += 1
+            st.last_eval = now
+            st.last_value = value
+            st.last_partial = partial
+            with self._lock:
+                self.counters["evals"] += 1
+            breach = value is not None and _COMPARATORS[rule.comparator](
+                value, rule.threshold
+            )
+            return self._transition(rule, st, breach, now)
+
+    def _transition(self, rule: AlertRule, st: _RuleState, breach: bool,
+                    now: int) -> str:
+        old = st.state
+        if breach:
+            if st.state in (STATE_INACTIVE, STATE_RESOLVED):
+                st.state = STATE_PENDING
+                st.pending_since = now
+            if st.state == STATE_PENDING and now - st.pending_since >= rule.for_s:
+                st.state = STATE_FIRING
+        else:
+            if st.state == STATE_PENDING:
+                # never matured: fall back quietly, no notification
+                st.state = STATE_RESOLVED if st.fired_before else STATE_INACTIVE
+                st.pending_since = None
+            elif st.state == STATE_FIRING:
+                st.state = STATE_RESOLVED
+                st.pending_since = None
+        if st.state != old:
+            st.transitions += 1
+            st.last_transition = now
+            with self._lock:
+                self.counters["transitions"] += 1
+            if st.state == STATE_FIRING:
+                st.fired_before = True
+                self._notify(rule, st, STATE_FIRING, now)
+            elif st.state == STATE_RESOLVED and old == STATE_FIRING:
+                self._notify(rule, st, STATE_RESOLVED, now)
+        return st.state
+
+    def _notify(self, rule: AlertRule, st: _RuleState, state: str, now: int):
+        event = {
+            "rule": rule.name,
+            "state": state,
+            "value": st.last_value,
+            "comparator": rule.comparator,
+            "threshold": rule.threshold,
+            "time": now,
+            "held_s": (now - st.pending_since) if st.pending_since else 0,
+            "partial": st.last_partial,
+            "labels": dict(rule.labels),
+        }
+        with self._lock:
+            sinks = [s for s in self._sinks if not s.detached]
+            self.counters["notifications"] += 1
+        for s in sinks:
+            try:
+                s.fn(event)
+            except Exception:
+                s.failures += 1
+                with self._lock:
+                    self.counters["sink_errors"] += 1
+                if s.failures >= self.MAX_SINK_FAILURES:
+                    s.detached = True
+                    with self._lock:
+                        self.counters["sinks_detached"] += 1
+                        if s in self._sinks:
+                            self._sinks.remove(s)
+                    _log.exception(
+                        "alert engine %s: notification sink %s detached "
+                        "after %d consecutive failures",
+                        self.name, s.name, s.failures,
+                    )
+            else:
+                s.failures = 0
+
+    # -- read faces ------------------------------------------------------
+    def state(self, name: str) -> str:
+        with self._lock:
+            return self._rules[name][1].state
+
+    def list_rules(self) -> list[dict]:
+        """The dfctl listing: one row per rule with its live state."""
+        with self._lock:
+            rules = list(self._rules.values())
+        return [
+            {
+                "name": r.name,
+                "query": r.query,
+                "condition": f"{r.comparator} {r.threshold}",
+                "for_s": r.for_s,
+                "state": st.state,
+                "value": st.last_value,
+                "partial": st.last_partial,
+                "evals": st.evals,
+                "transitions": st.transitions,
+                "last_transition": st.last_transition,
+            }
+            for r, st in rules
+        ]
+
+    def get_counters(self) -> dict:
+        with self._lock:
+            out = dict(self.counters)
+            rules = list(self._rules.values())
+        out["rules"] = len(rules)
+        out["firing"] = sum(st.state == STATE_FIRING for _, st in rules)
+        out["pending"] = sum(st.state == STATE_PENDING for _, st in rules)
+        for r, st in rules:
+            slug = _NAME_SAN_RE.sub("_", r.name)
+            out[f"rule_{slug}_state_code"] = STATE_CODES[st.state]
+            out[f"rule_{slug}_transitions"] = st.transitions
+        return out

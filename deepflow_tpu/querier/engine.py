@@ -94,6 +94,17 @@ class QueryEngine:
             self.cache = cache
 
     # -- public ---------------------------------------------------------
+    def resolve_query_table(self, sql: str) -> tuple[str, str]:
+        """The (db, table) a statement will read, without executing it —
+        the push plane's event-routing key: a SQL subscription resolves
+        once here and then re-evaluates only when events name its
+        table (querier/subscribe.py)."""
+        q = parse(sql)
+        if isinstance(q, Show):
+            raise SQLError("SHOW statements have no subscribable table")
+        trange = _time_range(q.where) if q.where is not None else None
+        return self._resolve_table(q.table, step=_requested_step(q), trange=trange)
+
     def execute(self, sql: str) -> Result:
         q = parse(sql)
         if isinstance(q, Show):

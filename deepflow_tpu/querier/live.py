@@ -161,7 +161,20 @@ class QueryResultCache:
     (counted: `invalidations` — the window-close path) and reports a
     miss; insertion beyond `max_entries` evicts the least recently
     used (counted: `evictions`). Thread-safe; the cached value is
-    returned by reference — treat results as immutable."""
+    returned by reference — treat results as immutable.
+
+    Push mode (ISSUE 11): `attach_bus(bus)` subscribes the cache to a
+    `events.QueryEventBus` — any WindowClosed / TierClosed /
+    SnapshotAdvanced / StoreMutation event drops the named (db, table)'s
+    entries EAGERLY at event time instead of waiting for the next
+    lookup's token compare. The two paths count into separate lanes —
+    `push_invalidations` (event-driven) vs `stale_invalidations` (the
+    lazy per-lookup backstop) — with `invalidations` kept as their sum,
+    so the push plane's coverage is observable: in a fully event-wired
+    process the stale lane sits at ~0 and every non-zero tick of it
+    names a mutation path that bypassed the bus. The token compare
+    itself is never retired — it is the correctness backstop that keeps
+    stale-row-never-served pinned bit-exact whether or not events flow."""
 
     def __init__(self, max_entries: int = 256, *, tracer: SpanTracer | None = None):
         if max_entries < 1:
@@ -172,9 +185,14 @@ class QueryResultCache:
         )
         self._map: OrderedDict = OrderedDict()
         self._lock = threading.Lock()
+        self._buses: list = []  # attached event buses (handles kept alive)
+        self._rewarm = None
         self.hits = 0
         self.misses = 0
         self.invalidations = 0
+        self.push_invalidations = 0
+        self.stale_invalidations = 0
+        self.rewarmed = 0
         self.evictions = 0
 
     def lookup(self, key, token):
@@ -188,9 +206,12 @@ class QueryResultCache:
                         self.hits += 1
                         return value
                     # stale — a window closed (store epoch moved) or a
-                    # newer snapshot landed (live epoch moved)
+                    # newer snapshot landed (live epoch moved) and no
+                    # push event beat this lookup to the entry: the
+                    # lazy-epoch backstop lane
                     del self._map[key]
                     self.invalidations += 1
+                    self.stale_invalidations += 1
                 self.misses += 1
                 return None
 
@@ -202,13 +223,18 @@ class QueryResultCache:
                 self._map.popitem(last=False)
                 self.evictions += 1
 
-    def invalidate(self, db: str | None = None, table: str | None = None) -> int:
+    def invalidate(
+        self, db: str | None = None, table: str | None = None,
+        *, push: bool = False,
+    ) -> int:
         """Drop entries whose key names (db, table) — every key the
         engines build carries them at fixed positions 2/3; None drops
-        everything. Returns the number invalidated."""
+        everything. Returns the number invalidated. `push=True` counts
+        into the event-driven lane (attach_bus uses it); the default
+        counts the manual/lazy lane."""
         with self._lock:
             if db is None and table is None:
-                n = len(self._map)
+                drop = list(self._map)
                 self._map.clear()
             else:
                 drop = [
@@ -218,9 +244,49 @@ class QueryResultCache:
                 ]
                 for k in drop:
                     del self._map[k]
-                n = len(drop)
+            n = len(drop)
             self.invalidations += n
-            return n
+            if push:
+                self.push_invalidations += n
+            else:
+                self.stale_invalidations += n
+            rewarm = self._rewarm
+        if push and rewarm is not None and drop:
+            # optional re-warm: hand the dropped keys to the hook (a
+            # SubscriptionManager re-evaluating its standing queries is
+            # the usual warmer); contained — a broken warmer must not
+            # break the event path
+            try:
+                self.rewarmed += rewarm(drop)
+            except Exception:
+                pass
+        return n
+
+    def attach_bus(self, bus, *, rewarm=None):
+        """Subscribe to an `events.QueryEventBus`: every event naming a
+        (db, table) push-invalidates its entries. Idempotent per bus.
+        `rewarm(keys) -> int` optionally re-computes hot entries right
+        after a push drop (returns how many it warmed)."""
+        if rewarm is not None:
+            self._rewarm = rewarm
+        with self._lock:
+            if any(b is bus for b, _ in self._buses):
+                return None
+
+        def on_events(events) -> None:
+            seen = set()
+            for e in events:
+                db = getattr(e, "db", None)
+                table = getattr(e, "table", None)
+                if db is None or table is None or (db, table) in seen:
+                    continue
+                seen.add((db, table))
+                self.invalidate(db, table, push=True)
+
+        handle = bus.subscribe(on_events, name="query_cache")
+        with self._lock:
+            self._buses.append((bus, handle))
+        return handle
 
     def __len__(self) -> int:
         with self._lock:
@@ -229,12 +295,17 @@ class QueryResultCache:
     def get_counters(self) -> dict:
         """Countable face — dogfoods into deepflow_system like every
         other component, so cache health is queryable via SQL and
-        PromQL (tpu_query_cache_hits{...})."""
+        PromQL (tpu_query_cache_hits{...}); the push vs stale lanes
+        (tpu_query_cache_push_invalidations / ..._stale_invalidations)
+        make the event plane's invalidation coverage observable."""
         with self._lock:
             return {
                 "hits": self.hits,
                 "misses": self.misses,
                 "invalidations": self.invalidations,
+                "push_invalidations": self.push_invalidations,
+                "stale_invalidations": self.stale_invalidations,
+                "rewarmed": self.rewarmed,
                 "evictions": self.evictions,
                 "entries": len(self._map),
                 "max_entries": self.max_entries,

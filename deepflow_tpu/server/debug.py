@@ -105,6 +105,24 @@ class DebugServer:
                     for r in list_cascade_tiers()
                 ]
             }
+        if cmd == "subscriptions":
+            # push query plane (ISSUE 11): active standing queries with
+            # watcher counts and eval latency — the dfctl listing
+            subs = self.context.get("subscriptions")
+            if subs is None:
+                return {"error": "no subscription manager attached"}
+            return {
+                "subscriptions": subs.list_subscriptions(),
+                "counters": subs.get_counters(),
+            }
+        if cmd == "alerts":
+            alerts = self.context.get("alerts")
+            if alerts is None:
+                return {"error": "no alert engine attached"}
+            return {
+                "alerts": alerts.list_rules(),
+                "counters": alerts.get_counters(),
+            }
         if cmd == "ping":
             return {"pong": True}
         return {"error": f"unknown cmd {cmd!r}"}

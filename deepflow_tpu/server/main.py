@@ -136,6 +136,25 @@ class Server:
             "batch_size": cfg.storage.writer_batch_size,
             "flush_interval_s": cfg.storage.writer_flush_s,
         }
+        # push query plane (ISSUE 11): store mutations publish on the
+        # process-wide event bus → eager result-cache invalidation; the
+        # subscription manager and alert engine evaluate standing
+        # queries on those events. The doc writer registers its pending
+        # rows as live sources (ROADMAP item (a)): the server-layer
+        # network/application families answer range-ending-now queries
+        # with partial rows instead of going dark for a flush interval.
+        from ..querier.alerts import AlertEngine
+        from ..querier.events import connect_store_events, default_event_bus
+        from ..querier.live import default_live_registry
+        from ..querier.subscribe import SubscriptionManager
+
+        self.event_bus = default_event_bus
+        connect_store_events(self.store, self.event_bus)
+        self.subscriptions = SubscriptionManager(
+            self.store, bus=self.event_bus, name="server"
+        )
+        self.alerts = AlertEngine(self.store, bus=self.event_bus, name="server")
+
         self.exporter_hub = ExporterHub(self.exporters) if self.exporters else None
         self.doc_writer = DocStoreWriter(
             self.store,
@@ -143,6 +162,7 @@ class Server:
             ttl_hours=cfg.storage.ttl_hours,
             writer_args=writer_args,
             exporter_hub=self.exporter_hub,
+            live_registry=default_live_registry,
         )
         platform_state = self.resources.build_platform_table(cfg.region_id).build()
         self.flow_metrics = FlowMetricsIngester(
@@ -179,6 +199,8 @@ class Server:
                 "store": self.store,
                 "trisolaris": self.trisolaris,
                 "downsampler": self.downsampler,
+                "subscriptions": self.subscriptions,
+                "alerts": self.alerts,
             }
         )
         self.monitor = StoreMonitor(
@@ -209,6 +231,10 @@ class Server:
             did["platform"] = True
         did["traces_closed"] = self.trace_builder.tick()
         did["monitor"] = self.monitor.check(now)
+        # alert `for`-durations must mature even when a watched table
+        # goes quiet (no events BECAUSE traffic stopped is itself an
+        # alertable condition) — the wall-clock evaluation lane
+        self.alerts.tick(now)
         # this process IS the local analyzer — its liveness follows the
         # tick, every node (remote analyzers heartbeat via their own sync)
         self.balancer.heartbeat(self._analyzer_ip)
@@ -304,4 +330,10 @@ class Server:
         self.debug.stop()
         self.trisolaris.stop()
         self.receiver.stop()
+        # detach the push plane from the PROCESS-WIDE bus: a stopped
+        # server's managers must not keep evaluating against its store
+        # when another server (tests, restarts) publishes
+        self.subscriptions.close()
+        self.alerts.close()
+        self.store.set_mutation_hook(None)
         self.started = False

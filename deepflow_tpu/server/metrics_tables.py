@@ -121,12 +121,20 @@ class DocStoreWriter:
         ttl_hours: int = 168,
         writer_args: dict | None = None,
         exporter_hub=None,
+        live_registry=None,
     ):
         self.store = store
         self.partition_s = partition_s
         self.ttl_hours = ttl_hours
         self.writer_args = writer_args or {}
         self.exporter_hub = exporter_hub
+        # ISSUE 11 satellite (ROADMAP item (a)): with a LiveRegistry
+        # attached, every per-table writer registers its pending rows
+        # as a live source — the server-layer network/application
+        # families answer range-ending-now queries with partial rows
+        # (and live-aware tier selection prefers them) instead of going
+        # dark for the writer's flush interval
+        self.live_registry = live_registry
         self._writers: dict[tuple[str, MetricsTableID], TableWriter] = {}
         self._app_tags = AppServiceTagWriter(store)
         self._lock = threading.Lock()
@@ -140,6 +148,7 @@ class DocStoreWriter:
                     self.store,
                     db,
                     table_schema(tid, self.partition_s, self.ttl_hours),
+                    live_registry=self.live_registry,
                     **self.writer_args,
                 )
                 self._writers[(db, tid)] = w

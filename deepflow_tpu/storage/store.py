@@ -19,6 +19,7 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import json
+import logging
 import re
 import threading
 from pathlib import Path
@@ -127,6 +128,12 @@ class ColumnarStore:
         # same-looking mutation counts on a recycled address would
         # serve one store's cached rows for another's query)
         self.uid = next(_STORE_UIDS)
+        # push query plane (ISSUE 11): optional mutation hook, called
+        # (db, table, epoch) OUTSIDE the lock after every insert/drop —
+        # querier/events.connect_store_events points it at a
+        # QueryEventBus so a window close push-invalidates standing
+        # queries the instant its flushed rows land
+        self._mutation_hook = None
         if self.root is not None:
             self.root.mkdir(parents=True, exist_ok=True)
             self._load_existing()
@@ -227,7 +234,27 @@ class ColumnarStore:
             for pid, part in written:
                 t.parts.setdefault(pid, []).append(part)
             t.mutations += 1
+            epoch = t.mutations
+        self._notify_mutation(db, table, epoch)
         return n
+
+    def set_mutation_hook(self, hook) -> None:
+        """`hook(db, table, epoch)` fires after every insert/drop (None
+        detaches). Called outside the store lock; exceptions are
+        contained — a broken event plane must never fail a write."""
+        self._mutation_hook = hook
+
+    def _notify_mutation(self, db: str, table: str, epoch: int) -> None:
+        hook = self._mutation_hook
+        if hook is None:
+            return
+        try:
+            hook(db, table, epoch)
+        except Exception:
+            logging.getLogger(__name__).debug(
+                "store mutation hook failed for %s.%s (contained)",
+                db, table, exc_info=True,
+            )
 
     def scan(
         self,
@@ -305,6 +332,8 @@ class ColumnarStore:
                 if isinstance(part, Path):
                     part.unlink(missing_ok=True)
             t.mutations += 1
+            epoch = t.mutations
+        self._notify_mutation(db, table, epoch)
 
     def mutation_count(self, db: str, table: str) -> int:
         """Write epoch of one table (0 for a table that does not exist

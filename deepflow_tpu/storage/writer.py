@@ -22,6 +22,60 @@ from ..utils.stats import register_countable
 from .store import ColumnarStore, TableSchema
 
 
+class _WriterLiveSource:
+    """LiveRegistry provider over a TableWriter's queued-but-unflushed
+    batches (ISSUE 11 satellite, ROADMAP item (a)): the server-layer
+    metrics writers' pending rows ARE the open span for their tables —
+    a range query ending "now" sees rows the flusher has not landed
+    yet, marked partial, and the flushed insert supersedes them (the
+    mirror drops a batch BEFORE its insert, so a row is never served
+    from both sides — transient invisibility between drop and insert
+    is a bounded freshness gap, never a double count)."""
+
+    def __init__(self, writer: "TableWriter"):
+        self._writer = writer
+
+    def __call__(self, lo: int, hi: int):
+        w = self._writer
+        with w._lock:
+            pending = list(w._live_pending)
+        if not pending:
+            return None
+        names = w.schema.column_names()
+        tcol = w.schema.time_column
+        parts = []
+        for b in pending:
+            try:
+                ts = np.asarray(b[tcol], np.int64)
+            except (KeyError, TypeError):
+                continue
+            sel = (ts >= lo) & (ts < hi)
+            if sel.any():
+                try:
+                    parts.append({k: np.asarray(b[k])[sel] for k in names})
+                except KeyError:  # malformed batch — the flusher counts it
+                    continue
+        if not parts:
+            return None
+        if len(parts) == 1:
+            return parts[0]
+        return {k: np.concatenate([p[k] for p in parts]) for k in names}
+
+    def epoch(self) -> int:
+        return self._writer._live_epoch
+
+    def open_from(self) -> int | None:
+        w = self._writer
+        tcol = w.schema.time_column
+        with w._lock:
+            pending = list(w._live_pending)
+        vals = [
+            int(np.min(b[tcol])) for b in pending
+            if tcol in b and len(np.atleast_1d(b[tcol]))
+        ]
+        return min(vals) if vals else None
+
+
 class TableWriter:
     def __init__(
         self,
@@ -33,6 +87,7 @@ class TableWriter:
         flush_interval_s: float = 1.0,
         queue_capacity: int = 256,
         retries: int = 3,
+        live_registry=None,
     ):
         store.create_table(db, schema)
         self.store = store
@@ -50,6 +105,17 @@ class TableWriter:
             "pending_rows": 0,
         }
         self._lock = threading.Lock()
+        # live read plane (ISSUE 11): the pending mirror tracks batches
+        # from put() until the flusher hands them to the store; a
+        # registered _WriterLiveSource serves them as open-span rows
+        self._live_pending: list = []
+        self._live_epoch = 0
+        self._live_handle = None
+        self._live_registry = live_registry
+        if live_registry is not None:
+            self._live_handle = live_registry.register(
+                db, schema.name, _WriterLiveSource(self)
+            )
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
@@ -70,6 +136,15 @@ class TableWriter:
         n = len(next(iter(cols.values()))) if cols else 0
         if n == 0:
             return True
+        # mirror BEFORE the queue handoff: once the batch is in the
+        # queue the flusher may retire-and-insert it at any moment, and
+        # a mirror append landing after that retire pass would serve
+        # the rows live forever ALONGSIDE their store copy (permanent
+        # double count + mirror leak)
+        if self._live_handle is not None:
+            with self._lock:
+                self._live_pending.append(cols)
+                self._live_epoch += 1
         try:
             self._q.put_nowait(cols)
             with self._lock:
@@ -78,6 +153,12 @@ class TableWriter:
         except queue.Full:
             with self._lock:
                 self.counters["dropped_full"] += n
+                if self._live_handle is not None and self._live_pending:
+                    # the batch never entered the pipeline — un-mirror it
+                    self._live_pending = [
+                        b for b in self._live_pending if b is not cols
+                    ]
+                    self._live_epoch += 1
             return False
 
     # -- flusher --------------------------------------------------------
@@ -106,6 +187,18 @@ class TableWriter:
 
     def _flush(self, batches: list[dict[str, np.ndarray]], rows: int):
         names = self.schema.column_names()
+        # retire the batches from the live mirror BEFORE the insert:
+        # between retire and insert a query sees neither copy (a bounded
+        # freshness gap — the rows "haven't arrived yet"); retiring
+        # after would let one query see both and double-count in SQL
+        # aggregates, the forbidden outcome
+        with self._lock:
+            if self._live_handle is not None and self._live_pending:
+                ids = {id(b) for b in batches}
+                self._live_pending = [
+                    b for b in self._live_pending if id(b) not in ids
+                ]
+                self._live_epoch += 1
         try:
             merged = {
                 nm: np.concatenate([np.asarray(b[nm]) for b in batches]) for nm in names
@@ -145,6 +238,9 @@ class TableWriter:
     def stop(self, timeout: float = 5.0) -> None:
         self._stop.set()
         self._thread.join(timeout=timeout)
+        if self._live_handle is not None:
+            self._live_registry.unregister(self._live_handle)
+            self._live_handle = None
         from ..utils.stats import default_collector
 
         default_collector.deregister(self._stats_src)

@@ -63,6 +63,15 @@ SPAN_FEEDER_DRAIN = "feeder.drain"  # queue gets + frame decode
 SPAN_FEEDER_COALESCE = "feeder.coalesce"  # bucket assembly + pad
 SPAN_FEEDER_DISPATCH = "feeder.dispatch"  # staged batch → sink ingest
 
+# Push query plane (ISSUE 11) — emitted by querier/subscribe.py and
+# querier/alerts.py on their own tracers; also not pipeline vocabulary
+# (a pipeline can run with no standing queries). One span per
+# subscription/rule evaluation, so fan-out latency (flush → watcher
+# delivery) is attributable separately from the pull path's
+# query.snapshot/query.cache lanes.
+SPAN_SUBSCRIPTION_EVAL = "subscribe.eval"  # one shared eval serving N watchers
+SPAN_ALERT_EVAL = "alert.eval"  # rule query + state-machine step
+
 PIPELINE_SPAN_NAMES = (
     SPAN_INGEST_DISPATCH,
     SPAN_STATS_FETCH,

@@ -1,0 +1,818 @@
+"""ISSUE 11 push query plane: event bus, flush-driven invalidation,
+query subscriptions, and the alerting rule engine.
+
+Pins, in order: (1) the QueryEventBus delivers whole publish batches,
+contains raising handlers and detaches repeat offenders; (2) push
+invalidation drops a mutated table's cache entries at EVENT time
+(push lane) while the per-lookup token compare stays as the backstop
+(stale lane) — both lanes queryable via SQL and PromQL; (3) one
+subscription evaluation serves N watchers with results bit-exact
+against a fresh pull, K events in one batch coalesce to ONE eval,
+identical queries dedup to one Subscription, slow/broken watchers are
+bounded/detached without stalling delivery; (4) the alert state
+machine: `for`-duration pending→firing, flap suppression across
+resolve/re-fire, a firing computed from a live partial confirmed
+bit-exact by the post-flush value, event-storm coalescing, topk()
+rules over the sketch lane, and rule states dogfooded into
+deepflow_system; (5) the server-layer writers register as live
+sources — a range-ending-now over a network family returns partial
+rows that settle bit-exact after the flush; (6) the feeder's drain
+publishes WindowClosed events; dfctl lists subscriptions and alerts
+over the debug plane.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from deepflow_tpu.aggregator.window import WindowConfig, WindowManager
+from deepflow_tpu.datamodel.schema import FLOW_METER, TAG_SCHEMA
+from deepflow_tpu.integration.dfstats import (
+    DEEPFLOW_SYSTEM_DB,
+    DEEPFLOW_SYSTEM_TABLE,
+    LIVE_METRIC_FLOW_BYTES,
+    PipelineLiveSource,
+    ensure_system_table,
+    flow_window_sink,
+)
+from deepflow_tpu.querier.alerts import (
+    STATE_FIRING,
+    STATE_INACTIVE,
+    STATE_PENDING,
+    STATE_RESOLVED,
+    AlertEngine,
+    AlertRule,
+    otlp_notification_sink,
+)
+from deepflow_tpu.querier.events import (
+    QueryEventBus,
+    SnapshotAdvanced,
+    StoreMutation,
+    TierClosed,
+    WindowClosed,
+    connect_store_events,
+    docbatch_events,
+)
+from deepflow_tpu.querier.live import LiveRegistry, QueryResultCache
+from deepflow_tpu.querier.promql import query_range
+from deepflow_tpu.querier.subscribe import SubscriptionManager
+from deepflow_tpu.storage.store import ColumnarStore
+
+T0 = 1_700_000_000
+
+
+def _samples_insert(store, t, metric, value, labels=""):
+    store.insert(DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE, {
+        "time": np.asarray([t], np.uint32),
+        "metric": np.asarray([metric], object),
+        "labels": np.asarray([labels], object),
+        "value": np.asarray([value], np.float64),
+    })
+
+
+def _doc_ingest(wm: WindowManager, t: int, keys: list[int], byte_tx: float):
+    n = len(keys)
+    meters = np.zeros((FLOW_METER.num_fields, n), np.float32)
+    meters[FLOW_METER.index("byte_tx")] = byte_tx
+    return wm.ingest(
+        np.full(n, t, np.uint32),
+        np.asarray(keys, np.uint32), np.asarray(keys, np.uint32) + 1,
+        np.zeros((TAG_SCHEMA.num_fields, n), np.uint32), meters,
+        np.ones(n, bool),
+    )
+
+
+# ---------------------------------------------------------------------------
+# (1) the bus
+
+
+def test_event_bus_batch_delivery_and_containment():
+    bus = QueryEventBus(name="t1")
+    seen: list[list] = []
+    bus.subscribe(lambda evs: seen.append(list(evs)), name="ok")
+
+    bad_calls = {"n": 0}
+
+    def bad(evs):
+        bad_calls["n"] += 1
+        raise RuntimeError("boom")
+
+    bus.subscribe(bad, name="bad")
+    # one publish call = one batch delivery, however many events
+    batch = [WindowClosed("db", "t", T0 + i) for i in range(5)]
+    assert bus.publish(batch) == 5
+    assert len(seen) == 1 and len(seen[0]) == 5
+    c = bus.get_counters()
+    assert c["events_published"] == 5 and c["batches"] == 1
+    assert c["handler_errors"] == 1  # contained, not raised
+
+    # repeat offender detaches after MAX_HANDLER_FAILURES batches
+    for _ in range(QueryEventBus.MAX_HANDLER_FAILURES):
+        bus.publish(WindowClosed("db", "t", T0))
+    c = bus.get_counters()
+    assert c["handlers_detached"] == 1
+    assert bad_calls["n"] == QueryEventBus.MAX_HANDLER_FAILURES
+    n = bad_calls["n"]
+    bus.publish(WindowClosed("db", "t", T0))
+    assert bad_calls["n"] == n  # gone
+    # the healthy handler saw every batch
+    assert len(seen) == QueryEventBus.MAX_HANDLER_FAILURES + 2
+
+
+def test_event_bus_reentrant_publish_drains_in_outer_dispatch():
+    bus = QueryEventBus(name="t2")
+    seen: list[list] = []
+
+    def chain(evs):
+        if any(isinstance(e, WindowClosed) for e in evs):
+            # publishing from inside a handler must queue, not recurse
+            bus.publish(StoreMutation("db", "t", 1))
+
+    bus.subscribe(chain, name="chain")
+    bus.subscribe(lambda evs: seen.append(list(evs)), name="obs")
+    bus.publish(WindowClosed("db", "t", T0))
+    assert len(seen) == 2  # the original batch, then the re-entrant one
+    assert isinstance(seen[1][0], StoreMutation)
+
+
+def test_docbatch_events_shapes():
+    class _FW:  # FlushedWindow shape
+        start_time, interval, count = T0, 0, 3
+
+    class _TW:  # tier window
+        start_time, interval = T0 - 40, 60
+
+    class _DB:  # DocBatch shape
+        timestamp = np.asarray([T0 + 2, T0 + 2], np.uint32)
+
+    evs = docbatch_events([_FW(), _TW(), _DB(), object()], db="d", table="t")
+    kinds = {(type(e).__name__, e.time, e.interval) for e in evs}
+    assert ("WindowClosed", T0, 1) in kinds
+    assert ("TierClosed", T0 - 40, 60) in kinds
+    assert ("WindowClosed", T0 + 2, 1) in kinds
+    assert len(evs) == 3  # the unreadable object is skipped, not fatal
+
+
+# ---------------------------------------------------------------------------
+# (2) push invalidation — and the satellite counter-lane split
+
+
+def test_push_invalidation_eager_with_lazy_backstop():
+    store = ColumnarStore()
+    ensure_system_table(store)
+    bus = QueryEventBus(name="t3")
+    cache = QueryResultCache(max_entries=8)
+    cache.attach_bus(bus)
+    connect_store_events(store, bus)
+
+    kw = dict(db=DEEPFLOW_SYSTEM_DB, table=DEEPFLOW_SYSTEM_TABLE,
+              live=LiveRegistry(), cache=cache)
+    _samples_insert(store, T0, "m", 1.0)
+    r1 = query_range(store, "m", T0, T0 + 2, 1, **kw)
+    assert query_range(store, "m", T0, T0 + 2, 1, **kw) == r1
+    c = cache.get_counters()
+    assert c["hits"] == 1 and c["entries"] == 1
+
+    # the push: a flushed insert drops the entry AT EVENT TIME —
+    # before any lookup runs — so the next lookup is a clean miss,
+    # not a token mismatch
+    _samples_insert(store, T0 + 1, "m", 5.0)
+    c = cache.get_counters()
+    assert c["entries"] == 0, "entry must drop at event time, not at lookup"
+    assert c["push_invalidations"] == 1
+    assert c["stale_invalidations"] == 0
+    r2 = query_range(store, "m", T0, T0 + 2, 1, **kw)
+    assert r2 != r1
+    c = cache.get_counters()
+    assert c["stale_invalidations"] == 0  # push covered it: backstop idle
+    assert c["invalidations"] == c["push_invalidations"] + c["stale_invalidations"]
+
+    # the backstop: detach the hook (a mutation path that bypasses the
+    # bus) — the lazy per-lookup token compare still catches it, in
+    # the stale lane, and no stale row is ever served
+    store.set_mutation_hook(None)
+    _samples_insert(store, T0 + 2, "m", 9.0)
+    stale0 = cache.get_counters()["stale_invalidations"]
+    # r2's entry is now stale in place; its next lookup must drop it
+    # and recompute over the NEW rows — never serve the stale value
+    r2b = query_range(store, "m", T0, T0 + 2, 1, **kw)
+    assert [v for _, v in r2b[0]["values"]][-1] == 9.0
+    c = cache.get_counters()
+    assert c["stale_invalidations"] == stale0 + 1
+    assert c["push_invalidations"] == 1  # unchanged — hook detached
+
+
+def test_invalidation_lane_counters_queryable_sql_and_promql():
+    """Satellite pin: the push vs stale lanes are Countable fields,
+    queryable through BOTH engines like every other cache counter."""
+    from deepflow_tpu.integration.dfstats import system_sink
+    from deepflow_tpu.querier.engine import QueryEngine
+    from deepflow_tpu.querier.promql import query_instant
+    from deepflow_tpu.utils.stats import StatsCollector
+
+    bus = QueryEventBus(name="t4")
+    cache = QueryResultCache(max_entries=8)
+    cache.attach_bus(bus)
+    cache.store(("q", "a", "db1", "t1"), 0, [1])
+    cache.store(("q", "b", "db2", "t2"), 0, [2])
+    bus.publish(WindowClosed("db1", "t1", T0))       # push lane
+    assert cache.lookup(("q", "b", "db2", "t2"), 1) is None  # stale lane
+
+    store = ColumnarStore()
+    col = StatsCollector(interval_s=999)
+    col.register("tpu_query_cache", cache)
+    col.add_sink(system_sink(store))
+    col.tick(now=float(T0))
+
+    eng = QueryEngine(store, cache=False)
+    for field, want in (("push_invalidations", 1.0),
+                        ("stale_invalidations", 1.0)):
+        res = eng.execute(
+            "SELECT value FROM deepflow_system.deepflow_system "
+            f"WHERE metric = 'tpu_query_cache_{field}'"
+        )
+        assert res.rows == 1 and float(res.values["value"][0]) == want, field
+        out = query_instant(
+            store, f"tpu_query_cache_{field}", T0 + 1,
+            db=DEEPFLOW_SYSTEM_DB, table=DEEPFLOW_SYSTEM_TABLE,
+        )
+        assert len(out) == 1 and out[0]["value"] == want, field
+
+
+# ---------------------------------------------------------------------------
+# (3) subscriptions
+
+
+def _wired(max_entries=64):
+    store = ColumnarStore()
+    ensure_system_table(store)
+    bus = QueryEventBus(name="w")
+    cache = QueryResultCache(max_entries=max_entries)
+    cache.attach_bus(bus)
+    connect_store_events(store, bus)
+    reg = LiveRegistry()
+    subs = SubscriptionManager(store, live=reg, cache=cache, bus=bus, name="w")
+    return store, bus, cache, reg, subs
+
+
+def test_one_evaluation_fans_out_to_n_watchers_bit_exact():
+    store, bus, cache, reg, subs = _wired()
+    N = 100
+    got: list[list] = [[] for _ in range(N)]
+    sub = None
+    for i in range(N):
+        s, _ = subs.subscribe_promql(
+            "m", span_s=5, step=1, db=DEEPFLOW_SYSTEM_DB,
+            table=DEEPFLOW_SYSTEM_TABLE,
+            callback=(lambda r, s, _i=i: got[_i].append(r)),
+        )
+        sub = s if sub is None else sub
+        assert s is sub, "identical specs must dedup to ONE subscription"
+    assert len(subs.list_subscriptions()) == 1
+    assert subs.list_subscriptions()[0]["watchers"] == N
+
+    _samples_insert(store, T0, "m", 7.0)  # → StoreMutation → one eval
+    # K window closes in ONE batch → still one eval (coalescing)
+    bus.publish([WindowClosed(DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE, T0 + i)
+                 for i in range(6)])
+    assert sub.evals == 2, "one eval per batch, not per event or watcher"
+    assert sub.coalesced_events == 5
+    assert all(len(g) == 2 for g in got)
+
+    # the delivered result is bit-exact vs a FRESH pull evaluation
+    fresh = query_range(
+        store, "m", sub.last_now - 5, sub.last_now, 1,
+        db=DEEPFLOW_SYSTEM_DB, table=DEEPFLOW_SYSTEM_TABLE, live=reg,
+        cache=False,
+    )
+    assert got[0][-1] == fresh
+    c = subs.get_counters()
+    assert c["evals"] == 2 and c["deliveries"] == 2 * N
+    assert c["amplification_x100"] == N * 100
+    # unrelated tables never wake the subscription
+    bus.publish(WindowClosed("other_db", "other_t", T0))
+    assert sub.evals == 2
+
+
+def test_watcher_queue_bounded_and_raising_callback_detached():
+    store, bus, cache, reg, subs = _wired()
+    sub, wq = subs.subscribe_promql(
+        "m", span_s=5, step=1, db=DEEPFLOW_SYSTEM_DB,
+        table=DEEPFLOW_SYSTEM_TABLE, queue=True, maxlen=2,
+    )
+
+    def bad(result, s):
+        raise RuntimeError("watcher down")
+
+    wbad = sub.watch(bad)
+    _samples_insert(store, T0, "m", 1.0)
+    for i in range(4):
+        bus.publish(WindowClosed(DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE,
+                                 T0 + i))
+    assert sub.evals == 5
+    # queue mode: bounded, oldest dropped and counted, newest kept
+    assert wq.dropped == 3 and len(wq.queue) == 2
+    assert wq.poll() is not None
+    # callback mode: counted then detached — delivery to the healthy
+    # watcher never stalled
+    c = subs.get_counters()
+    assert c["watcher_errors"] == wbad.errors > 0
+    assert c["watchers_detached"] == 1
+    assert wbad not in sub.watchers
+    assert c["watcher_drops"] == 3
+
+
+def test_sql_subscription_resolves_table_and_reevaluates():
+    store, bus, cache, reg, subs = _wired()
+    _samples_insert(store, T0, "m", 2.0)
+    got = []
+    sub, _ = subs.subscribe_sql(
+        "SELECT Sum(value) AS total FROM deepflow_system.deepflow_system",
+        callback=lambda r, s: got.append(float(r.values["total"][0])),
+    )
+    assert (sub.db, sub.table) == (DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE)
+    _samples_insert(store, T0 + 1, "m", 3.0)
+    assert got and got[-1] == 5.0
+    # a SHOW statement has no subscribable table
+    with pytest.raises(Exception):
+        subs.subscribe_sql("SHOW tables")
+
+
+def test_snapshot_advanced_event_reevaluates_live_overlay():
+    """A SnapshotAdvanced event (new open-window generation, nothing
+    flushed) must re-evaluate and deliver the NEW partial values."""
+    store, bus, cache, reg, subs = _wired()
+    wm = WindowManager(WindowConfig(capacity=1 << 10, min_snapshot_interval=0.0))
+    reg.register(DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE, PipelineLiveSource(wm))
+    got = []
+    sub, _ = subs.subscribe_promql(
+        LIVE_METRIC_FLOW_BYTES, span_s=4, step=1, db=DEEPFLOW_SYSTEM_DB,
+        table=DEEPFLOW_SYSTEM_TABLE, callback=lambda r, s: got.append(r),
+        lookback_s=2,
+    )
+    _doc_ingest(wm, T0, [10], 100.0)
+    snap = wm.snapshot_open(force=True)
+    bus.publish(SnapshotAdvanced(DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE,
+                                 snap.seq))
+    # SnapshotAdvanced carries no data time → wall-clock now misses T0;
+    # drive an explicit evaluation at the data edge instead
+    res = subs.evaluate(sub, now=T0 + 1)
+    assert res and all(s.get("partial") for s in res)
+    vals = [v for s in res for _, v in s["values"]]
+    assert vals and set(vals) == {100.0}
+
+
+# ---------------------------------------------------------------------------
+# (4) the alert state machine
+
+
+def _alert_stack(**rule_kw):
+    """Store + bus + engine with ONE rule; events are published
+    explicitly with DATA times (WindowClosed), so `for`-duration
+    arithmetic is deterministic — the event plane's clock, not the
+    wall's."""
+    store = ColumnarStore()
+    ensure_system_table(store)
+    bus = QueryEventBus(name="a")
+    fired: list[dict] = []
+    eng = AlertEngine(store, live=LiveRegistry(), bus=bus, name="a",
+                      log_sink=False)
+    eng.add_sink(fired.append, name="cb")
+    rule = AlertRule(name="high_m", query="m", comparator=">", threshold=10.0,
+                     **rule_kw)
+    eng.add_rule(rule)
+    return store, bus, eng, fired
+
+
+def _sample_event(store, bus, t, value):
+    """One data point + its window-close event: the drain shape — the
+    sample lands, then the close for window `t` publishes."""
+    _samples_insert(store, t, "m", value)
+    bus.publish(WindowClosed(DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE, t))
+
+
+def test_alert_for_duration_pending_to_firing():
+    store, bus, eng, fired = _alert_stack(for_s=10)
+    _sample_event(store, bus, T0, 50.0)  # breach lands + event fires
+    # the breach is young: pending, NOT firing, nothing notified
+    assert eng.state("high_m") == STATE_PENDING
+    assert fired == []
+    # held for < for_s: still pending
+    _sample_event(store, bus, T0 + 5, 50.0)
+    assert eng.state("high_m") == STATE_PENDING
+    # held for ≥ for_s: firing, exactly one notification
+    _sample_event(store, bus, T0 + 10, 50.0)
+    assert eng.state("high_m") == STATE_FIRING
+    assert len(fired) == 1
+    ev = fired[0]
+    assert ev["state"] == STATE_FIRING and ev["value"] == 50.0
+    assert ev["held_s"] >= 10
+    # further breaches while firing do NOT re-notify
+    _sample_event(store, bus, T0 + 12, 60.0)
+    assert len(fired) == 1
+
+
+def test_alert_flap_suppression_across_resolve_refire():
+    store, bus, eng, fired = _alert_stack(for_s=5, lookback_s=2)
+    _sample_event(store, bus, T0, 50.0)
+    _sample_event(store, bus, T0 + 5, 50.0)
+    assert eng.state("high_m") == STATE_FIRING and len(fired) == 1
+    # value drops → resolved, one resolve notification
+    _sample_event(store, bus, T0 + 7, 1.0)
+    assert eng.state("high_m") == STATE_RESOLVED
+    assert len(fired) == 2 and fired[1]["state"] == STATE_RESOLVED
+    # re-breach: must walk the FULL pending ladder again — an instant
+    # re-fire here is the flap the suppression exists to stop
+    _sample_event(store, bus, T0 + 9, 50.0)
+    assert eng.state("high_m") == STATE_PENDING
+    assert len(fired) == 2, "re-fire before for_s elapsed = flapping pager"
+    # a dip while pending falls back to RESOLVED (it fired before),
+    # not inactive — and still no notification
+    _sample_event(store, bus, T0 + 11, 1.0)
+    assert eng.state("high_m") == STATE_RESOLVED
+    assert len(fired) == 2
+    # a sustained re-breach matures to firing again
+    _sample_event(store, bus, T0 + 13, 50.0)
+    _sample_event(store, bus, T0 + 18, 50.0)
+    assert eng.state("high_m") == STATE_FIRING
+    assert len(fired) == 3 and fired[2]["state"] == STATE_FIRING
+    st = eng.list_rules()[0]
+    assert st["transitions"] >= 6
+
+
+def test_alert_fires_from_live_partial_confirmed_by_flush():
+    """The flushed-supersedes pin, alert flavor: a rule breaches on an
+    OPEN window's partial rows; when the window flushes, the same rule
+    query answers with the IDENTICAL value from flushed rows (traffic
+    quiesced), and the rule stays firing with no flap."""
+    store = ColumnarStore()
+    ensure_system_table(store)
+    reg = LiveRegistry()
+    wm = WindowManager(WindowConfig(capacity=1 << 10, min_snapshot_interval=0.0))
+    reg.register(DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE, PipelineLiveSource(wm))
+    fired: list[dict] = []
+    eng = AlertEngine(store, live=reg, name="live", log_sink=False)
+    eng.add_sink(fired.append, name="cb")
+    eng.add_rule(AlertRule(
+        name="hot_flow", query=LIVE_METRIC_FLOW_BYTES, comparator=">",
+        threshold=90.0, for_s=0, lookback_s=2,
+    ))
+
+    flushed = _doc_ingest(wm, T0, [10], 100.0)
+    wm.snapshot_open(force=True)
+    assert eng.evaluate_rule("hot_flow", now=T0 + 1) == STATE_FIRING
+    assert len(fired) == 1
+    assert fired[0]["partial"] is True  # fired from a live partial
+    live_value = fired[0]["value"]
+    assert live_value == 100.0
+
+    # close the window; flushed rows land via the SAME row builder
+    flushed += wm.flush_all()
+    flow_window_sink(store)([f for f in flushed if f.count])
+    assert eng.evaluate_rule("hot_flow", now=T0 + 1) == STATE_FIRING
+    st = eng.list_rules()[0]
+    assert st["value"] == live_value  # bit-exact across the close
+    assert st["partial"] is False  # now confirmed by flushed rows
+    assert len(fired) == 1  # no flap, no re-notification
+
+
+def test_alert_event_storm_coalesces_to_one_evaluation():
+    store, bus, eng, fired = _alert_stack(for_s=0)
+    _samples_insert(store, T0, "m", 50.0)
+    evals0 = eng.get_counters()["evals"]
+    # K window closes in ONE drain → ONE publish batch → ONE evaluation
+    bus.publish([WindowClosed(DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE,
+                              T0 + i) for i in range(8)])
+    assert eng.get_counters()["evals"] == evals0 + 1
+    # ...and events for OTHER tables do not evaluate the rule at all
+    bus.publish([WindowClosed("x", "y", T0 + i) for i in range(8)])
+    assert eng.get_counters()["evals"] == evals0 + 1
+
+
+def test_alert_topk_rule_over_sketch_lane():
+    """Heavy-hitter rule: topk() over the sketch tier's inverted top-K
+    metric — the arXiv:2511.16797 shape — compares the BIGGEST
+    recovered flow against the threshold."""
+    from deepflow_tpu.integration.dfstats import SKETCH_METRIC_TOPK
+
+    store = ColumnarStore()
+    ensure_system_table(store)
+    eng = AlertEngine(store, live=LiveRegistry(), name="hh", log_sink=False)
+    eng.add_rule(AlertRule(
+        name="heavy_hitter", query=f"topk(3, {SKETCH_METRIC_TOPK})",
+        comparator=">", threshold=1000.0, for_s=0,
+    ))
+    for rank, est in enumerate([800.0, 500.0, 200.0]):
+        _samples_insert(store, T0, SKETCH_METRIC_TOPK, est, f"rank={rank}")
+    assert eng.evaluate_rule("heavy_hitter", now=T0 + 1) == STATE_INACTIVE
+    _samples_insert(store, T0, SKETCH_METRIC_TOPK, 5000.0, "rank=big")
+    assert eng.evaluate_rule("heavy_hitter", now=T0 + 1) == STATE_FIRING
+    assert eng.list_rules()[0]["value"] == 5000.0
+
+
+def test_alert_states_dogfood_sql_and_promql():
+    from deepflow_tpu.integration.dfstats import system_sink
+    from deepflow_tpu.querier.engine import QueryEngine
+    from deepflow_tpu.querier.promql import query_instant
+    from deepflow_tpu.utils.stats import StatsCollector
+
+    store, bus, eng, fired = _alert_stack(for_s=0)
+    _sample_event(store, bus, T0, 50.0)
+    assert eng.state("high_m") == STATE_FIRING
+
+    col = StatsCollector(interval_s=999)
+    col.register("tpu_alert_rules", eng)
+    col.add_sink(system_sink(store))
+    col.tick(now=float(T0 + 2))
+
+    qe = QueryEngine(store, cache=False)
+    res = qe.execute(
+        "SELECT value FROM deepflow_system.deepflow_system "
+        "WHERE metric = 'tpu_alert_rules_rule_high_m_state_code'"
+    )
+    assert res.rows == 1 and float(res.values["value"][0]) == 2.0  # FIRING
+    out = query_instant(
+        store, "tpu_alert_rules_firing", T0 + 3,
+        db=DEEPFLOW_SYSTEM_DB, table=DEEPFLOW_SYSTEM_TABLE,
+    )
+    assert len(out) == 1 and out[0]["value"] == 1.0
+
+
+def test_notification_sink_detach_and_otlp_lane():
+    store, bus, eng, fired = _alert_stack(for_s=0, lookback_s=2)
+
+    calls = {"n": 0}
+
+    def broken(event):
+        calls["n"] += 1
+        raise OSError("pager down")
+
+    eng.add_sink(broken, name="broken")
+
+    class _Exp:
+        tables: list = []
+
+        def export(self, table, cols):
+            self.tables.append((table, {k: list(map(str, v))
+                                        for k, v in cols.items()}))
+
+    exp = _Exp()
+    eng.add_sink(otlp_notification_sink(exp), name="otlp")
+
+    # drive fire/resolve flaps until the broken sink crosses its limit
+    t = T0
+    for i in range(AlertEngine.MAX_SINK_FAILURES):
+        _sample_event(store, bus, t, 50.0)   # fire
+        _sample_event(store, bus, t + 2, 1.0)  # resolve
+        t += 4
+    c = eng.get_counters()
+    assert c["sink_errors"] == AlertEngine.MAX_SINK_FAILURES
+    assert c["sinks_detached"] == 1
+    n = calls["n"]
+    _sample_event(store, bus, t, 50.0)
+    assert calls["n"] == n  # detached — no longer called
+    # the OTLP lane kept exporting through every flap
+    assert len(exp.tables) == len(fired) >= 2
+    table, cols = exp.tables[0]
+    assert table == "l7_flow_log"
+    assert cols["app_service"] == ["deepflow_tpu.alerts"]
+    assert cols["endpoint"][0].startswith("high_m:")
+
+
+def test_alert_tick_matures_pending_on_quiet_table():
+    """A pending rule must fire when traffic STOPS — tick() is the
+    wall-clock lane that matures for-durations without events."""
+    store, bus, eng, fired = _alert_stack(for_s=10, lookback_s=60)
+    _sample_event(store, bus, T0, 50.0)
+    assert eng.state("high_m") == STATE_PENDING
+    # no further events: the quiet-path tick carries it to firing
+    eng.tick(now=T0 + 30)
+    assert eng.state("high_m") == STATE_FIRING
+    assert len(fired) == 1
+
+
+# ---------------------------------------------------------------------------
+# (5) satellite: server-layer writers as live sources
+
+
+def test_server_writer_live_source_partial_rows_settle_bit_exact():
+    from deepflow_tpu.querier.engine import QueryEngine
+    from deepflow_tpu.server.metrics_tables import MetricsTableID, table_schema
+    from deepflow_tpu.storage.writer import TableWriter
+
+    store = ColumnarStore()
+    reg = LiveRegistry()
+    writer = TableWriter(
+        store, "flow_metrics", table_schema(MetricsTableID.NETWORK_1S),
+        flush_interval_s=0.05, live_registry=reg,
+    )
+    try:
+        assert reg.has("flow_metrics", "network_1s")
+        sch = writer.schema
+        n = 4
+        cols = {c.name: np.zeros(n, dtype=np.dtype(c.dtype))
+                for c in sch.columns}
+        cols["time"] = np.full(n, T0, np.uint32)
+        cols["byte_tx"] = np.asarray([10.0, 20.0, 30.0, 40.0], np.float32)
+        writer.put(cols)
+
+        eng = QueryEngine(store, live=reg, cache=False)
+        sql = f"SELECT Sum(byte_tx) AS total FROM network WHERE time >= {T0 - 5}"
+        # bare family + range ending now → the LIVE-covered 1s tier
+        assert eng._resolve_table(
+            "network", step=None, trange=(T0 - 5, 1 << 62)
+        ) == ("flow_metrics", "network_1s")
+        res = eng.execute(sql)
+        assert res.partial is True, "pending writer rows must serve as partials"
+        assert float(res.values["total"][0]) == 100.0
+
+        writer.flush()
+        res2 = eng.execute(sql)
+        assert res2.partial is False  # flushed rows superseded the mirror
+        assert float(res2.values["total"][0]) == 100.0  # bit-exact settle
+        assert store.row_count("flow_metrics", "network_1s") == n
+    finally:
+        writer.stop()
+    # teardown unregisters the provider
+    assert not reg.has("flow_metrics", "network_1s")
+
+
+def test_doc_store_writer_passes_live_registry_down():
+    from deepflow_tpu.server.metrics_tables import DocStoreWriter, MetricsTableID
+
+    store = ColumnarStore()
+    reg = LiveRegistry()
+    dw = DocStoreWriter(store, live_registry=reg,
+                        writer_args={"flush_interval_s": 0.05})
+    w = dw._writer("flow_metrics", MetricsTableID.APPLICATION_1S)
+    try:
+        assert reg.has("flow_metrics", "application_1s")
+    finally:
+        dw.stop()
+
+
+# ---------------------------------------------------------------------------
+# (6) feeder drain hook + dfctl
+
+
+def test_feeder_publishes_window_events_at_drain():
+    from deepflow_tpu.aggregator.pipeline import L4Pipeline, PipelineConfig
+    from deepflow_tpu.feeder import (
+        FeederConfig,
+        FeederRuntime,
+        PipelineFeedSink,
+        encode_flowbatch_frames,
+    )
+    from deepflow_tpu.ingest.queues import PyOverwriteQueue
+    from deepflow_tpu.ingest.replay import SyntheticFlowGen
+
+    store = ColumnarStore()
+    ensure_system_table(store)
+    bus = QueryEventBus(name="feed")
+    batches: list[list] = []
+    bus.subscribe(lambda evs: batches.append(list(evs)), name="obs")
+
+    pipe = L4Pipeline(PipelineConfig(
+        window=WindowConfig(capacity=1 << 12, min_snapshot_interval=0.0),
+        batch_size=256, bucket_sizes=(64, 128),
+    ))
+    q = PyOverwriteQueue(1 << 10)
+    feeder = FeederRuntime(
+        [q], PipelineFeedSink(pipe),
+        FeederConfig(frames_per_queue=8, snapshot_interval_pumps=2),
+        name="pushfeed", event_bus=bus,
+    )
+    gen = SyntheticFlowGen(num_tuples=100, seed=5)
+    # jump the clock so window closes ride the pumps
+    for i, t in enumerate((T0, T0 + 1, T0 + 6, T0 + 7)):
+        for fr in encode_flowbatch_frames(gen.flow_batch(64, t),
+                                          max_rows_per_frame=64):
+            q.put(fr)
+        feeder.pump()
+    feeder.flush()
+    c = feeder.get_counters()
+    assert c["events_published"] > 0
+    closed = [e for b in batches for e in b if isinstance(e, WindowClosed)]
+    assert closed, "window closes never reached the bus"
+    assert {e.table for e in closed} == {DEEPFLOW_SYSTEM_TABLE}
+    # a drain that closed K windows delivered them as ONE batch
+    multi = [b for b in batches
+             if sum(isinstance(e, WindowClosed) for e in b) > 1]
+    assert multi, "multi-window drain should publish one coalesced batch"
+    # snapshot scheduling rode along and published generations
+    snaps = [e for b in batches for e in b if isinstance(e, SnapshotAdvanced)]
+    assert snaps and c["snapshots_taken"] > 0
+
+
+def test_debug_plane_and_dfctl_listing(capsys):
+    from deepflow_tpu.cli import main as dfctl_main
+    from deepflow_tpu.server.debug import DebugServer, debug_request
+
+    store, bus, cache, reg, subs = _wired()
+    eng = AlertEngine(store, live=reg, bus=bus, name="dbg", log_sink=False)
+    eng.add_rule(AlertRule(name="r1", query="m", comparator=">",
+                           threshold=10.0, for_s=0))
+    subs.subscribe_promql("m", span_s=5, step=1, db=DEEPFLOW_SYSTEM_DB,
+                          table=DEEPFLOW_SYSTEM_TABLE, queue=True)
+    _samples_insert(store, T0, "m", 50.0)
+    # the close event carries the DATA time, so the rule's instant
+    # query lands on the sample (a bare StoreMutation has no time and
+    # would evaluate at the wall clock, far past T0)
+    bus.publish(WindowClosed(DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE, T0))
+
+    dbg = DebugServer(context={"subscriptions": subs, "alerts": eng})
+    try:
+        resp = debug_request("127.0.0.1", dbg.port, {"cmd": "subscriptions"})
+        assert resp["subscriptions"][0]["watchers"] == 1
+        assert resp["subscriptions"][0]["evals"] >= 1
+        assert "last_eval_us" in resp["subscriptions"][0]
+        resp = debug_request("127.0.0.1", dbg.port, {"cmd": "alerts"})
+        assert resp["alerts"][0]["name"] == "r1"
+        assert resp["alerts"][0]["state"] == STATE_FIRING
+        assert resp["counters"]["firing"] == 1
+
+        # the dfctl commands print the same listings
+        import json as _json
+
+        dfctl_main(["subscriptions", "--port", str(dbg.port)])
+        out = _json.loads(capsys.readouterr().out)
+        assert out["subscriptions"][0]["watchers"] == 1
+        dfctl_main(["alerts", "--port", str(dbg.port)])
+        out = _json.loads(capsys.readouterr().out)
+        assert out["alerts"][0]["state"] == STATE_FIRING
+    finally:
+        dbg.stop()
+    # a context without the push plane answers with an error, not a crash
+    dbg2 = DebugServer(context={})
+    try:
+        assert "error" in debug_request("127.0.0.1", dbg2.port,
+                                        {"cmd": "alerts"})
+    finally:
+        dbg2.stop()
+
+
+def test_sink_insert_and_close_events_coalesce_to_one_dispatch():
+    """Full wiring (store mutation hook + a bus-aware sink) must cost
+    ONE dispatch per sink call, not two: the insert's StoreMutation
+    joins the sink's data-timed WindowClosed in a single batch
+    (bus.batch), so standing queries evaluate once — at the data time,
+    not first at the wall clock — and the cache does not bounce
+    through a drop/rewarm/drop per close."""
+    store = ColumnarStore()
+    ensure_system_table(store)
+    bus = QueryEventBus(name="coal")
+    connect_store_events(store, bus)
+    batches: list[list] = []
+    bus.subscribe(lambda evs: batches.append(list(evs)), name="obs")
+    reg = LiveRegistry()
+    subs = SubscriptionManager(store, live=reg, cache=False, bus=bus,
+                               name="coal")
+    sub, _ = subs.subscribe_promql(
+        LIVE_METRIC_FLOW_BYTES, span_s=4, step=1, db=DEEPFLOW_SYSTEM_DB,
+        table=DEEPFLOW_SYSTEM_TABLE, queue=True,
+    )
+    wm = WindowManager(WindowConfig(capacity=1 << 10, min_snapshot_interval=0.0))
+    flushed = _doc_ingest(wm, T0, [10], 100.0)
+    flushed += wm.flush_all()
+    flow_window_sink(store, bus=bus)([f for f in flushed if f.count])
+    assert len(batches) == 1, "insert + close events must be ONE dispatch"
+    kinds = {type(e).__name__ for e in batches[0]}
+    assert kinds == {"StoreMutation", "WindowClosed"}
+    assert sub.evals == 1
+    # ...and the one evaluation ran at the DATA time and saw the rows
+    assert sub.last_now == T0 + 1
+    vals = [v for s in sub.last_result for _, v in s["values"]]
+    assert vals and set(vals) == {100.0}
+
+
+def test_tier_closed_event_from_sketch_sink():
+    """sketch_system_sink with a bus publishes WindowClosed for 1s
+    blocks — the cascade's coarser blocks would ride TierClosed — after
+    the insert, so a standing heavy-hitter rule sees fresh rows."""
+    from deepflow_tpu.aggregator.pipeline import L4Pipeline, PipelineConfig
+    from deepflow_tpu.aggregator.sketchplane import SketchConfig
+    from deepflow_tpu.datamodel.batch import FlowBatch
+    from deepflow_tpu.ingest.replay import SyntheticFlowGen
+    from deepflow_tpu.integration.dfstats import sketch_system_sink
+    from deepflow_tpu.ops.histogram import LogHistSpec
+
+    store = ColumnarStore()
+    bus = QueryEventBus(name="sk")
+    seen: list = []
+    bus.subscribe(lambda evs: seen.extend(evs), name="obs")
+    sk = SketchConfig(
+        num_groups=4, hll_precision=6, cms_depth=2, cms_width=128,
+        hist=LogHistSpec(bins=32, vmin=1.0, gamma=1.3),
+        topk_rows=2, topk_cols=32, pending=8,
+    )
+    pipe = L4Pipeline(PipelineConfig(
+        window=WindowConfig(capacity=1 << 12, sketch=sk), batch_size=256,
+    ))
+    gen = SyntheticFlowGen(num_tuples=100, seed=3)
+    sink = sketch_system_sink(store, bus=bus)
+    for t in (T0, T0 + 5):
+        pipe.ingest(FlowBatch.from_records(gen.records(128, t)))
+        sink(pipe.pop_closed_sketches())
+    assert any(isinstance(e, WindowClosed) for e in seen)
+    assert store.row_count(DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE) > 0
