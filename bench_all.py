@@ -575,13 +575,44 @@ def config12(quick: bool):
          flows=rec["flows"])
 
 
+def config13(quick: bool):
+    """Device profiling plane (ISSUE 12): always-on ledger + census +
+    span-quantile overhead on the §14 feeder workload via
+    bench/profbench.py (protocol: PERF.md §21, committed numbers:
+    PROFBENCH_r01.json). The vs line is the overhead percent under an
+    aggressive every-4-pumps profiling consumer (acceptance <2% with
+    fetch parity — parity itself is CI-gated deterministically); the
+    profile pull latencies and per-bucket census rows ride the
+    detail."""
+    import os
+    import subprocess
+
+    env = {**os.environ}
+    if quick:
+        env.update(PROFBENCH_ITERS="16")
+    out = subprocess.run(
+        [sys.executable, "bench/profbench.py"],
+        capture_output=True, text=True, timeout=1800, env=env,
+    )
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    if rec.get("partial"):
+        emit("c13_device_profiling", 0, "error", 0, error=rec.get("error"))
+        return
+    emit("c13_device_profiling", rec["profiled"]["rec_s"], "records/s",
+         rec["overhead_pct"],
+         fetch_parity=rec["fetch_parity"], pull=rec["pull"],
+         hbm_bytes=rec["hbm_bytes"], census=rec["census"],
+         span_p99_us=rec["span_p99_us"],
+         passive=rec["passive"], iters=rec["iters"])
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--cpu", action="store_true")
     p.add_argument("--quick", action="store_true")
     args = p.parse_args()
     for fn in (config1, config2, config3, config4, config5, config6, config7,
-               config8, config9, config10, config11, config12):
+               config8, config9, config10, config11, config12, config13):
         try:
             fn(args.quick)
         except Exception as e:  # one config must not kill the others

@@ -461,6 +461,15 @@ def save_window_state(wm: WindowManager, path: str | Path, *, extra_meta=None):
             arrays.update(c_arrays)
         if extra_meta:
             meta.update(extra_meta)
+        # device profiling plane (ISSUE 12): the jitted pack kernels
+        # above materialized device scratch of exactly these byte sizes
+        # before the host copy — record the peak on the HBM ledger's
+        # transient checkpoint_scratch row (steady-state bytes stay 0)
+        from ..profiling.ledger import PLANE_CHECKPOINT, default_ledger
+
+        default_ledger.note_transient(
+            PLANE_CHECKPOINT, sum(a.nbytes for a in arrays.values())
+        )
         _write_checkpoint(path, meta, arrays)
     return in_flight
 
@@ -612,6 +621,15 @@ def save_sharded_state(swm, path: str | Path, *, extra_meta=None) -> list:
             arrays.update(c_arrays)
         if extra_meta:
             meta.update(extra_meta)
+        # device profiling plane (ISSUE 12): the jitted pack kernels
+        # above materialized device scratch of exactly these byte sizes
+        # before the host copy — record the peak on the HBM ledger's
+        # transient checkpoint_scratch row (steady-state bytes stay 0)
+        from ..profiling.ledger import PLANE_CHECKPOINT, default_ledger
+
+        default_ledger.note_transient(
+            PLANE_CHECKPOINT, sum(a.nbytes for a in arrays.values())
+        )
         _write_checkpoint(path, meta, arrays)
     return in_flight
 

@@ -20,6 +20,8 @@ download is the 5-scalar stats vector the window controller reads
 from __future__ import annotations
 
 import dataclasses
+import itertools
+import time
 
 import jax
 import jax.numpy as jnp
@@ -42,6 +44,10 @@ from .window import (
     sketch_span_bounds,
 )
 from .sketchplane import SketchConfig, sketch_plane_step
+
+#: census service-key ordinal — one per pipeline instance, so profile
+#: attribution never aliases across concurrently-live pipelines
+_PIPELINE_SEQ = itertools.count(1)
 
 _KEY_COLS = np.nonzero(TAG_SCHEMA.key_mask)[0].astype(np.int32)
 # DOC_KEY_PACK covers exactly the TAG_SCHEMA key columns — drift between
@@ -298,16 +304,36 @@ class RollupPipeline:
             )
         # self-telemetry registration (reference RegisterCountable stance:
         # every component registers at construction; weakly held, so
-        # short-lived pipelines deregister themselves)
-        register_countable(
-            "tpu_pipeline", self,
-            kind=type(self).__name__,
-            interval=f"{config.window.interval}s",
-        )
-        register_countable(
-            "tpu_pipeline_spans", self.tracer,
-            kind=type(self).__name__,
-            interval=f"{config.window.interval}s",
+        # short-lived pipelines deregister themselves). Handles kept so
+        # close() can deregister eagerly (ISSUE 12 lifecycle).
+        self._stats_srcs = [
+            register_countable(
+                "tpu_pipeline", self,
+                kind=type(self).__name__,
+                interval=f"{config.window.interval}s",
+            ),
+            register_countable(
+                "tpu_pipeline_spans", self.tracer,
+                kind=type(self).__name__,
+                interval=f"{config.window.interval}s",
+            ),
+        ]
+        # device profiling plane (ISSUE 12): the step-cost census — per
+        # bucket shape, the fused step's abstract args + compile wall
+        # time captured at first dispatch (metadata only; the expensive
+        # XLA analysis runs lazily on the profile pull). The HBM ledger
+        # registration lives on the WindowManager, which owns the
+        # planes — the pipeline's Profilable face just delegates.
+        from ..profiling.census import default_census
+
+        self._census = default_census
+        # per-INSTANCE service key: two concurrently-live pipelines of
+        # the same class/interval may have different fused-step
+        # signatures (sketch on/off), and a shared key would silently
+        # attribute one pipeline's shapes/analysis to the other
+        self._census_service = (
+            f"{type(self).__name__}:{config.window.interval}s"
+            f"#{next(_PIPELINE_SEQ)}"
         )
 
     def _build_step(self, names: tuple):
@@ -467,20 +493,34 @@ class RollupPipeline:
             st = self.wm.state
             casc = self.wm._cascade_lanes()
             snap = self.wm._snapshot_lanes()
+            args = (acc, offset, start_window, st.valid, st.dropped_overflow,
+                    shed, self.wm._fold_rows_dev, casc, snap)
             if self.wm.sk is not None:
-                return self._step(
-                    acc, offset, start_window, st.valid, st.dropped_overflow,
-                    shed, self.wm._fold_rows_dev, casc, snap, self.wm.sk,
-                    staged.tag_mat, staged.meters, staged.valid,
+                args = args + (self.wm.sk,)
+            args = args + (staged.tag_mat, staged.meters, staged.valid)
+            # census capture (ISSUE 12): first dispatch of a bucket shape
+            # records the abstract arg shapes BEFORE the step consumes
+            # its donated buffers — ShapeDtypeStructs only, no compile,
+            # no transfer, once per bucket
+            if not self._census.seen(self._census_service, "fused_step",
+                                     staged.padded_rows):
+                self._census.observe(
+                    self._census_service, "fused_step", staged.padded_rows,
+                    self._step, args,
                 )
-            return self._step(
-                acc, offset, start_window, st.valid, st.dropped_overflow,
-                shed, self.wm._fold_rows_dev, casc, snap,
-                staged.tag_mat, staged.meters, staged.valid,
-            )
+            return self._step(*args)
 
+        compiles0 = sum(self._jit.poll())
+        t0 = time.perf_counter()
         flushed = self.wm.ingest_step(dispatch, rows, ring_rows=max_rows)
-        self._jit.poll()
+        wall_s = time.perf_counter() - t0
+        if sum(self._jit.poll()) > compiles0:
+            # the monitor saw the pjit cache grow on this dispatch: the
+            # wall time above IS the bucket's compile + first-execute
+            # tax — attribute it (steady-state dispatches skip this)
+            self._census.note_compile(
+                self._census_service, "fused_step", staged.padded_rows, wall_s
+            )
         return self._convert_flushed(flushed)
 
     def drain(self) -> list[DocBatch]:
@@ -565,13 +605,52 @@ class RollupPipeline:
         out["tier_sketch_blocks_dropped"] = self.tier_sketch_blocks_dropped
         return out
 
+    # -- device profiling plane (ISSUE 12) --------------------------------
+    def device_planes(self) -> dict:
+        """Profilable face — delegates to the owning WindowManager (the
+        manager holds every device plane; it is also the one registered
+        on the HBM ledger, so the flat tpu_hbm_* lanes never
+        double-count a pipeline-wrapped manager)."""
+        return self.wm.device_planes()
+
+    def profile_snapshot(self, *, analyze: bool = False) -> dict:
+        """The per-pipeline profile record: per-plane HBM bytes + the
+        step census rows for THIS pipeline's fused step. With
+        `analyze=True` the census rows carry the XLA cost/memory
+        analysis (may compile — pull path only)."""
+        from ..profiling.ledger import plane_bytes
+
+        return {
+            "hbm_bytes": {
+                name: plane_bytes(tree)[0]
+                for name, tree in self.wm.device_planes().items()
+            },
+            "census": [
+                r for r in self._census.snapshot(analyze=analyze)
+                if r["service"] == self._census_service
+            ],
+        }
+
+    def close(self) -> None:
+        """Eager profiling/telemetry teardown (weakrefs would get there
+        eventually; close() makes it synchronous): the manager leaves
+        the HBM ledger and the pipeline's Countable rows stop."""
+        self.wm.close()
+        from ..utils.stats import default_collector
+
+        for src in self._stats_srcs:
+            default_collector.deregister(src)
+
     def telemetry(self) -> dict:
         """JSON-able snapshot for bench records: the counter-block-backed
         counters plus the per-stage span summary (BENCH files carry
-        stage attribution — PERF.md §13)."""
+        stage attribution — PERF.md §13) and, since ISSUE 12, the
+        device profile record (per-plane HBM bytes + step census, no
+        analysis — absence-tolerant consumers)."""
         return {
             "counters": self.get_counters(),
             "spans": self.tracer.summary(),
+            "profile": self.profile_snapshot(),
         }
 
     @property

@@ -734,6 +734,18 @@ class WindowManager:
         self.dispatch_retries = 0
         self.fetch_retries = 0
         self.tracer = tracer if tracer is not None else SpanTracer()
+        # device profiling plane (ISSUE 12): every device-resident plane
+        # this manager owns is enumerable via device_planes(), and the
+        # manager registers WEAKLY on the process-wide HBM ledger (the
+        # r13 tier-registry stance — GC removes it, close() eagerly so)
+        from ..profiling.ledger import register_profilable
+
+        self._ledger_src = register_profilable(
+            "window_manager", self,
+            interval=f"{config.interval}s",
+            sketch=str(config.sketch is not None),
+            cascade=str(config.cascade is not None),
+        )
         # async-drain double buffers (device handles, fetched next call)
         self._pending_stats = None
         self._pending_flush: list[tuple] = []
@@ -1374,6 +1386,49 @@ class WindowManager:
             stats, self._pending_stats = self._pending_stats, None
             self._process_stats(stats)
         return self._settle_ready()
+
+    # -- device profiling plane (ISSUE 12) --------------------------------
+    def device_planes(self) -> dict:
+        """Profilable face: every device-resident plane this manager
+        owns, by name — the HBM ledger walks these (metadata-only
+        `.nbytes`, zero fetches). The enumeration IS the ownership
+        contract: a new device buffer added to the manager without a
+        plane entry here fails the ledger reconciliation test."""
+        planes: dict[str, object] = {
+            "stash": self.state,
+            "accumulator": self.acc,  # None until the first batch
+            "stats_ring": [self._cb_ring, self._sw_state],
+            "lanes": [self._fold_rows_dev, self._zero_lanes,
+                      self._snap_lanes_dev],
+            # async-drain holds: the deferred stats vector plus every
+            # dispatched-but-unfetched flush's device handles (packed
+            # rows, sketch pending, tier flushes) — real HBM between
+            # ingest calls, up to a full packed flush block in steady
+            # async operation (_FlushEntry/TierFlush are plain
+            # dataclasses, not pytrees, so the handles list explicitly)
+            "pending_flush": [self._pending_stats] + [
+                [e.packed, e.total, e.pend, e.pend_win, e.pend_n]
+                + [[tf.packed, tf.total] for tf in e.tiers]
+                for e in self._pending_flush
+            ],
+        }
+        if self.sk is not None:
+            planes["sketch"] = self.sk
+        if self.cascade is not None:
+            planes["cascade"] = [
+                self.cascade.tiers, self.cascade.accs, self.cascade.fills,
+                self.cascade.lanes_dev,
+            ]
+        return planes
+
+    def close(self) -> None:
+        """Eager teardown of the profiling registrations (the weakref
+        would get there eventually; close() makes 'this manager's HBM
+        left the ledger' a synchronous statement, like the r13 cascade
+        tier registry)."""
+        from ..profiling.ledger import default_ledger
+
+        default_ledger.deregister(self._ledger_src)
 
     def make_feeder(self, queues, bucket_sizes, config=None, **kw):
         """Wire this manager behind a feeder runtime: METRICS pb frames

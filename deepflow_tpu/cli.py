@@ -19,6 +19,9 @@ Commands mirror the reference CLI surface that applies to this build:
                                          domain / resource mgmt seats:
                                          resources, datasources, traces,
                                          tracemap, prom, profile)
+  dfctl profile --port P device          device profiling plane: HBM
+                                         ledger + XLA step census
+                                         (--no-analyze skips compiles)
   dfctl agent-group --port P ...         trisolaris group config/upgrade
   dfctl plugin --dir D list              L7 protocol plugin inventory
   dfctl trace --port P TRACE_ID          assembled trace tree (REST)
@@ -125,6 +128,20 @@ def cmd_trace(args):
         print(json.dumps(json.loads(r.read()), indent=2))
 
 
+def cmd_profile(args):
+    """Device profiling plane (ISSUE 12): `dfctl profile device` pulls
+    the HBM ledger + step census over the controller REST surface."""
+    import urllib.request
+
+    if args.what != "device":
+        sys.exit(f"unknown profile target {args.what!r}")
+    analyze = "0" if args.no_analyze else "1"
+    with urllib.request.urlopen(
+        f"http://{args.host}:{args.port}/v1/profile/device?analyze={analyze}"
+    ) as r:
+        print(json.dumps(json.loads(r.read()), indent=2))
+
+
 def cmd_agent_group(args):
     """Trisolaris group management over the sync socket (line-JSON):
     the deepflow-ctl agent-group/agent-group-config seat."""
@@ -207,6 +224,14 @@ def main(argv=None):
     sp.add_argument("--port", type=int, required=True)
     sp.add_argument("trace_id")
     sp.set_defaults(fn=cmd_trace)
+
+    sp = sub.add_parser("profile")
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--port", type=int, required=True)
+    sp.add_argument("what", choices=["device"])
+    sp.add_argument("--no-analyze", action="store_true",
+                    help="skip the XLA cost/memory analysis (no compile)")
+    sp.set_defaults(fn=cmd_profile)
 
     sp = sub.add_parser("agent-group")
     sp.add_argument("--host", default="127.0.0.1")

@@ -19,6 +19,9 @@ the composition root:
   GET    /v1/prom/range?query=&start=&end=&step=   PromQL range
   GET    /v1/traces/<trace_id>           assembled trace tree
   GET    /v1/tracemap?start=&end=        service-edge aggregation
+  GET    /v1/profile/device              device profiling plane (ISSUE
+                                         12): HBM ledger + step census
+                                         (?analyze=0 skips XLA analysis)
   GET    /v1/profile/stacks              all live thread stacks (pprof
                                          goroutine-dump analog)
   GET    /v1/profile/cpu?seconds=N       folded stack samples (pprof
@@ -233,6 +236,21 @@ class RestServer:
                     200 if out is not None else 404)
         elif u.path == "/v1/tracemap":
             h._json(df.trace_map(time_range=_q_time_range(q), org=int(q.get("org") or 1)))
+        elif u.path == "/v1/profile/device":
+            # device profiling plane (ISSUE 12): the HBM ledger (per
+            # owner × plane bytes + watermarks, zero device fetches) and
+            # the step-cost census (per callable × bucket: flops/bytes
+            # accessed/peak memory + compile wall time). ?analyze=0
+            # skips the XLA analysis (which may compile via the AOT
+            # path on the first pull — never on the ingest path).
+            from ..profiling import default_census, default_ledger
+
+            analyze = (q.get("analyze") or "1") not in ("0", "false")
+            h._json({
+                "hbm": default_ledger.snapshot(),
+                "hbm_totals": default_ledger.get_counters(),
+                "census": default_census.snapshot(analyze=analyze),
+            })
         elif u.path == "/v1/profile/stacks":
             h._json(_thread_stacks())
         elif u.path == "/v1/profile/cpu":

@@ -206,6 +206,23 @@ class PipelineFeedSink(_FlowFrameCodec):
         self._held = None  # (StagedBatch, shed, rows) awaiting dispatch
         self._shed_carry = 0  # shed count whose batch had no valid rows
         self.lost_records = 0  # rows lost to failed dispatches
+        # device profiling plane (ISSUE 12): the double-buffered staged
+        # upload (tag matrix + meters + valid, device handles awaiting
+        # dispatch) is HBM this sink owns — weakly registered so the
+        # ledger's tpu_hbm_staged_bytes lane shows the feeder's upload
+        # footprint next to the manager's planes
+        from ..profiling.ledger import register_profilable
+
+        self._ledger_src = register_profilable("feeder_sink", self)
+
+    def device_planes(self) -> dict:
+        held = self._held
+        staged = held[0] if held is not None else None
+        return {
+            "staged": None if staged is None else [
+                staged.tag_mat, staged.meters, staged.valid
+            ],
+        }
 
     def emit(self, chunks: list[FlowChunk], rows: int, bucket: int, shed: int) -> list:
         fb = FlowBatch.concat([c.fb for c in chunks])

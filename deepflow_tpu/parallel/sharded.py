@@ -760,12 +760,24 @@ class ShardedWindowManager:
         self.tracer = tracer if tracer is not None else SpanTracer(
             service="deepflow_tpu.sharded_pipeline"
         )
-        register_countable(
-            "tpu_sharded_pipeline", self, devices=str(pipe.n_devices)
-        )
-        register_countable(
-            "tpu_sharded_pipeline_spans", self.tracer,
-            devices=str(pipe.n_devices),
+        self._stats_srcs = [
+            register_countable(
+                "tpu_sharded_pipeline", self, devices=str(pipe.n_devices)
+            ),
+            register_countable(
+                "tpu_sharded_pipeline_spans", self.tracer,
+                devices=str(pipe.n_devices),
+            ),
+        ]
+        # device profiling plane (ISSUE 12): weakly registered on the
+        # process-wide HBM ledger with the device count, so the ledger
+        # reports bytes/device next to the [D]-leading totals
+        from ..profiling.ledger import register_profilable
+
+        self._ledger_src = register_profilable(
+            "sharded_window_manager", self, devices=pipe.n_devices,
+            interval=f"{self.interval}s",
+            cascade=str(bool(self._cascade_intervals)),
         )
 
     def _fetch(self, x) -> np.ndarray:
@@ -839,8 +851,51 @@ class ShardedWindowManager:
         return out
 
     def telemetry(self) -> dict:
-        """JSON-able counters + span summary (bench snapshot shape)."""
-        return {"counters": self.get_counters(), "spans": self.tracer.summary()}
+        """JSON-able counters + span summary (bench snapshot shape) +
+        the per-plane HBM byte record (ISSUE 12)."""
+        from ..profiling.ledger import plane_bytes
+
+        return {
+            "counters": self.get_counters(),
+            "spans": self.tracer.summary(),
+            "profile": {
+                "hbm_bytes": {
+                    name: plane_bytes(tree)[0]
+                    for name, tree in self.device_planes().items()
+                },
+                "devices": self.pipe.n_devices,
+            },
+        }
+
+    # -- device profiling plane (ISSUE 12) --------------------------------
+    def device_planes(self) -> dict:
+        """Profilable face — every [D]-leading device plane this manager
+        owns (the sharded twin of WindowManager.device_planes; same
+        enumeration-is-ownership contract, pinned by the sharded
+        reconciliation test)."""
+        planes: dict[str, object] = {
+            "stash": self.stash,
+            "accumulator": self.acc,  # None until the first batch
+            "sketch": self.sketches,
+            "lanes": [self._fold_rows_dev],
+        }
+        if self._tier_ratios:
+            planes["cascade"] = [
+                self.tier_stashes, self.tier_accs, self.tier_fills,
+                self.cascade_lanes,
+            ]
+        return planes
+
+    def close(self) -> None:
+        """Eager profiling/telemetry teardown — the manager leaves the
+        HBM ledger and its Countable rows stop (weakrefs remain the
+        backstop for callers that just drop the reference)."""
+        from ..profiling.ledger import default_ledger
+        from ..utils.stats import default_collector
+
+        default_ledger.deregister(self._ledger_src)
+        for src in self._stats_srcs:
+            default_collector.deregister(src)
 
     def _fold(self):
         """Full-set fold (kernel per pipe.config.fold_mode): the ring

@@ -1,0 +1,48 @@
+"""Device profiling plane (ISSUE 12) — always-on, zero new device
+fetches. Three layers:
+
+  * `ledger` — DeviceMemoryLedger: per-plane HBM byte accounting over
+    weakly-registered Profilables (`tpu_hbm_*` in deepflow_system);
+  * `census` — StepCostCensus: per jitted-callable × bucket-shape XLA
+    cost/memory analysis + compile wall time (`/v1/profile/device`);
+  * span latency distributions live in `utils/spans` (per-stage
+    log-histograms → p50/p95/p99 lanes), not here — the tracer predates
+    this package and every host component already carries one.
+"""
+
+from .census import StepCostCensus, default_census
+from .ledger import (
+    PLANE_ACCUMULATOR,
+    PLANE_CASCADE,
+    PLANE_CHECKPOINT,
+    PLANE_LANES,
+    PLANE_SKETCH,
+    PLANE_STAGED,
+    PLANE_STASH,
+    PLANE_STATS_RING,
+    DeviceMemoryLedger,
+    Profilable,
+    default_ledger,
+    plane_bytes,
+    profile_tick_sink,
+    register_profilable,
+)
+
+__all__ = [
+    "DeviceMemoryLedger",
+    "Profilable",
+    "StepCostCensus",
+    "default_census",
+    "default_ledger",
+    "plane_bytes",
+    "profile_tick_sink",
+    "register_profilable",
+    "PLANE_STASH",
+    "PLANE_ACCUMULATOR",
+    "PLANE_STATS_RING",
+    "PLANE_SKETCH",
+    "PLANE_CASCADE",
+    "PLANE_LANES",
+    "PLANE_STAGED",
+    "PLANE_CHECKPOINT",
+]

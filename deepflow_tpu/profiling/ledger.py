@@ -1,0 +1,244 @@
+"""Device memory ledger — per-plane HBM accounting for every
+device-resident buffer the pipeline owns (ISSUE 12, layer 1).
+
+The reference's fourth pillar (continuous profiling) covers the host;
+the device — where every hot-path byte lives — was unobserved. The
+ROADMAP's disaggregated-sketch-memory item ("cardinality density per
+HBM byte") cannot even be scoped without knowing how many bytes each
+plane holds per chip. This module is that ledger:
+
+  * **Profilable** — a registration protocol: a component exposes
+    `device_planes() -> {plane_name: pytree-of-device-arrays}`. The
+    window managers, pipelines and the feeder sink implement it,
+    enumerating every plane they own: stash, accumulator ring, counter
+    ring + gate state, per-tier sketch slabs, cascade tier stashes/
+    rings, staged upload buffers, CB lane vectors.
+  * **DeviceMemoryLedger** — holds Profilables WEAKLY (the r13
+    cascade-tier-registry stance: a torn-down pipeline leaves the
+    ledger; `close()` deregisters eagerly) and snapshots per-plane
+    bytes + high watermarks on demand. ZERO device fetches: `.nbytes`
+    on a jax Array is shape×dtype metadata — no transfer, so the
+    ledger is safe to sample from a ticking collector thread and from
+    the REST pull path.
+  * **Countable face** — the default ledger registers on the default
+    StatsCollector as module `tpu_hbm`, so `tpu_hbm_sketch_bytes`,
+    `tpu_hbm_stash_bytes`, … dogfood into `deepflow_system` and answer
+    via SQL AND PromQL like every other lane (the acceptance pin).
+
+Reconciliation contract (tests/test_profiling.py): Σ per-plane ledger
+bytes == the summed `.nbytes` of exactly the pipeline-owned device
+arrays, each of which is present in `jax.live_arrays()` — the ledger
+never invents or misses an owned buffer, single-chip AND sharded, with
+the sketch plane and cascade enabled.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Mapping, Protocol, runtime_checkable
+
+#: canonical plane vocabulary (components may add ad-hoc names; docs
+#: and the reconciliation test pin this set)
+PLANE_STASH = "stash"
+PLANE_ACCUMULATOR = "accumulator"
+PLANE_STATS_RING = "stats_ring"
+PLANE_SKETCH = "sketch"
+PLANE_CASCADE = "cascade"
+PLANE_LANES = "lanes"  # small CB lane vectors (fold_rows, casc, snap)
+PLANE_STAGED = "staged"  # feeder double-buffer upload (StagedBatch)
+PLANE_CHECKPOINT = "checkpoint_scratch"  # transient pack buffers (HWM only)
+
+
+@runtime_checkable
+class Profilable(Protocol):
+    def device_planes(self) -> Mapping[str, object]: ...
+
+
+def _leaf_arrays(tree) -> list:
+    """Flatten a pytree-ish value into its device-array leaves without
+    importing jax at module import time. Accepts arrays, None, lists/
+    tuples/dicts, and registered dataclass pytrees (StashState &co)."""
+    import jax
+
+    return [
+        leaf
+        for leaf in jax.tree_util.tree_leaves(tree)
+        if hasattr(leaf, "nbytes") and hasattr(leaf, "dtype")
+    ]
+
+
+def plane_bytes(tree) -> tuple[int, int]:
+    """(bytes, array_count) for one plane — metadata only, no transfer.
+    Leaves are deduplicated by identity so a buffer shared between two
+    entries of the same plane never double-counts."""
+    seen: dict[int, int] = {}
+    for leaf in _leaf_arrays(tree):
+        seen[id(leaf)] = int(leaf.nbytes)
+    return sum(seen.values()), len(seen)
+
+
+class _Source:
+    __slots__ = ("module", "tags", "devices", "_ref")
+
+    def __init__(self, module: str, tags: dict, devices: int, profilable):
+        self.module = module
+        self.tags = tuple(sorted(tags.items()))
+        self.devices = max(1, int(devices))
+        self._ref = weakref.ref(profilable)
+
+    def owner(self):
+        return self._ref()
+
+
+class DeviceMemoryLedger:
+    """Weakly-held Profilable registry + per-plane byte accounting."""
+
+    def __init__(self, name: str = "hbm"):
+        self.name = name
+        self._sources: list[_Source] = []
+        self._lock = threading.Lock()
+        # (module, tags, plane) -> high watermark bytes, surviving the
+        # owner (a restarted pipeline's peak stays visible until reset)
+        self._hwm: dict[tuple, int] = {}
+        # transient planes (checkpoint pack scratch): bytes=0 steady,
+        # only the watermark is meaningful
+        self._transient_hwm: dict[str, int] = {}
+        self.seq = 0  # bumped per snapshot/sample — ProfileSnapshot clock
+        self.snapshots = 0
+
+    # -- registry -------------------------------------------------------
+    def register(self, module: str, profilable: Profilable, *,
+                 devices: int = 1, **tags: str) -> _Source:
+        src = _Source(module, tags, devices, profilable)
+        with self._lock:
+            self._sources = [s for s in self._sources if s.owner() is not None]
+            self._sources.append(src)
+        return src
+
+    def deregister(self, src: _Source) -> None:
+        with self._lock:
+            if src in self._sources:
+                self._sources.remove(src)
+
+    def note_transient(self, plane: str, nbytes: int) -> None:
+        """Record a short-lived scratch allocation (checkpoint pack
+        buffers) — steady-state bytes stay 0, the watermark shows the
+        peak the plane ever needed."""
+        with self._lock:
+            if nbytes > self._transient_hwm.get(plane, 0):
+                self._transient_hwm[plane] = int(nbytes)
+
+    # -- read faces -----------------------------------------------------
+    def snapshot(self) -> list[dict]:
+        """One row per (owner, plane): bytes, bytes/device, arrays,
+        high watermark. Walks live owners only (dead weakrefs pruned);
+        zero device fetches."""
+        with self._lock:
+            sources = list(self._sources)
+        rows: list[dict] = []
+        dead: list[_Source] = []
+        for src in sources:
+            owner = src.owner()
+            if owner is None:
+                dead.append(src)
+                continue
+            try:
+                planes = owner.device_planes()
+            except Exception:  # a torn-down owner must not kill the walk
+                continue
+            for plane, tree in sorted(planes.items()):
+                nbytes, n_arrays = plane_bytes(tree)
+                key = (src.module, src.tags, plane)
+                with self._lock:
+                    hwm = self._hwm[key] = max(self._hwm.get(key, 0), nbytes)
+                rows.append({
+                    "module": src.module,
+                    "tags": dict(src.tags),
+                    "plane": plane,
+                    "bytes": nbytes,
+                    "bytes_per_device": nbytes // src.devices,
+                    "devices": src.devices,
+                    "arrays": n_arrays,
+                    "bytes_hwm": hwm,
+                })
+        with self._lock:
+            if dead:
+                self._sources = [s for s in self._sources if s not in dead]
+            for plane, hwm in sorted(self._transient_hwm.items()):
+                rows.append({
+                    "module": "transient", "tags": {}, "plane": plane,
+                    "bytes": 0, "bytes_per_device": 0, "devices": 1,
+                    "arrays": 0, "bytes_hwm": hwm,
+                })
+            self.seq += 1
+            self.snapshots += 1
+        return rows
+
+    def get_counters(self) -> dict[str, int]:
+        """Countable face: per-plane byte totals summed across owners —
+        `sketch_bytes` under module `tpu_hbm` becomes the
+        `tpu_hbm_sketch_bytes` metric in deepflow_system (SQL + PromQL,
+        the acceptance pin). Fetch-free like every Countable."""
+        rows = self.snapshot()
+        out: dict[str, int] = {}
+        total = 0
+        for r in rows:
+            out[f"{r['plane']}_bytes"] = (
+                out.get(f"{r['plane']}_bytes", 0) + r["bytes"]
+            )
+            hk = f"{r['plane']}_bytes_hwm"
+            out[hk] = max(out.get(hk, 0), r["bytes_hwm"])
+            total += r["bytes"]
+        out["total_bytes"] = total
+        out["planes"] = len({r["plane"] for r in rows})
+        out["sources"] = len(self._sources)
+        out["snapshots"] = self.snapshots
+        return out
+
+
+#: process-wide default ledger, mirroring utils/stats.default_collector;
+#: registered there as module `tpu_hbm` so the dogfood loop closes with
+#: no further wiring (an empty ledger emits no fields → no rows)
+default_ledger = DeviceMemoryLedger()
+
+from ..utils.stats import register_countable  # noqa: E402
+
+register_countable("tpu_hbm", default_ledger)
+
+
+def register_profilable(module: str, profilable: Profilable, *,
+                        devices: int = 1, ledger: DeviceMemoryLedger | None = None,
+                        **tags: str) -> _Source:
+    """Register a component's device planes on the (default) ledger —
+    the RegisterCountable twin for HBM accounting."""
+    led = default_ledger if ledger is None else ledger
+    return led.register(module, profilable, devices=devices, **tags)
+
+
+def profile_tick_sink(bus, *, ledger: DeviceMemoryLedger | None = None,
+                      db: str = "deepflow_system",
+                      table: str = "deepflow_system"):
+    """→ a StatsCollector sink publishing a `ProfileSnapshot` event on
+    `bus` at each collector tick (ISSUE 12): the moment profiling rows
+    land in deepflow_system, standing queries / span-latency alert
+    rules over it re-evaluate — the push plane observing the profiler
+    observing the pipeline. Sink-only (never fires on pull-path
+    `sample()` reads, so dashboard pulls don't publish)."""
+    led = default_ledger if ledger is None else ledger
+
+    def sink(points) -> None:
+        if not points or bus is None:
+            return
+        from ..querier.events import ProfileSnapshot
+
+        with led._lock:
+            led.seq += 1
+            seq = led.seq
+        # the event clock is the tick's own sample timestamp — the time
+        # column the rows landed under — so rule evaluations run at
+        # data time (deterministic under replay), never the wall
+        t = max(int(p.timestamp) for p in points)
+        bus.publish(ProfileSnapshot(db, table, seq, t))
+
+    return sink

@@ -9,7 +9,10 @@ websocket.
 
 Rule = query (PromQL instant — including `topk()` / distinct /
 quantile queries the sketch plane answers — or SQL) + comparator +
-threshold + `for`-duration. States:
+threshold + `for`-duration. State is kept PER SERIES (label set) since
+ISSUE 12 — Prometheus semantics: one service's latency series can fire
+while its siblings stay inactive; the rule-level faces report the
+worst series. States:
 
     inactive ──breach──▶ pending ──held for ≥ for_s──▶ firing
        ▲                    │                            │
@@ -82,11 +85,15 @@ _NAME_SAN_RE = re.compile(r"[^a-zA-Z0-9_]")
 @dataclasses.dataclass(frozen=True)
 class AlertRule:
     """One rule spec. `engine` picks evaluation: "promql" runs
-    `query_instant` at the event time over (db, table) and compares the
-    MAX series value (so `topk(k, m)`-shaped heavy-hitter rules compare
-    the biggest recovered flow); "sql" executes the statement and
-    compares the first numeric cell of the first row. No data → no
-    breach (a silent series resolves rather than pages)."""
+    `query_instant` at the event time over (db, table) and compares
+    EVERY returned series against the threshold — state is kept PER
+    LABEL SET (Prometheus semantics, ISSUE 12 satellite: one series of
+    a rule can fire while its siblings stay inactive); "sql" executes
+    the statement and compares the first numeric cell of the first row
+    (one anonymous series). A series with no data this evaluation is no
+    breach (a silent series resolves rather than pages), and an
+    inactive series that stops reporting leaves the state map — label
+    churn cannot grow it unboundedly (plus a hard cap, counted)."""
 
     name: str
     query: str
@@ -108,22 +115,78 @@ class AlertRule:
             raise ValueError("for_s must be >= 0")
 
 
-class _RuleState:
-    __slots__ = ("state", "pending_since", "fired_before", "last_value",
-                 "last_eval", "last_transition", "transitions", "evals",
-                 "eval_errors", "last_partial")
+#: worst-state ordering for the rule-level rollup faces (state(),
+#: list_rules, the dogfood state-code lane)
+_SEVERITY = {STATE_INACTIVE: 0, STATE_RESOLVED: 1, STATE_PENDING: 2,
+             STATE_FIRING: 3}
 
-    def __init__(self):
+
+class _SeriesState:
+    """One label set's state machine (Prometheus keys alert state by
+    series, not by rule)."""
+
+    __slots__ = ("labels", "state", "pending_since", "fired_before",
+                 "last_value", "last_transition", "transitions",
+                 "last_partial", "last_seen")
+
+    def __init__(self, labels: dict | None = None):
+        self.labels = dict(labels or {})
         self.state = STATE_INACTIVE
         self.pending_since: int | None = None
         self.fired_before = False
         self.last_value: float | None = None
-        self.last_eval = 0
         self.last_transition = 0
         self.transitions = 0
+        self.last_partial = False
+        self.last_seen = 0  # event time of the last eval WITH data
+
+
+class _RuleState:
+    """Per-rule bookkeeping: the series map + rule-level eval counters.
+    Bounded: beyond MAX_SERIES new label sets are counted-dropped (the
+    held-buffer stance everywhere else in the tree); inactive series
+    that stop reporting are garbage-collected each evaluation."""
+
+    MAX_SERIES = 512
+
+    __slots__ = ("series", "last_eval", "evals", "eval_errors",
+                 "last_partial", "series_dropped", "_transitions_base",
+                 "_last_transition_base")
+
+    def __init__(self):
+        self.series: dict[tuple, _SeriesState] = {}
+        self.last_eval = 0
         self.evals = 0
         self.eval_errors = 0
         self.last_partial = False
+        self.series_dropped = 0
+        self._transitions_base = 0  # transitions of GC'd series
+        self._last_transition_base = 0  # newest transition of GC'd series
+
+    def worst(self) -> _SeriesState | None:
+        """The most severe series (ties: larger value) — the rule-level
+        rollup the single-state faces report."""
+        best = None
+        for ss in self.series.values():
+            if best is None:
+                best = ss
+                continue
+            key = (_SEVERITY[ss.state], ss.last_value or 0.0)
+            bkey = (_SEVERITY[best.state], best.last_value or 0.0)
+            if key > bkey:
+                best = ss
+        return best
+
+    @property
+    def state(self) -> str:
+        w = self.worst()
+        return STATE_INACTIVE if w is None else w.state
+
+    @property
+    def transitions(self) -> int:
+        return self._transitions_base + sum(
+            ss.transitions for ss in self.series.values()
+        )
 
 
 def log_notification_sink(event: dict) -> None:
@@ -178,6 +241,12 @@ class AlertEngine:
     """Rules over one store, evaluated on push events (and `tick`)."""
 
     MAX_SINK_FAILURES = 4
+    # a RESOLVED series that stops reporting is kept this many seconds
+    # (event time) for flap-memory/visibility, then GC'd like an
+    # inactive one — without this, churned series that once fired
+    # (per-pod incident labels) occupy MAX_SERIES slots forever and
+    # eventually block NEW series from ever alerting
+    RESOLVED_RETENTION_S = 900
 
     def __init__(self, store, *, live=None, cache=None,
                  bus: QueryEventBus | None = None,
@@ -278,7 +347,11 @@ class AlertEngine:
         with self._lock:
             rules = list(self._rules.values())
         for rule, st in rules:
-            if all_rules or st.state in (STATE_PENDING, STATE_FIRING):
+            # st.state iterates the series map — _eval_lock, like every
+            # other series read (the bus thread mutates it mid-eval)
+            with self._eval_lock:
+                wanted = all_rules or st.state in (STATE_PENDING, STATE_FIRING)
+            if wanted:
                 self._evaluate(rule, st, now)
 
     def evaluate_rule(self, name: str, *, now: int | None = None):
@@ -286,9 +359,14 @@ class AlertEngine:
             rule, st = self._rules[name]
         return self._evaluate(rule, st, now)
 
-    def _query_value(self, rule: AlertRule, now: int) -> tuple[float | None, bool]:
-        """→ (value, partial): the scalar the comparator sees, and
-        whether a live open-window partial produced it."""
+    def _query_series(
+        self, rule: AlertRule, now: int
+    ) -> list[tuple[tuple, dict, float, bool]]:
+        """→ [(series_key, labels, value, partial)] — one entry per
+        returned series (Prometheus alert semantics: every label set
+        gets its own state machine). SQL rules produce one anonymous
+        series from the first numeric cell; no rows → empty list (no
+        data → no breach for every known series)."""
         if rule.engine == "promql":
             from .promql import query_instant
 
@@ -296,35 +374,37 @@ class AlertEngine:
                 self.store, rule.query, int(now), lookback_s=rule.lookback_s,
                 db=rule.db, table=rule.table, live=self.live,
             )
-            if not rows:
-                return None, False
-            best = max(rows, key=lambda r: r["value"])
-            return float(best["value"]), any(r.get("partial") for r in rows)
+            return [
+                (tuple(sorted(r["labels"].items())), r["labels"],
+                 float(r["value"]), bool(r.get("partial")))
+                for r in rows
+            ]
         from .engine import QueryEngine
 
         engine = QueryEngine(self.store, live=self.live, cache=False)
         res = engine.execute(rule.query)
         if not res.rows:
-            return None, False
+            return []
         for c in res.columns:
             try:
-                return float(res.values[c][0]), res.partial
+                return [((), {}, float(res.values[c][0]), bool(res.partial))]
             except (TypeError, ValueError):
                 continue
-        return None, res.partial
+        return []
 
     def _evaluate(self, rule: AlertRule, st: _RuleState, now: int | None):
         # now=None (an event batch with no data-timed event, e.g. pure
-        # SnapshotAdvanced): re-evaluate at the rule's LAST data time —
-        # under replay the wall clock is far from the data and would
-        # silently resolve a firing rule over an empty range
+        # SnapshotAdvanced/ProfileSnapshot): re-evaluate at the rule's
+        # LAST data time — under replay the wall clock is far from the
+        # data and would silently resolve a firing rule over an empty
+        # range
         with self._eval_lock:
             if now is None:
                 now = st.last_eval or int(time.time())
             now = int(now)
             try:
                 with self.tracer.span(SPAN_ALERT_EVAL):
-                    value, partial = self._query_value(rule, now)
+                    series = self._query_series(rule, now)
             except Exception:
                 st.eval_errors += 1
                 with self._lock:
@@ -332,55 +412,89 @@ class AlertEngine:
                 return st.state
             st.evals += 1
             st.last_eval = now
-            st.last_value = value
-            st.last_partial = partial
+            st.last_partial = any(p for *_, p in series)
             with self._lock:
                 self.counters["evals"] += 1
-            breach = value is not None and _COMPARATORS[rule.comparator](
-                value, rule.threshold
-            )
-            return self._transition(rule, st, breach, now)
+            seen: set[tuple] = set()
+            for key, labels, value, partial in series:
+                ss = st.series.get(key)
+                if ss is None:
+                    if len(st.series) >= st.MAX_SERIES:
+                        st.series_dropped += 1
+                        continue
+                    ss = st.series[key] = _SeriesState(labels)
+                seen.add(key)
+                ss.last_value = value
+                ss.last_partial = partial
+                ss.last_seen = now
+                breach = _COMPARATORS[rule.comparator](value, rule.threshold)
+                self._transition(rule, ss, breach, now)
+            # series with no data this evaluation: no breach (a silent
+            # series resolves rather than pages) — then GC so label
+            # churn cannot poison the bounded map: inactive ones leave
+            # immediately, RESOLVED ones after RESOLVED_RETENTION_S of
+            # silence (they hold only flap memory by then — left
+            # forever, 512 churned once-fired series would permanently
+            # block every NEW label set from alerting)
+            for key, ss in list(st.series.items()):
+                if key in seen:
+                    continue
+                ss.last_value = None
+                self._transition(rule, ss, False, now)
+                if ss.state == STATE_INACTIVE or (
+                    ss.state == STATE_RESOLVED
+                    and now - max(ss.last_seen, ss.last_transition)
+                    >= self.RESOLVED_RETENTION_S
+                ):
+                    st._transitions_base += ss.transitions
+                    st._last_transition_base = max(
+                        st._last_transition_base, ss.last_transition
+                    )
+                    del st.series[key]
+            return st.state
 
-    def _transition(self, rule: AlertRule, st: _RuleState, breach: bool,
+    def _transition(self, rule: AlertRule, ss: _SeriesState, breach: bool,
                     now: int) -> str:
-        old = st.state
+        old = ss.state
         if breach:
-            if st.state in (STATE_INACTIVE, STATE_RESOLVED):
-                st.state = STATE_PENDING
-                st.pending_since = now
-            if st.state == STATE_PENDING and now - st.pending_since >= rule.for_s:
-                st.state = STATE_FIRING
+            if ss.state in (STATE_INACTIVE, STATE_RESOLVED):
+                ss.state = STATE_PENDING
+                ss.pending_since = now
+            if ss.state == STATE_PENDING and now - ss.pending_since >= rule.for_s:
+                ss.state = STATE_FIRING
         else:
-            if st.state == STATE_PENDING:
+            if ss.state == STATE_PENDING:
                 # never matured: fall back quietly, no notification
-                st.state = STATE_RESOLVED if st.fired_before else STATE_INACTIVE
-                st.pending_since = None
-            elif st.state == STATE_FIRING:
-                st.state = STATE_RESOLVED
-                st.pending_since = None
-        if st.state != old:
-            st.transitions += 1
-            st.last_transition = now
+                ss.state = STATE_RESOLVED if ss.fired_before else STATE_INACTIVE
+                ss.pending_since = None
+            elif ss.state == STATE_FIRING:
+                ss.state = STATE_RESOLVED
+                ss.pending_since = None
+        if ss.state != old:
+            ss.transitions += 1
+            ss.last_transition = now
             with self._lock:
                 self.counters["transitions"] += 1
-            if st.state == STATE_FIRING:
-                st.fired_before = True
-                self._notify(rule, st, STATE_FIRING, now)
-            elif st.state == STATE_RESOLVED and old == STATE_FIRING:
-                self._notify(rule, st, STATE_RESOLVED, now)
-        return st.state
+            if ss.state == STATE_FIRING:
+                ss.fired_before = True
+                self._notify(rule, ss, STATE_FIRING, now)
+            elif ss.state == STATE_RESOLVED and old == STATE_FIRING:
+                self._notify(rule, ss, STATE_RESOLVED, now)
+        return ss.state
 
-    def _notify(self, rule: AlertRule, st: _RuleState, state: str, now: int):
+    def _notify(self, rule: AlertRule, ss: _SeriesState, state: str, now: int):
         event = {
             "rule": rule.name,
             "state": state,
-            "value": st.last_value,
+            "value": ss.last_value,
             "comparator": rule.comparator,
             "threshold": rule.threshold,
             "time": now,
-            "held_s": (now - st.pending_since) if st.pending_since else 0,
-            "partial": st.last_partial,
-            "labels": dict(rule.labels),
+            "held_s": (now - ss.pending_since) if ss.pending_since else 0,
+            "partial": ss.last_partial,
+            # rule labels + the firing series' own label set — a pager
+            # line names WHICH series fired, not just which rule
+            "labels": {**dict(rule.labels), **ss.labels},
         }
         with self._lock:
             sinks = [s for s in self._sinks if not s.detached]
@@ -407,39 +521,102 @@ class AlertEngine:
                 s.failures = 0
 
     # -- read faces ------------------------------------------------------
+    # Series maps are mutated by _evaluate under _eval_lock (inserts on
+    # new label sets, deletes on GC); every reader that ITERATES one —
+    # state()'s worst() rollup, list_rules, series_states, the
+    # Countable face a ticking collector thread samples — must hold
+    # _eval_lock too, or a concurrent evaluation turns the read into
+    # "dictionary changed size during iteration". Lock order: _lock is
+    # only ever taken INSIDE _eval_lock (never the reverse), so the
+    # readers take _lock first standalone, release it, then _eval_lock.
+
     def state(self, name: str) -> str:
         with self._lock:
-            return self._rules[name][1].state
+            st = self._rules[name][1]
+        with self._eval_lock:
+            return st.state
 
-    def list_rules(self) -> list[dict]:
-        """The dfctl listing: one row per rule with its live state."""
+    def series_states(self, name: str) -> list[dict]:
+        """Per-series detail for one rule (the Prometheus /api/v1/rules
+        alerts shape): one row per tracked label set."""
         with self._lock:
-            rules = list(self._rules.values())
+            _, st = self._rules[name]
+        with self._eval_lock:
+            series = list(st.series.values())
         return [
             {
+                "labels": dict(ss.labels),
+                "state": ss.state,
+                "value": ss.last_value,
+                "partial": ss.last_partial,
+                "transitions": ss.transitions,
+                "last_transition": ss.last_transition,
+            }
+            for ss in series
+        ]
+
+    def list_rules(self) -> list[dict]:
+        """The dfctl listing: one row per rule — the worst series'
+        state/value as the rule-level rollup, per-series detail in
+        `series`."""
+        with self._lock:
+            rules = list(self._rules.values())
+        out = []
+        for r, st in rules:
+            with self._eval_lock:
+                out.append(self._rule_row(r, st))
+        return out
+
+    def _rule_row(self, r: AlertRule, st: _RuleState) -> dict:
+        worst = st.worst()
+        return {
                 "name": r.name,
                 "query": r.query,
                 "condition": f"{r.comparator} {r.threshold}",
                 "for_s": r.for_s,
                 "state": st.state,
-                "value": st.last_value,
+                "value": None if worst is None else worst.last_value,
                 "partial": st.last_partial,
                 "evals": st.evals,
                 "transitions": st.transitions,
-                "last_transition": st.last_transition,
+                # GC'd series fold their newest transition into the
+                # base so the rule-level stamp never regresses to 0
+                # while transitions stays > 0
+                "last_transition": max(
+                    max((ss.last_transition for ss in st.series.values()),
+                        default=0),
+                    st._last_transition_base,
+                ),
+                "series": [
+                    {
+                        "labels": dict(ss.labels),
+                        "state": ss.state,
+                        "value": ss.last_value,
+                        "transitions": ss.transitions,
+                    }
+                    for ss in st.series.values()
+                ],
             }
-            for r, st in rules
-        ]
 
     def get_counters(self) -> dict:
         with self._lock:
             out = dict(self.counters)
             rules = list(self._rules.values())
         out["rules"] = len(rules)
-        out["firing"] = sum(st.state == STATE_FIRING for _, st in rules)
-        out["pending"] = sum(st.state == STATE_PENDING for _, st in rules)
-        for r, st in rules:
-            slug = _NAME_SAN_RE.sub("_", r.name)
-            out[f"rule_{slug}_state_code"] = STATE_CODES[st.state]
-            out[f"rule_{slug}_transitions"] = st.transitions
+        with self._eval_lock:
+            # rule-level rollups (a rule counts as firing when ANY of
+            # its series fires) + the total tracked-series accounting —
+            # all series-map iteration, hence under the eval lock (the
+            # collector tick thread samples this mid-evaluation)
+            out["firing"] = sum(st.state == STATE_FIRING for _, st in rules)
+            out["pending"] = sum(st.state == STATE_PENDING for _, st in rules)
+            out["series"] = sum(len(st.series) for _, st in rules)
+            out["series_dropped"] = sum(st.series_dropped for _, st in rules)
+            for r, st in rules:
+                slug = _NAME_SAN_RE.sub("_", r.name)
+                out[f"rule_{slug}_state_code"] = STATE_CODES[st.state]
+                out[f"rule_{slug}_transitions"] = st.transitions
+                out[f"rule_{slug}_firing_series"] = sum(
+                    ss.state == STATE_FIRING for ss in st.series.values()
+                )
         return out

@@ -104,7 +104,24 @@ class StoreMutation:
     epoch: int
 
 
-QUERY_EVENT_TYPES = (WindowClosed, TierClosed, SnapshotAdvanced, StoreMutation)
+@dataclasses.dataclass(frozen=True)
+class ProfileSnapshot:
+    """A profiling sample tick landed (ISSUE 12): the device memory
+    ledger / span-quantile rows for (db, table) moved — span-latency
+    alert rules and standing profile dashboards re-evaluate. `time` is
+    the tick's sample timestamp (the rows' own time column), so
+    evaluations run at DATA time like every other event; None falls
+    back to the consumer's last data time, like SnapshotAdvanced."""
+
+    db: str
+    table: str
+    seq: int
+    time: int | None = None
+
+
+QUERY_EVENT_TYPES = (
+    WindowClosed, TierClosed, SnapshotAdvanced, StoreMutation, ProfileSnapshot
+)
 
 
 def event_time(ev) -> int | None:

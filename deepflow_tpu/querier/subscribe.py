@@ -55,14 +55,26 @@ DEFAULT_WATCHER_QUEUE = 64
 class Watcher:
     """One consumer of a subscription's evaluations: callback mode
     (`callback(result, subscription)`) or queue mode (bounded deque,
-    client drains with `poll()`)."""
+    client drains with `poll()`).
+
+    Lease (ISSUE 12 satellite): with `lease_s` set the watcher must
+    renew within that many seconds — `poll()` renews implicitly (an
+    actively-draining queue dashboard never expires), a SUCCESSFUL
+    callback delivery renews too (callback mode has no poll; accepting
+    the delivery is its heartbeat), and `renew()` renews explicitly
+    (the wire layer calls it per client heartbeat). A watcher that
+    misses its lease is REAPED by the manager (counted,
+    `watchers_reaped`): an abandoned dashboard client stops holding a
+    bounded queue — and its share of fan-out work — forever. lease_s
+    None (default) never expires, today's behavior."""
 
     MAX_WATCHER_FAILURES = 4
 
     __slots__ = ("callback", "queue", "delivered", "dropped", "errors",
-                 "_failstreak", "detached")
+                 "_failstreak", "detached", "lease_s", "last_renew")
 
-    def __init__(self, callback=None, *, maxlen: int = DEFAULT_WATCHER_QUEUE):
+    def __init__(self, callback=None, *, maxlen: int = DEFAULT_WATCHER_QUEUE,
+                 lease_s: float | None = None):
         self.callback = callback
         self.queue: deque | None = None if callback is not None else deque(
             maxlen=max(1, maxlen)
@@ -72,6 +84,18 @@ class Watcher:
         self.errors = 0
         self._failstreak = 0
         self.detached = False
+        self.lease_s = lease_s
+        self.last_renew = time.monotonic()
+
+    def renew(self) -> None:
+        """Refresh the lease (client liveness heartbeat)."""
+        self.last_renew = time.monotonic()
+
+    def expired(self, now_monotonic: float | None = None) -> bool:
+        if self.lease_s is None:
+            return False
+        now = time.monotonic() if now_monotonic is None else now_monotonic
+        return now - self.last_renew > self.lease_s
 
     def deliver(self, result, sub) -> bool:
         if self.callback is not None:
@@ -85,6 +109,12 @@ class Watcher:
                 return False
             self._failstreak = 0
             self.delivered += 1
+            # a callback that keeps ACCEPTING deliveries is alive — it
+            # has no poll() to renew through, so successful delivery IS
+            # its heartbeat (queue mode must NOT renew here: the queue
+            # fills whether or not anyone drains it — only poll() proves
+            # a queue client exists)
+            self.renew()
             return True
         if len(self.queue) == self.queue.maxlen:
             self.dropped += 1  # deque drops the OLDEST on append
@@ -93,7 +123,10 @@ class Watcher:
         return True
 
     def poll(self):
-        """Queue mode: pop the oldest pending result (None = empty)."""
+        """Queue mode: pop the oldest pending result (None = empty).
+        Polling renews the lease — an actively-draining client is by
+        definition alive."""
+        self.renew()
         if self.queue is None or not self.queue:
             return None
         return self.queue.popleft()
@@ -120,8 +153,9 @@ class Subscription:
         self.last_now = 0
         self.last_result = None
 
-    def watch(self, callback=None, *, maxlen: int = DEFAULT_WATCHER_QUEUE) -> Watcher:
-        w = Watcher(callback, maxlen=maxlen)
+    def watch(self, callback=None, *, maxlen: int = DEFAULT_WATCHER_QUEUE,
+              lease_s: float | None = None) -> Watcher:
+        w = Watcher(callback, maxlen=maxlen, lease_s=lease_s)
         self.watchers.append(w)
         return w
 
@@ -157,6 +191,7 @@ class SubscriptionManager:
             "watcher_drops": 0,
             "watcher_errors": 0,
             "watchers_detached": 0,
+            "watchers_reaped": 0,
         }
         # serializes evaluation + fan-out: bus dispatch is single-
         # threaded by the bus itself, but the public evaluate() may be
@@ -186,13 +221,15 @@ class SubscriptionManager:
     def subscribe_promql(
         self, query: str, *, span_s: int, step: int, db: str, table: str,
         lookback_s: int = 300, callback=None, queue: bool = False,
-        maxlen: int = DEFAULT_WATCHER_QUEUE,
+        maxlen: int = DEFAULT_WATCHER_QUEUE, lease_s: float | None = None,
     ) -> tuple[Subscription, Watcher]:
         """Register (or join — dedup) a now-anchored PromQL range query;
         returns (subscription, watcher). Pass `callback` for push
         delivery or `queue=True` for a pollable bounded queue; neither
         registers a bare subscription (evaluations still run and park
-        in `last_result` — the cache-warming mode)."""
+        in `last_result` — the cache-warming mode). `lease_s` gives the
+        watcher a renewal lease (poll()/renew()); miss it and `reap()`
+        removes the watcher, counted."""
         from .promql import query_range
 
         key = ("promql", query, db, table, int(span_s), int(step), int(lookback_s))
@@ -205,11 +242,11 @@ class SubscriptionManager:
             )
 
         return self._register(key, "promql", query, db, table, evaluate,
-                              callback, queue, maxlen)
+                              callback, queue, maxlen, lease_s)
 
     def subscribe_sql(
         self, sql: str, *, callback=None, queue: bool = False,
-        maxlen: int = DEFAULT_WATCHER_QUEUE,
+        maxlen: int = DEFAULT_WATCHER_QUEUE, lease_s: float | None = None,
     ) -> tuple[Subscription, Watcher]:
         """Register (or join) a SQL query, evaluated as written. Its
         (db, table) resolves once here — event routing filters on it."""
@@ -224,10 +261,10 @@ class SubscriptionManager:
             return engine.execute(sql)
 
         return self._register(key, "sql", sql, db, table, evaluate,
-                              callback, queue, maxlen)
+                              callback, queue, maxlen, lease_s)
 
     def _register(self, key, kind, query, db, table, evaluate,
-                  callback, queue, maxlen):
+                  callback, queue, maxlen, lease_s=None):
         with self._lock:
             sub = self._subs.get(key)
             if sub is None:
@@ -235,8 +272,34 @@ class SubscriptionManager:
                 self._subs[key] = sub
         watcher = None
         if callback is not None or queue:
-            watcher = sub.watch(callback, maxlen=maxlen)
+            watcher = sub.watch(callback, maxlen=maxlen, lease_s=lease_s)
         return sub, watcher
+
+    def reap(self, now_monotonic: float | None = None) -> int:
+        """Remove watchers whose lease expired (ISSUE 12 satellite):
+        an abandoned dashboard client — websocket gone, tab closed —
+        stops holding its bounded queue and its share of the fan-out.
+        Counted (`watchers_reaped`, queryable like every lane); runs
+        before every event-batch evaluation and from Server.tick."""
+        now = time.monotonic() if now_monotonic is None else now_monotonic
+        reaped = 0
+        with self._lock:
+            subs = list(self._subs.values())
+        # watcher-list mutation is serialized on the eval lock like
+        # every other path that touches it (_evaluate_locked's detach
+        # loop) — reap() runs concurrently from the Server.tick thread
+        # and the bus thread, and an unguarded check-then-remove pair
+        # would double-remove the same expired watcher (ValueError out
+        # of whichever thread loses the race, double-counted reaps)
+        with self._eval_lock:
+            for sub in subs:
+                for w in [w for w in sub.watchers if w.expired(now)]:
+                    sub.unwatch(w)
+                    reaped += 1
+        if reaped:
+            with self._lock:
+                self.counters["watchers_reaped"] += reaped
+        return reaped
 
     def unsubscribe(self, sub: Subscription) -> None:
         with self._lock:
@@ -245,7 +308,10 @@ class SubscriptionManager:
     # -- evaluation ------------------------------------------------------
     def on_events(self, events) -> None:
         """Bus handler: ONE evaluation per dirty subscription per batch
-        regardless of how many events touched it (the coalescing pin)."""
+        regardless of how many events touched it (the coalescing pin).
+        Expired leases reap first — a dead client must not receive (or
+        drop) this batch's delivery."""
+        self.reap()
         with self._lock:
             subs = list(self._subs.values())
             self.counters["event_batches"] += 1

@@ -154,6 +154,14 @@ class Server:
             self.store, bus=self.event_bus, name="server"
         )
         self.alerts = AlertEngine(self.store, bus=self.event_bus, name="server")
+        # device profiling plane (ISSUE 12): each collector tick that
+        # lands profiling rows publishes a ProfileSnapshot, so standing
+        # queries / span-latency alert rules over deepflow_system
+        # re-evaluate at the sample tick instead of waiting for a poll
+        from ..profiling import profile_tick_sink
+
+        self._profile_sink = profile_tick_sink(self.event_bus)
+        default_collector.add_sink(self._profile_sink)
 
         self.exporter_hub = ExporterHub(self.exporters) if self.exporters else None
         self.doc_writer = DocStoreWriter(
@@ -235,6 +243,10 @@ class Server:
         # goes quiet (no events BECAUSE traffic stopped is itself an
         # alertable condition) — the wall-clock evaluation lane
         self.alerts.tick(now)
+        # abandoned dashboard watchers (missed lease renewals) reap on
+        # the tick as well as on event batches — a quiet store must not
+        # keep dead clients' queues alive forever (ISSUE 12 satellite)
+        self.subscriptions.reap()
         # this process IS the local analyzer — its liveness follows the
         # tick, every node (remote analyzers heartbeat via their own sync)
         self.balancer.heartbeat(self._analyzer_ip)
@@ -335,5 +347,6 @@ class Server:
         # when another server (tests, restarts) publishes
         self.subscriptions.close()
         self.alerts.close()
+        default_collector.remove_sink(self._profile_sink)
         self.store.set_mutation_hook(None)
         self.started = False

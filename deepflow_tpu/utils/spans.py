@@ -91,28 +91,89 @@ class SpanRecord:
     duration_us: int  # monotonic-clock measured
 
 
-class _Agg:
-    __slots__ = ("count", "total_us", "max_us", "last_us")
+@dataclasses.dataclass(frozen=True)
+class SpanHistSpec:
+    """Log-binned per-stage latency histogram geometry (ISSUE 12) — the
+    numpy twin of ops/histogram.LogHistSpec (same bin(v) =
+    floor(log_gamma(v / vmin)) algebra, same (gamma-1)/(gamma+1)
+    relative-error bound), kept jax-free so the tracer stays importable
+    from host-only components (agent, querier threads). The default
+    covers 1 µs .. ~640 s at ≤1% relative error in 1024 i64 bins
+    (8 KB per stage)."""
 
-    def __init__(self):
+    bins: int = 1024
+    vmin: float = 1.0  # µs; durations at/below land in bin 0
+    gamma: float = 1.02
+
+    def bin(self, duration_us: float) -> int:
+        import math
+
+        v = max(float(duration_us), self.vmin)
+        b = int(math.floor(math.log(v / self.vmin) / math.log(self.gamma)))
+        return min(max(b, 0), self.bins - 1)
+
+    def centers(self) -> np.ndarray:
+        return self.vmin * np.power(
+            float(self.gamma), np.arange(self.bins, dtype=np.float64) + 0.5
+        )
+
+
+def loghist_quantiles_np(
+    hist: np.ndarray, spec: SpanHistSpec, qs: tuple[float, ...]
+) -> np.ndarray:
+    """Pure-numpy quantiles over one [bins] log-histogram — the same
+    cumsum + rank-threshold walk as ops/histogram.loghist_quantiles,
+    evaluated host-side so the Countable face never dispatches to a
+    device. Returns zeros for an empty histogram (no fake series)."""
+    cum = np.cumsum(hist.astype(np.float64))
+    total = cum[-1]
+    if total <= 0:
+        return np.zeros(len(qs))
+    centers = spec.centers()
+    out = np.empty(len(qs))
+    for i, q in enumerate(qs):
+        idx = int(np.searchsorted(cum, q * total, side="left"))
+        out[i] = centers[min(idx, spec.bins - 1)]
+    return out
+
+
+#: the quantiles the Countable face exports per stage (deepflow_system
+#: metric names: <module>_<stage>_p50_us / _p95_us / _p99_us — the lanes
+#: span-latency alert rules key on, ISSUE 12)
+SPAN_QUANTILES = (0.5, 0.95, 0.99)
+
+
+class _Agg:
+    __slots__ = ("count", "total_us", "max_us", "last_us", "hist")
+
+    def __init__(self, bins: int):
         self.count = 0
         self.total_us = 0
         self.max_us = 0
         self.last_us = 0
+        # per-stage log-histogram (ISSUE 12): updated together with the
+        # scalar aggregates — callers hold the tracer lock, so the
+        # read-modify-write on the bin counter cannot lose updates under
+        # concurrent feeder-pump + query threads
+        self.hist = np.zeros(bins, np.int64)
 
-    def add(self, dur_us: int) -> None:
+    def add(self, dur_us: int, bin_idx: int) -> None:
         self.count += 1
         self.total_us += dur_us
         self.last_us = dur_us
         if dur_us > self.max_us:
             self.max_us = dur_us
+        self.hist[bin_idx] += 1
 
 
 class SpanTracer:
-    """Monotonic-clock stage spans: aggregates always, ring for export."""
+    """Monotonic-clock stage spans: aggregates + per-stage log-histograms
+    always, ring for export."""
 
-    def __init__(self, service: str = "deepflow_tpu.pipeline", ring_size: int = 2048):
+    def __init__(self, service: str = "deepflow_tpu.pipeline", ring_size: int = 2048,
+                 hist_spec: SpanHistSpec = SpanHistSpec()):
         self.service = service
+        self.hist_spec = hist_spec
         self._ring: deque[SpanRecord] = deque(maxlen=ring_size)
         self._agg: dict[str, _Agg] = {}
         self._lock = threading.Lock()
@@ -134,37 +195,98 @@ class SpanTracer:
         ONE logical span so cross-path stage attribution compares."""
         rec = SpanRecord(name, time.time() if start_s is None else start_s,
                          int(duration_us))
+        # the bin is computed outside the lock (pure math), but EVERY
+        # aggregate mutation — scalar lanes and the histogram counter —
+        # happens under the tracer lock: record() runs concurrently from
+        # feeder-pump and query threads, and an unlocked += on the
+        # histogram would silently lose samples (ISSUE 12 satellite,
+        # pinned by tests/test_profiling.py::test_span_tracer_threaded).
+        bin_idx = self.hist_spec.bin(rec.duration_us)
         with self._lock:
             self._ring.append(rec)
             agg = self._agg.get(name)
             if agg is None:
-                agg = self._agg[name] = _Agg()
-            agg.add(rec.duration_us)
+                agg = self._agg[name] = _Agg(self.hist_spec.bins)
+            agg.add(rec.duration_us, bin_idx)
 
     # -- read faces -----------------------------------------------------
     def summary(self) -> dict[str, dict]:
-        """Per-stage aggregates, JSON-able (the bench snapshot shape)."""
+        """Per-stage aggregates, JSON-able (the bench snapshot shape) —
+        now with the log-histogram quantiles (ISSUE 12), so BENCH files
+        carry p50/p95/p99 stage attribution next to count/avg/max."""
         with self._lock:
-            return {
-                name: {
+            out = {}
+            for name, a in sorted(self._agg.items()):
+                qv = loghist_quantiles_np(a.hist, self.hist_spec, SPAN_QUANTILES)
+                out[name] = {
                     "count": a.count,
                     "total_us": a.total_us,
                     "avg_us": round(a.total_us / a.count, 1) if a.count else 0.0,
                     "max_us": a.max_us,
                     "last_us": a.last_us,
+                    **{
+                        f"p{int(q * 100)}_us": round(float(v), 1)
+                        for q, v in zip(SPAN_QUANTILES, qv)
+                    },
                 }
-                for name, a in sorted(self._agg.items())
-            }
-
-    def get_counters(self) -> dict[str, int]:
-        """Countable face: flat `<stage>.count/.total_us/.max_us` fields."""
-        with self._lock:
-            out: dict[str, int] = {}
-            for name, a in sorted(self._agg.items()):
-                out[f"{name}.count"] = a.count
-                out[f"{name}.total_us"] = a.total_us
-                out[f"{name}.max_us"] = a.max_us
             return out
+
+    def quantiles(
+        self, name: str, qs: tuple[float, ...] = SPAN_QUANTILES
+    ) -> np.ndarray | None:
+        """Per-stage latency quantiles (µs) from the log-histogram —
+        pure numpy, no device access. None when the stage never ran."""
+        with self._lock:
+            a = self._agg.get(name)
+            hist = None if a is None else a.hist.copy()
+        if hist is None:
+            return None
+        return loghist_quantiles_np(hist, self.hist_spec, qs)
+
+    def tdigest(self, name: str, compression: int = 64):
+        """(means, weights) centroid export of one stage's latency
+        histogram — the same loghist→t-digest compression the r12
+        sketch blocks use (ops/tdigest.tdigest_from_loghist). Dispatches
+        the jitted compressor on a tiny fixed-size array: OFF the
+        Countable face, for wire/bench export only. None when the stage
+        never ran."""
+        with self._lock:
+            a = self._agg.get(name)
+            hist = None if a is None else a.hist.copy()
+        if hist is None:
+            return None
+        import jax.numpy as jnp  # lazy: the tracer itself stays jax-free
+
+        from ..ops.histogram import LogHistSpec
+        from ..ops.tdigest import tdigest_from_loghist
+
+        spec = LogHistSpec(bins=self.hist_spec.bins, vmin=self.hist_spec.vmin,
+                           gamma=self.hist_spec.gamma)
+        m, w = tdigest_from_loghist(
+            jnp.asarray(hist[None, :], jnp.int32), spec, compression=compression
+        )
+        return np.asarray(m[0]), np.asarray(w[0])
+
+    def get_counters(self) -> dict[str, int | float]:
+        """Countable face: flat `<stage>.count/.total_us/.max_us` fields
+        plus the log-histogram p50/p95/p99 lanes (ISSUE 12) — dogfooded
+        via integration/dfstats into deepflow_system, where
+        `ingest.dispatch.p99_us` becomes the
+        `tpu_pipeline_spans_ingest_dispatch_p99_us` metric a span-latency
+        alert rule keys on. Pure numpy, fetch-free, safe from a ticking
+        collector thread."""
+        with self._lock:
+            aggs = [(name, a.count, a.total_us, a.max_us, a.hist.copy())
+                    for name, a in sorted(self._agg.items())]
+        out: dict[str, int | float] = {}
+        for name, count, total_us, max_us, hist in aggs:
+            out[f"{name}.count"] = count
+            out[f"{name}.total_us"] = total_us
+            out[f"{name}.max_us"] = max_us
+            qv = loghist_quantiles_np(hist, self.hist_spec, SPAN_QUANTILES)
+            for q, v in zip(SPAN_QUANTILES, qv):
+                out[f"{name}.p{int(q * 100)}_us"] = round(float(v), 1)
+        return out
 
     def recent(self, name: str | None = None) -> list[SpanRecord]:
         with self._lock:

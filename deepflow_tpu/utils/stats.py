@@ -125,6 +125,13 @@ class StatsCollector:
         with self._lock:
             self._sinks.append(sink)
 
+    def remove_sink(self, sink: Callable[[list[StatsPoint]], None]) -> None:
+        """Detach a sink (a stopped server's ProfileSnapshot publisher
+        must not keep firing events on a bus nobody drains)."""
+        with self._lock:
+            if sink in self._sinks:
+                self._sinks.remove(sink)
+
     # -- ticking --------------------------------------------------------
     def sample(
         self, now: float | None = None, *, _advance_backoff: bool = False

@@ -816,3 +816,275 @@ def test_tier_closed_event_from_sketch_sink():
         sink(pipe.pop_closed_sketches())
     assert any(isinstance(e, WindowClosed) for e in seen)
     assert store.row_count(DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE) > 0
+
+
+# ---------------------------------------------------------------------------
+# (6) ISSUE 12 satellites: per-series alert states + subscription leases
+
+
+def test_alert_per_series_states_one_fires_one_stays_inactive():
+    """Prometheus semantics pin (r15 leftover): alert state is keyed by
+    LABEL SET — a rule over a two-series metric tracks each series'
+    own inactive→pending→firing ladder, and the hot series firing
+    leaves the cold one INACTIVE (not dragged along by a rule-wide
+    max), with the firing notification naming the hot series' labels."""
+    from deepflow_tpu.integration.formats import pack_tags
+
+    store = ColumnarStore()
+    ensure_system_table(store)
+    bus = QueryEventBus(name="ps")
+    fired: list[dict] = []
+    eng = AlertEngine(store, live=LiveRegistry(), bus=bus, name="ps",
+                      log_sink=False)
+    eng.add_sink(fired.append, name="cb")
+    eng.add_rule(AlertRule(name="high_m", query="m", comparator=">",
+                           threshold=10.0, for_s=0))
+
+    def both(t, hot, cold):
+        _samples_insert(store, t, "m", hot, pack_tags({"svc": "hot"}))
+        _samples_insert(store, t, "m", cold, pack_tags({"svc": "cold"}))
+        bus.publish(WindowClosed(DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE, t))
+
+    both(T0, 50.0, 1.0)
+    series = {tuple(sorted(s["labels"].items())): s
+              for s in eng.series_states("high_m")}
+    hot = series[(("svc", "hot"),)]
+    cold = series[(("svc", "cold"),)]
+    assert hot["state"] == STATE_FIRING and hot["value"] == 50.0
+    assert cold["state"] == STATE_INACTIVE and cold["value"] == 1.0
+    # the rule-level rollup reports the worst series
+    assert eng.state("high_m") == STATE_FIRING
+    assert len(fired) == 1 and fired[0]["labels"]["svc"] == "hot"
+    c = eng.get_counters()
+    assert c["rule_high_m_firing_series"] == 1
+    assert c["series"] == 2
+
+    # the hot series cools: IT resolves (one notification, with its
+    # labels); the cold one never left inactive
+    both(T0 + 2, 2.0, 1.0)
+    series = {s["labels"]["svc"]: s for s in eng.series_states("high_m")}
+    assert series["hot"]["state"] == STATE_RESOLVED
+    assert series["cold"]["state"] == STATE_INACTIVE
+    assert len(fired) == 2 and fired[1]["state"] == STATE_RESOLVED
+    assert fired[1]["labels"]["svc"] == "hot"
+
+    # the cold series breaches while hot stays resolved — independent
+    # ladders: cold fires without re-notifying hot
+    both(T0 + 4, 2.0, 99.0)
+    series = {s["labels"]["svc"]: s for s in eng.series_states("high_m")}
+    assert series["cold"]["state"] == STATE_FIRING
+    assert series["hot"]["state"] == STATE_RESOLVED
+    assert len(fired) == 3 and fired[2]["labels"]["svc"] == "cold"
+
+
+def test_alert_per_series_for_duration_and_gc():
+    """Per-series `for` ladders advance independently, and an inactive
+    series that stops reporting leaves the state map (label churn
+    cannot grow it forever) while its transition count survives in the
+    rule total."""
+    from deepflow_tpu.integration.formats import pack_tags
+
+    store = ColumnarStore()
+    ensure_system_table(store)
+    bus = QueryEventBus(name="ps2")
+    eng = AlertEngine(store, live=LiveRegistry(), bus=bus, name="ps2",
+                      log_sink=False)
+    eng.add_rule(AlertRule(name="high_m", query="m", comparator=">",
+                           threshold=10.0, for_s=5, lookback_s=3))
+
+    def one(t, svc, v):
+        _samples_insert(store, t, "m", v, pack_tags({"svc": svc}))
+
+    one(T0, "a", 50.0)
+    bus.publish(WindowClosed(DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE, T0))
+    assert {s["labels"]["svc"]: s["state"]
+            for s in eng.series_states("high_m")} == {"a": STATE_PENDING}
+    # series b starts breaching LATER — its ladder starts at its own
+    # first breach, not a's
+    one(T0 + 4, "a", 50.0)
+    one(T0 + 4, "b", 50.0)
+    bus.publish(WindowClosed(DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE, T0 + 4))
+    states = {s["labels"]["svc"]: s["state"]
+              for s in eng.series_states("high_m")}
+    assert states == {"a": STATE_PENDING, "b": STATE_PENDING}
+    one(T0 + 6, "a", 50.0)
+    one(T0 + 6, "b", 50.0)
+    bus.publish(WindowClosed(DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE, T0 + 6))
+    states = {s["labels"]["svc"]: s["state"]
+              for s in eng.series_states("high_m")}
+    assert states["a"] == STATE_FIRING  # held ≥5s
+    assert states["b"] == STATE_PENDING  # only 2s on its own ladder
+    # series a vanishes (tight lookback): no data → resolved (it fired);
+    # b keeps pending; then b falls quiet pre-fire → inactive → GC'd
+    transitions_before = eng.list_rules()[0]["transitions"]
+    one(T0 + 10, "b", 1.0)
+    bus.publish(WindowClosed(DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE, T0 + 10))
+    series = {s["labels"]["svc"]: s for s in eng.series_states("high_m")}
+    assert series["a"]["state"] == STATE_RESOLVED  # fired before → resolved
+    assert series["b"]["state"] == STATE_INACTIVE  # fell back, still reporting
+    # ...and once b stops reporting entirely, the inactive series is
+    # GC'd from the state map (label churn bound) while its transition
+    # count survives in the rule total
+    bus.publish(WindowClosed(DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE, T0 + 20))
+    series = {s["labels"]["svc"]: s for s in eng.series_states("high_m")}
+    assert "b" not in series
+    assert eng.list_rules()[0]["transitions"] >= transitions_before
+
+
+def test_subscription_lease_reaps_abandoned_watchers():
+    """r15 leftover: a queue-mode watcher that misses its lease renewal
+    is reaped (counted, queryable) — abandoned dashboard clients stop
+    holding bounded queues; an actively-polling watcher never expires."""
+    store = ColumnarStore()
+    ensure_system_table(store)
+    bus = QueryEventBus(name="lease")
+    subs = SubscriptionManager(store, live=LiveRegistry(), cache=False,
+                               bus=bus, name="lease")
+    sub, alive = subs.subscribe_promql(
+        "m", span_s=4, step=1, db=DEEPFLOW_SYSTEM_DB,
+        table=DEEPFLOW_SYSTEM_TABLE, queue=True, lease_s=30.0,
+    )
+    _, dead = subs.subscribe_promql(
+        "m", span_s=4, step=1, db=DEEPFLOW_SYSTEM_DB,
+        table=DEEPFLOW_SYSTEM_TABLE, queue=True, lease_s=30.0,
+    )
+    _, forever = subs.subscribe_promql(
+        "m", span_s=4, step=1, db=DEEPFLOW_SYSTEM_DB,
+        table=DEEPFLOW_SYSTEM_TABLE, queue=True,  # no lease: never reaped
+    )
+    assert len(sub.watchers) == 3
+    _samples_insert(store, T0, "m", 5.0)
+    bus.publish(WindowClosed(DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE, T0))
+    assert alive.poll() is not None  # delivery worked; poll renews
+
+    # simulate 60s of silence from `dead` only (injected clock — the
+    # reap compares monotonic seconds, no sleeping in CI)
+    dead.last_renew -= 60.0
+    reaped = subs.reap()
+    assert reaped == 1
+    assert dead not in sub.watchers
+    assert alive in sub.watchers and forever in sub.watchers
+    assert subs.get_counters()["watchers_reaped"] == 1
+
+    # the next event batch reaps implicitly too (on_events path)
+    alive.last_renew -= 60.0
+    _samples_insert(store, T0 + 1, "m", 6.0)
+    bus.publish(WindowClosed(DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE, T0 + 1))
+    assert alive not in sub.watchers
+    assert forever in sub.watchers  # lease-less watcher still served
+    assert forever.poll() is not None
+    assert subs.get_counters()["watchers_reaped"] == 2
+    subs.close()
+
+
+def test_alert_read_faces_safe_under_concurrent_evaluation():
+    """Review fix pin: the Countable/listing faces iterate the
+    per-series maps while the bus thread mutates them — without the
+    eval lock a concurrent evaluation turns get_counters()/list_rules()
+    into 'dictionary changed size during iteration' and kills the
+    collector tick."""
+    import threading
+
+    from deepflow_tpu.integration.formats import pack_tags
+
+    store = ColumnarStore()
+    ensure_system_table(store)
+    eng = AlertEngine(store, live=LiveRegistry(), name="race",
+                      log_sink=False)
+    eng.add_rule(AlertRule(name="high_m", query="m", comparator=">",
+                           threshold=10.0, for_s=0, lookback_s=2))
+    # churn the label space so every evaluation inserts AND GCs series
+    for i in range(40):
+        _samples_insert(store, T0 + i, "m", 50.0,
+                        pack_tags({"svc": f"s{i}"}))
+    stop = threading.Event()
+    errors: list = []
+
+    def reader():
+        while not stop.is_set():
+            try:
+                eng.get_counters()
+                eng.list_rules()
+                eng.series_states("high_m")
+                eng.state("high_m")
+            except Exception as e:  # pragma: no cover - the regression
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=reader) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for i in range(40):
+        eng.evaluate_rule("high_m", now=T0 + i)
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+
+
+def test_alert_resolved_series_retention_gc():
+    """Review fix pin: a RESOLVED series that stops reporting is GC'd
+    after RESOLVED_RETENTION_S — churned once-fired label sets must not
+    occupy MAX_SERIES slots forever and block new series from ever
+    alerting."""
+    from deepflow_tpu.integration.formats import pack_tags
+
+    store = ColumnarStore()
+    ensure_system_table(store)
+    eng = AlertEngine(store, live=LiveRegistry(), name="ret",
+                      log_sink=False)
+    eng.add_rule(AlertRule(name="high_m", query="m", comparator=">",
+                           threshold=10.0, for_s=0, lookback_s=2))
+    # fire + resolve one churned series
+    _samples_insert(store, T0, "m", 50.0, pack_tags({"pod": "p1"}))
+    eng.evaluate_rule("high_m", now=T0)
+    _samples_insert(store, T0 + 1, "m", 1.0, pack_tags({"pod": "p1"}))
+    eng.evaluate_rule("high_m", now=T0 + 1)
+    assert {s["state"] for s in eng.series_states("high_m")} == {STATE_RESOLVED}
+    # silent but inside retention: kept (flap memory / visibility)
+    eng.evaluate_rule("high_m", now=T0 + 10)
+    assert len(eng.series_states("high_m")) == 1
+    # silent past retention: GC'd, transitions preserved in the total
+    before = eng.list_rules()[0]["transitions"]
+    eng.evaluate_rule("high_m", now=T0 + 1 + AlertEngine.RESOLVED_RETENTION_S)
+    assert eng.series_states("high_m") == []
+    assert eng.list_rules()[0]["transitions"] == before
+
+
+def test_callback_watcher_lease_renews_on_delivery():
+    """Review fix pin: a callback watcher has no poll() — a SUCCESSFUL
+    delivery is its heartbeat, so an actively-served callback client
+    with a lease is never reaped; a failing one stops renewing and is."""
+    store = ColumnarStore()
+    ensure_system_table(store)
+    bus = QueryEventBus(name="cb_lease")
+    subs = SubscriptionManager(store, live=LiveRegistry(), cache=False,
+                               bus=bus, name="cb_lease")
+    got: list = []
+    sub, served = subs.subscribe_promql(
+        "m", span_s=4, step=1, db=DEEPFLOW_SYSTEM_DB,
+        table=DEEPFLOW_SYSTEM_TABLE, callback=lambda r, s: got.append(r),
+        lease_s=30.0,
+    )
+    # a SUCCESSFUL delivery renews (callback mode has no poll — the
+    # accepted delivery is its heartbeat): age the lease, deliver
+    # directly (evaluate() has no reap step), then reap — kept
+    _samples_insert(store, T0, "m", 5.0)
+    served.last_renew -= 60.0
+    assert served.expired()
+    subs.evaluate(sub, now=T0 + 1)
+    assert got and not served.expired()
+    assert subs.reap() == 0
+    assert served in sub.watchers
+    # a watcher whose callback RAISES does NOT renew — it stops
+    # heartbeating and the next reap removes it
+    bad = sub.watch(
+        callback=lambda r, s: (_ for _ in ()).throw(RuntimeError("x")),
+        lease_s=30.0,
+    )
+    bad.last_renew -= 60.0
+    subs.evaluate(sub, now=T0 + 2)  # failed delivery: no renewal
+    assert bad.expired()
+    assert subs.reap() == 1
+    assert bad not in sub.watchers and served in sub.watchers
+    subs.close()
