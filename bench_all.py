@@ -606,13 +606,46 @@ def config13(quick: bool):
          passive=rec["passive"], iters=rec["iters"])
 
 
+def config14(quick: bool):
+    """Window lineage tracing + freshness plane (ISSUE 13): passive vs
+    traced A/B on the §14 feeder workload via bench/tracebench.py
+    (protocol: PERF.md §22, committed numbers: TRACEBENCH_r01.json).
+    The vs line is the overhead percent with the full lineage stack +
+    an every-4-pumps consumer (fetch parity itself is CI-gated
+    deterministically); span-row volume and the trace pull latencies
+    ride the detail, on-chip columns reserved."""
+    import os
+    import subprocess
+
+    env = {**os.environ}
+    if quick:
+        env.update(TRACEBENCH_ITERS="16")
+    out = subprocess.run(
+        [sys.executable, "bench/tracebench.py"],
+        capture_output=True, text=True, timeout=1800, env=env,
+    )
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    if rec.get("partial"):
+        emit("c14_window_lineage", 0, "error", 0, error=rec.get("error"))
+        return
+    emit("c14_window_lineage", rec["traced"]["rec_s"], "records/s",
+         rec["overhead_pct"],
+         fetch_parity=rec["fetch_parity"],
+         span_rows_per_window=rec["traced"]["span_rows_per_window"],
+         span_rows_per_1k_records=rec["traced"]["span_rows_per_1k_records"],
+         pull_ms_live_assemble=rec["traced"]["pull_ms_live_assemble"],
+         pull_ms_store_query=rec["traced"]["pull_ms_store_query"],
+         passive=rec["passive"], iters=rec["iters"])
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--cpu", action="store_true")
     p.add_argument("--quick", action="store_true")
     args = p.parse_args()
     for fn in (config1, config2, config3, config4, config5, config6, config7,
-               config8, config9, config10, config11, config12, config13):
+               config8, config9, config10, config11, config12, config13,
+               config14):
         try:
             fn(args.quick)
         except Exception as e:  # one config must not kill the others

@@ -254,6 +254,11 @@ class StagedBatch:
     meters: jnp.ndarray  # [B, M] f32 (device)
     valid: jnp.ndarray  # [B] bool (device)
     padded_rows: int  # B — the bucket this batch padded to
+    # lineage plane (ISSUE 13): the batch's host-side event-time bounds
+    # (valid rows only), captured in stage() BEFORE upload — t_max <
+    # t_min means "not computed" (no lineage attached)
+    t_min: int = 0
+    t_max: int = -1
 
 
 class RollupPipeline:
@@ -335,6 +340,18 @@ class RollupPipeline:
             f"{type(self).__name__}:{config.window.interval}s"
             f"#{next(_PIPELINE_SEQ)}"
         )
+        # window lineage plane (ISSUE 13): opt-in via attach_lineage
+        self._lineage = None
+
+    def attach_lineage(self, tracker) -> None:
+        """Wire a tracing/lineage.LineageTracker through this pipeline:
+        stage() stamps the upload hop and captures the batch's host
+        event-time bounds, ingest_staged binds them to the dispatch, and
+        the wrapped WindowManager records advance/flush/tier/snapshot
+        hops + freshness lags. Host wall stamps only — zero new device
+        fetches (CI-gated)."""
+        self._lineage = tracker
+        self.wm.attach_lineage(tracker)
 
     def _build_step(self, names: tuple):
         """One fused device step per batch: [T, N] packed tags → stats +
@@ -441,6 +458,15 @@ class RollupPipeline:
         batch = batch.pad_to(self._pad_target(batch.size))
         if not np.any(batch.valid):
             return None
+        lin = self._lineage
+        t_min, t_max, s0 = 0, -1, 0.0
+        if lin is not None:
+            # host event-time bounds BEFORE the upload (numpy — free);
+            # the dispatch binds them to the lineage window span
+            ts = np.asarray(batch.tags["timestamp"])[batch.valid]
+            if ts.size:
+                t_min, t_max = int(ts.min()), int(ts.max())
+            s0 = lin.clock()
         if self._tag_names is None:
             self._tag_names = tuple(sorted(batch.tags))
             self._step = self._build_step(self._tag_names)
@@ -456,8 +482,10 @@ class RollupPipeline:
         self.wm.bytes_uploaded += (
             tag_mat.nbytes + meters.nbytes + valid.nbytes
         )
+        if lin is not None:
+            lin.note_stage(s0)
         return StagedBatch(tag_mat=tag_mat, meters=meters, valid=valid,
-                           padded_rows=batch.size)
+                           padded_rows=batch.size, t_min=t_min, t_max=t_max)
 
     def ingest(self, batch: FlowBatch, feeder_shed: int = 0) -> list[DocBatch]:
         """Feed one decoded flow batch; returns any closed windows."""
@@ -510,9 +538,15 @@ class RollupPipeline:
                 )
             return self._step(*args)
 
+        window_span = None
+        if self._lineage is not None and staged.t_max >= staged.t_min:
+            iv = self.config.window.interval
+            window_span = (staged.t_min // iv, staged.t_max // iv)
         compiles0 = sum(self._jit.poll())
         t0 = time.perf_counter()
-        flushed = self.wm.ingest_step(dispatch, rows, ring_rows=max_rows)
+        flushed = self.wm.ingest_step(
+            dispatch, rows, ring_rows=max_rows, window_span=window_span
+        )
         wall_s = time.perf_counter() - t0
         if sum(self._jit.poll()) > compiles0:
             # the monitor saw the pjit cache grow on this dispatch: the

@@ -734,6 +734,11 @@ class WindowManager:
         self.dispatch_retries = 0
         self.fetch_retries = 0
         self.tracer = tracer if tracer is not None else SpanTracer()
+        # window lineage plane (ISSUE 13): optional per-window hop
+        # recorder (tracing/lineage.LineageTracker). Every hop is a
+        # host wall stamp — attaching it never adds a device fetch
+        # (CI-gated, test_perf_gate::test_lineage_tracing_budget).
+        self.lineage = None
         # device profiling plane (ISSUE 12): every device-resident plane
         # this manager owns is enumerable via device_planes(), and the
         # manager registers WEAKLY on the process-wide HBM ledger (the
@@ -874,6 +879,9 @@ class WindowManager:
                     )
                 )
         flushed.sort(key=lambda f: f.window_idx)
+        lin = self.lineage
+        if lin is not None and flushed:
+            lin.note_flush_windows([(f.window_idx, f.count) for f in flushed])
         if self.cascade is not None:
             # this drain's closed child blocks feed the parent merge
             # BEFORE tier windows are built, so a parent closing in the
@@ -886,6 +894,10 @@ class WindowManager:
             for tf, t in zip(entry.tiers, tier_totals):
                 t_rows = take(t * row_cols).reshape(t, row_cols)
                 tier_wins.extend(self.cascade.take_tier_windows(tf, t_rows, t))
+            if lin is not None and tier_wins:
+                lin.note_tier_windows(
+                    [(f.interval, f.window_idx, f.count) for f in tier_wins]
+                )
             from .sketchplane import hold_blocks
 
             self.tier_windows_dropped += hold_blocks(
@@ -969,6 +981,28 @@ class WindowManager:
     def window_of(self, timestamp):
         return timestamp // self.config.interval
 
+    def attach_lineage(self, tracker) -> None:
+        """Wire a tracing/lineage.LineageTracker: dispatch stamps,
+        advance/flush/tier-close hops and the freshness lags all record
+        from this manager's existing host seams."""
+        self.lineage = tracker
+
+    def _lineage_span_of(self, timestamp, valid) -> tuple[int, int] | None:
+        """Host-side window span of one batch — ONLY when the arrays
+        are already host-resident (a jnp input would force the transfer
+        the zero-fetch contract forbids)."""
+        if not isinstance(timestamp, np.ndarray):
+            return None
+        # the valid mask must be host too — np.asarray on a jnp array
+        # would force the very transfer the zero-fetch contract forbids
+        v = valid if isinstance(valid, np.ndarray) else None
+        ts = timestamp[v.astype(bool)] \
+            if (v is not None and v.shape == timestamp.shape) else timestamp
+        if ts.size == 0:
+            return None
+        iv = self.config.interval
+        return int(ts.min()) // iv, int(ts.max()) // iv
+
     def _cascade_lanes(self) -> jnp.ndarray:
         """Device [rows, shed] vector for the counter block's v5 lanes —
         the cascade's when configured, a cached zero vector otherwise
@@ -1024,6 +1058,13 @@ class WindowManager:
             snap = self._read_open_snapshot(now)
         self.snapshot_seq += 1
         snap.seq = self.snapshot_seq
+        if self.lineage is not None and snap.windows:
+            # a live read served these still-open windows: the DISTINCT
+            # partial lane (ISSUE 13 — never confusable with post-flush
+            # visibility)
+            self.lineage.note_snapshot(
+                [(w.window_idx, w.count) for w in snap.windows]
+            )
         self._snap_lanes_dev = jnp.asarray(
             [self.snapshot_reads & 0xFFFFFFFF, self.snapshot_bytes & 0xFFFFFFFF],
             dtype=jnp.uint32,
@@ -1125,6 +1166,11 @@ class WindowManager:
         Accepts both the versioned CB_LEN block (element 0 =
         COUNTER_BLOCK_VERSION) and the legacy 5-scalar stats vector, so
         caller-supplied dispatch steps can widen incrementally."""
+        lin = self.lineage
+        # one block = one dispatch: pop its wall stamp FIRST (whether or
+        # not this block advances) so the FIFO pairing stays aligned
+        # across K-ring drains and async settles
+        lin_stamp = lin.pop_dispatch_stamp() if lin is not None else None
         if len(vec) == CB_LEN:
             if vec[CB_VERSION] != COUNTER_BLOCK_VERSION:
                 raise ValueError(
@@ -1197,6 +1243,8 @@ class WindowManager:
                         packed, total, self.start_window, new_start
                     )
                 )
+                if lin is not None:
+                    lin.note_advance(self.start_window, new_start, lin_stamp)
                 self.start_window = new_start
                 self.n_advances += 1
 
@@ -1233,6 +1281,10 @@ class WindowManager:
         batch instead (double-buffered — see WindowConfig).
         `feeder_shed` rides into the counter block's CB_FEEDER_SHED
         lane (upstream drop accounting, ISSUE 4)."""
+        window_span = (
+            self._lineage_span_of(timestamp, valid)
+            if self.lineage is not None else None
+        )
         timestamp = jnp.asarray(timestamp, dtype=jnp.uint32)
         rows = int(timestamp.shape[0])
         interval = self.config.interval
@@ -1266,10 +1318,11 @@ class WindowManager:
                     interval=interval,
                 )
 
-        return self.ingest_step(dispatch, rows)
+        return self.ingest_step(dispatch, rows, window_span=window_span)
 
     def ingest_step(
-        self, dispatch, rows: int, ring_rows: int | None = None
+        self, dispatch, rows: int, ring_rows: int | None = None,
+        window_span: tuple[int, int] | None = None,
     ) -> list[FlushedWindow]:
         """Window protocol around a caller-supplied jitted append step.
 
@@ -1280,7 +1333,9 @@ class WindowManager:
         of accumulator rows the step appends; `ring_rows` (≥ rows) sizes
         the accumulator ring when bucketed callers know a larger batch
         shape is coming, so a small first bucket doesn't build a ring a
-        later big bucket immediately replaces."""
+        later big bucket immediately replaces. `window_span` (lo, hi —
+        host-computed from the batch's own timestamps) binds this
+        dispatch to the lineage plane when one is attached (ISSUE 13)."""
         if rows == 0:
             return self._settle_ready()
 
@@ -1333,6 +1388,8 @@ class WindowManager:
         def on_retry(_attempt, _exc):
             self.dispatch_retries += 1
 
+        lin = self.lineage
+        d0 = lin.clock() if lin is not None else 0.0
         with self.tracer.span(SPAN_INGEST_DISPATCH):
             # admission-time-only classification: the step donates its
             # accumulator (and sketch plane), so a mid-flight
@@ -1345,6 +1402,11 @@ class WindowManager:
                 self.acc, stats_dev, self.sk = out
             else:
                 self.acc, stats_dev = out
+        if lin is not None:
+            # bind the batch's window span (host timestamps) + push the
+            # wall stamp the counter-block replay pops — device-side
+            # hop times are DERIVED from this pairing, never fetched
+            lin.note_dispatch(window_span, d0)
         self.fill += rows
 
         if K > 1:

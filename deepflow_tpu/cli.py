@@ -25,6 +25,10 @@ Commands mirror the reference CLI surface that applies to this build:
   dfctl agent-group --port P ...         trisolaris group config/upgrade
   dfctl plugin --dir D list              L7 protocol plugin inventory
   dfctl trace --port P TRACE_ID          assembled trace tree (REST)
+  dfctl trace --port P window WID        window lineage tree (ISSUE 13:
+                                         the pipeline traced by its own
+                                         trace engine; --interval for
+                                         cascade tiers, --service)
 """
 
 from __future__ import annotations
@@ -120,12 +124,30 @@ def cmd_rest(args):
 
 
 def cmd_trace(args):
+    import urllib.parse
     import urllib.request
 
-    with urllib.request.urlopen(
-        f"http://{args.host}:{args.port}/v1/traces/{args.trace_id}"
-    ) as r:
-        print(json.dumps(json.loads(r.read()), indent=2))
+    if args.trace_id == "window":
+        # window lineage plane (ISSUE 13): `dfctl trace window <id>`
+        # serves the pipeline's own trace tree for one window
+        if args.window_id is None:
+            sys.exit("usage: dfctl trace window WINDOW_ID [--interval N] "
+                     "[--service S]")
+        q = {"interval": str(args.interval)}
+        if args.service:
+            q["service"] = args.service
+        url = (
+            f"http://{args.host}:{args.port}/v1/trace/window/"
+            f"{args.window_id}?{urllib.parse.urlencode(q)}"
+        )
+    else:
+        url = f"http://{args.host}:{args.port}/v1/traces/{args.trace_id}"
+    try:
+        with urllib.request.urlopen(url) as r:
+            body = r.read()
+    except urllib.error.HTTPError as e:
+        body = e.read()
+    print(json.dumps(json.loads(body), indent=2))
 
 
 def cmd_profile(args):
@@ -222,7 +244,14 @@ def main(argv=None):
     sp = sub.add_parser("trace")
     sp.add_argument("--host", default="127.0.0.1")
     sp.add_argument("--port", type=int, required=True)
-    sp.add_argument("trace_id")
+    sp.add_argument("trace_id",
+                    help="a trace id, or the literal 'window' followed "
+                         "by a window id (window lineage tree)")
+    sp.add_argument("window_id", nargs="?", default=None)
+    sp.add_argument("--interval", type=int, default=1,
+                    help="tier interval seconds for 'window' (default 1)")
+    sp.add_argument("--service", default=None,
+                    help="lineage service name (default tpu.pipeline)")
     sp.set_defaults(fn=cmd_trace)
 
     sp = sub.add_parser("profile")

@@ -18,6 +18,10 @@ the composition root:
   GET    /v1/prom?query=&time=           PromQL instant
   GET    /v1/prom/range?query=&start=&end=&step=   PromQL range
   GET    /v1/traces/<trace_id>           assembled trace tree
+  GET    /v1/trace/window/<window_id>    window lineage tree (ISSUE 13;
+                                         ?interval=&service=&org= — the
+                                         trace id derives from the
+                                         window id, no lookup)
   GET    /v1/tracemap?start=&end=        service-edge aggregation
   GET    /v1/profile/device              device profiling plane (ISSUE
                                          12): HBM ledger + step census
@@ -220,6 +224,22 @@ class RestServer:
                     int(q.get("step") or 60),
                 )
             )
+        elif len(parts) == 4 and parts[:3] == ["v1", "trace", "window"]:
+            # window lineage plane (ISSUE 13): the trace id is DERIVED
+            # from (service, interval, window id) — no lookup table
+            try:
+                wid = int(parts[3])
+            except ValueError:
+                h._json({"error": "window id must be an integer"}, 400)
+                return
+            out = df.query_window_trace(
+                wid,
+                interval=int(q.get("interval") or 1),
+                service=q.get("service") or None,
+                org=int(q.get("org") or 1),
+            )
+            h._json(out if out is not None else {"error": "not found"},
+                    200 if out is not None else 404)
         elif len(parts) == 3 and parts[:2] == ["v1", "traces"]:
             out = df.query_trace(parts[2], org=int(q.get("org") or 1))
             h._json(out if out is not None else {"error": "not found"},

@@ -457,6 +457,7 @@ class FeederRuntime:
         tracer: SpanTracer | None = None,
         journal=None,
         event_bus=None,
+        lineage=None,
     ):
         if not queues:
             raise ValueError("need at least one queue")
@@ -480,6 +481,18 @@ class FeederRuntime:
         # the drain-side hook that turns a window close into an eager
         # cache invalidation + one shared subscription evaluation
         self._event_bus = event_bus
+        # window lineage plane (ISSUE 13): the feeder owns the
+        # pre-window hops — pump start, receiver-admission pairing and
+        # journal appends park in the tracker's pending context and
+        # bind to window ids at the sink's dispatch. Every admitted
+        # frame must consume exactly one admission stamp; frames the
+        # OverwriteQueue silently overwrote never reach the feeder, so
+        # each pump drops stamps by the queues' overwritten-counter
+        # delta (baseline taken here — pre-attach drops don't count).
+        self._lineage = lineage
+        self._overwritten_base = sum(
+            int(getattr(q, "overwritten", 0)) for q in queues
+        )
         self._weights = config.weights or (1,) * len(queues)
         self._pressure = [False] * len(queues)
         self._chunks: deque = deque()
@@ -609,10 +622,19 @@ class FeederRuntime:
         else:
             self._probe_now = False
 
+    def _drop_admit_stamp(self) -> None:
+        """One admitted frame contributed no rows (bad/empty/shed):
+        consume its receiver admission stamp WITHOUT folding it into
+        the lineage context, or the FIFO pairing drifts stale
+        (ISSUE 13 — every admitted frame must pop exactly one stamp)."""
+        if self._lineage is not None:
+            self._lineage.drop_stamps(1)
+
     def _shed_frame(self, raw: bytes) -> None:
         """Degraded-mode shed: whole frames, counted via header peek —
         the same stance as watermark shedding, plus the degraded lane."""
         self._count("shed_frames")
+        self._drop_admit_stamp()
         n = self._count_records_safe(raw)
         self._count("shed_records", n)
         self._count("degraded_shed_records", n)
@@ -648,6 +670,7 @@ class FeederRuntime:
             cut = max(len(drained) - budget, 0)
             for raw in drained[:cut]:
                 self._count("shed_frames")
+                self._drop_admit_stamp()
                 n = self._count_records_safe(raw)
                 self._count("shed_records", n)
                 with self._lock:
@@ -741,13 +764,21 @@ class FeederRuntime:
             # sinks quarantine internally (FrameCodecBase); this guard
             # covers foreign sink implementations only
             self._count("bad_frames")
+            self._drop_admit_stamp()
             return
         if int(getattr(self.sink, "decode_errors", 0)) > errs0:
             self._count("bad_frames")  # quarantined by the codec
+            self._drop_admit_stamp()
             return
         self._count("frames_in")
         if chunk is None or chunk.rows == 0:
+            self._drop_admit_stamp()
             return
+        if self._lineage is not None:
+            # pair this admitted frame with its receiver admission
+            # stamp (FIFO) — opens the receiver.admit hop in the
+            # pending context
+            self._lineage.note_frames(1)
         self._count("records_in", chunk.rows)
         self._admit(chunk, out)
 
@@ -763,6 +794,15 @@ class FeederRuntime:
 
     def _pump_locked(self) -> list:
         out: list = []
+        if self._lineage is not None:
+            self._lineage.begin_pump()
+            # frames lost to queue OVERWRITE never reach _process_frame
+            # — consume their admission stamps here or the FIFO pairing
+            # drifts stale under sustained backpressure
+            ow = sum(int(getattr(q, "overwritten", 0)) for q in self.queues)
+            if ow > self._overwritten_base:
+                self._lineage.drop_stamps(ow - self._overwritten_base)
+            self._overwritten_base = ow
         self._probe_tick()
         dispatch0 = self.counters["batches_out"] + self.counters["emit_failures"]
         nq = len(self.queues)
@@ -786,8 +826,12 @@ class FeederRuntime:
                 # device: a kill anywhere downstream (dispatch, fetch,
                 # flush) then loses nothing the journal can't replay
                 if self._journal is not None and not shedding:
+                    j0 = (self._lineage.clock()
+                          if self._lineage is not None else 0.0)
                     for raw in admit:
                         self._journal.append(raw)
+                    if self._lineage is not None and admit:
+                        self._lineage.note_journal(j0)
                 for raw in admit:
                     if shedding:
                         self._shed_frame(raw)

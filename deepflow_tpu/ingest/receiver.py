@@ -69,6 +69,12 @@ class Receiver:
             "tcp_conns": 0,
         }
         self._queue_stat_sources: list = []
+        # window lineage plane (ISSUE 13): when a LineageTracker is
+        # attached, every frame admitted into a handler queue leaves a
+        # wall stamp — the feeder pairs stamps to frames FIFO, so the
+        # receiver.admit hop opens a window's trace without any header
+        # field on the wire
+        self.lineage = None
 
     def agent_list(self) -> list[AgentStatus]:
         """Snapshot for observers (REST/debug) — .agents mutates under
@@ -182,8 +188,13 @@ class Receiver:
         try:
             if q.put(raw_frame) is False:
                 self._count("queue_closed")
+                return
         except Exception:
             self._count("queue_closed")
+            return
+        lin = self.lineage
+        if lin is not None:
+            lin.note_admit()
 
     # -- TCP ------------------------------------------------------------
     def _accept_loop(self) -> None:

@@ -428,14 +428,17 @@ def live_flow_source(
     return provider, reg.register(db, table, provider)
 
 
-def flow_window_sink(store, *, bus=None, **row_kw):
+def flow_window_sink(store, *, bus=None, lineage=None, **row_kw):
     """→ callable(windows) writing CLOSED windows' rows through the
     same `flow_window_rows` builder the live source uses — window
     close = insert = store epoch bump = result-cache invalidation.
     With `bus` set (ISSUE 11), one WindowClosed batch publishes AFTER
     the insert (on top of the store's own StoreMutation hook, if
     connected): standing queries re-evaluate once per sink call with
-    the closed windows' times as the event clock."""
+    the closed windows' times as the event clock. With `lineage` set
+    (ISSUE 13), each inserted window's store.insert hop records and
+    its VISIBILITY freshness lag anchors here — the row just became
+    queryable."""
     ensure_system_table(store)
 
     def sink(windows) -> None:
@@ -461,6 +464,74 @@ def flow_window_sink(store, *, bus=None, **row_kw):
                 )
                 if evs:
                     bus.publish(evs)
+        if lineage is not None and windows:
+            # AFTER the insert: the visibility lag is "row queryable",
+            # not "sink called" (partial snapshots never insert here,
+            # and must never masquerade as post-flush visibility)
+            lineage.note_store_insert(
+                [(getattr(f, "interval", 0) or lineage.interval,
+                  f.window_idx)
+                 for f in windows if not getattr(f, "partial", False)]
+            )
+
+    return sink
+
+
+LIVE_METRIC_WINDOW_ROWS = "deepflow_window_rows"
+
+
+def docbatch_window_sink(store, *, interval: int = 1,
+                         metric: str = LIVE_METRIC_WINDOW_ROWS,
+                         bus=None, lineage=None):
+    """→ callable(outputs) for CLOSED windows that arrive as writer
+    DocBatches (RollupPipeline.ingest / ShardedWindowManager.ingest /
+    pop_tier_docbatches): one summary row per window lands in
+    deepflow_system (time = window start, labels {window, tier}, value
+    = row count) — the minimal "this window is queryable" insert.
+    Outputs may be DocBatches or (tier_interval_s, DocBatch) pairs
+    (the cascade shape). With `lineage` set (ISSUE 13) each window's
+    store.insert hop + VISIBILITY freshness lag anchor AFTER the
+    insert; with `bus` set one WindowClosed/TierClosed batch publishes
+    after it (the r15 contract)."""
+    import contextlib
+
+    ensure_system_table(store)
+
+    def sink(outputs) -> None:
+        rows = []
+        items = []
+        events = []
+        for o in outputs:
+            iv, db = o if isinstance(o, tuple) else (interval, o)
+            if db.timestamp.shape[0] == 0:
+                continue
+            w = int(db.timestamp[0]) // iv
+            rows.append((w * iv, metric,
+                         {"window": str(w), "tier": f"{iv}s"},
+                         float(db.timestamp.shape[0])))
+            items.append((iv, w))
+            if bus is not None:
+                from ..querier.events import TierClosed, WindowClosed
+
+                events.append(
+                    WindowClosed(DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE,
+                                 w * iv, iv)
+                    if iv <= interval else
+                    TierClosed(DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE,
+                               w * iv, iv)
+                )
+        if not rows:
+            return
+        with (bus.batch() if bus is not None else contextlib.nullcontext()):
+            store.insert(
+                DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE,
+                sketch_rows_to_columns(rows),
+            )
+            if events and bus is not None:
+                bus.publish(events)
+        if lineage is not None:
+            # AFTER the insert — visibility means "row queryable now"
+            lineage.note_store_insert(items)
 
     return sink
 

@@ -760,6 +760,11 @@ class ShardedWindowManager:
         self.tracer = tracer if tracer is not None else SpanTracer(
             service="deepflow_tpu.sharded_pipeline"
         )
+        # window lineage plane (ISSUE 13): optional per-window hop
+        # recorder — host wall stamps only, zero new device fetches
+        # (the sharded path computes its window spans from the host
+        # timestamp arrays it already gates on)
+        self.lineage = None
         self._stats_srcs = [
             register_countable(
                 "tpu_sharded_pipeline", self, devices=str(pipe.n_devices)
@@ -843,6 +848,11 @@ class ShardedWindowManager:
             "snapshot_reads": self.snapshot_reads,
             "snapshot_bytes": self.snapshot_bytes,
         }
+
+    def attach_lineage(self, tracker) -> None:
+        """Wire a tracing/lineage.LineageTracker (the single-chip
+        WindowManager.attach_lineage twin)."""
+        self.lineage = tracker
 
     def pop_closed_sketches(self) -> list:
         """Drain the host-merged closed WindowSketchBlocks (window
@@ -1093,6 +1103,11 @@ class ShardedWindowManager:
         flushed = self._group_rows_by_window(per_dev, self.interval)
         for db in flushed:
             self.total_flushed += db.size
+        if self.lineage is not None and flushed:
+            self.lineage.note_flush_windows(
+                [(int(db.timestamp[0]) // self.interval, db.size)
+                 for db in flushed]
+            )
         return flushed
 
     def _group_rows_by_window(self, per_dev, interval: int):
@@ -1148,6 +1163,11 @@ class ShardedWindowManager:
             ]
             batches = self._group_rows_by_window(per_dev, interval)
             self.tier_windows_flushed += len(batches)
+            if self.lineage is not None and batches:
+                self.lineage.note_tier_windows(
+                    [(interval, int(db.timestamp[0]) // interval, db.size)
+                     for db in batches]
+                )
             self.tier_windows_dropped += hold_blocks(
                 self.tier_flushed, [(interval, db) for db in batches],
                 self.max_held_tier_windows,
@@ -1215,6 +1235,10 @@ class ShardedWindowManager:
             snap = self._read_open_snapshot(now)
         self.snapshot_seq += 1
         snap.seq = self.snapshot_seq
+        if self.lineage is not None and snap.windows:
+            self.lineage.note_snapshot(
+                [(w.window_idx, w.count) for w in snap.windows]
+            )
         self._snapshot_cache = snap
         return snap
 
@@ -1377,6 +1401,8 @@ class ShardedWindowManager:
         def on_retry(_attempt, _exc):
             self.dispatch_retries += 1
 
+        lin = self.lineage
+        d0 = lin.clock() if lin is not None else 0.0
         with self.tracer.span(SPAN_INGEST_DISPATCH):
             # admission-time-only classification: the step donates its
             # buffers, so a mid-flight UNAVAILABLE/ABORTED must NOT
@@ -1385,6 +1411,16 @@ class ShardedWindowManager:
                 dispatch_once, self.retry_policy, on_retry=on_retry,
                 rng=self._retry_rng, classify=is_dispatch_transient,
             )
+        if lin is not None:
+            # bind this batch's window span (ts_np is already host —
+            # the sharded gate computed it above, no transfer)
+            live = valid_np & ~late if n_late else valid_np
+            span = None
+            if live.any():
+                ts_live = ts_np[live]
+                span = (int(ts_live.min()) // self.interval,
+                        int(ts_live.max()) // self.interval)
+            lin.note_dispatch(span, d0)
         self.fill += rows_per_device
 
         flushed = []
@@ -1401,6 +1437,10 @@ class ShardedWindowManager:
                 close_us + int((time.perf_counter() - t0) * 1e6),
                 start_s=adv_wall,
             )
+            if lin is not None:
+                # sharded advances are decided host-side pre-dispatch:
+                # the dispatch stamp above is the derived time base
+                lin.note_advance(self.start_window, new_start, (d0, d0))
             with self.tracer.span(SPAN_FLUSH_DRAIN):
                 flushed = self._drain_range(self.start_window, new_start)
             self.start_window = new_start

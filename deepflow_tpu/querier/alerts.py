@@ -121,6 +121,105 @@ _SEVERITY = {STATE_INACTIVE: 0, STATE_RESOLVED: 1, STATE_PENDING: 2,
              STATE_FIRING: 3}
 
 
+# -- rule persistence (ISSUE 13 satellite / ROADMAP r15 leftover) --------
+#
+# Rules serialize to/from plain mappings so a YAML or JSON config file
+# round-trips an engine's rule set across restarts. Parsing is LOUD:
+# a malformed rule raises ValueError naming the entry and the field —
+# a typo'd comparator must fail the boot, not silently drop the page.
+
+_RULE_REQUIRED = ("name", "query", "comparator", "threshold")
+_RULE_OPTIONAL = {
+    "for_s": int, "engine": str, "db": str, "table": str,
+    "lookback_s": int, "labels": None,
+}
+
+
+def rule_to_dict(rule: AlertRule) -> dict:
+    d = dataclasses.asdict(rule)
+    d["labels"] = dict(rule.labels)
+    return d
+
+
+def rule_from_dict(d, *, where: str = "rule") -> AlertRule:
+    if not isinstance(d, dict):
+        raise ValueError(f"{where}: expected a mapping, got {type(d).__name__}")
+    unknown = set(d) - set(_RULE_REQUIRED) - set(_RULE_OPTIONAL)
+    if unknown:
+        raise ValueError(f"{where}: unknown keys {sorted(unknown)}")
+    for k in _RULE_REQUIRED:
+        if k not in d:
+            raise ValueError(f"{where}: missing required key {k!r}")
+    kw = dict(d)
+    try:
+        kw["threshold"] = float(kw["threshold"])
+        for k in ("for_s", "lookback_s"):
+            if k in kw:
+                kw[k] = int(kw[k])
+        labels = kw.pop("labels", None)
+        if labels is not None:
+            if not isinstance(labels, dict):
+                raise ValueError("labels must be a mapping")
+            kw["labels"] = tuple(sorted(
+                (str(k), str(v)) for k, v in labels.items()
+            ))
+        rule = AlertRule(**kw)
+    except (TypeError, ValueError) as exc:
+        raise ValueError(f"{where}: {exc}") from exc
+    return rule
+
+
+def load_rules_file(path) -> list[AlertRule]:
+    """Parse a YAML/JSON rules file → validated AlertRules. The file is
+    either a list of rule mappings or {"rules": [...]}; EVERY rule is
+    validated before any is returned (atomic — a malformed entry fails
+    the whole load loudly)."""
+    import json
+    from pathlib import Path
+
+    import yaml
+
+    p = Path(path)
+    text = p.read_text()
+    try:
+        data = (json.loads(text) if p.suffix == ".json"
+                else yaml.safe_load(text))
+    except Exception as exc:
+        raise ValueError(f"alert rules file {p}: unparseable: {exc}") from exc
+    if isinstance(data, dict):
+        data = data.get("rules", None)
+    if not isinstance(data, list):
+        raise ValueError(
+            f"alert rules file {p}: expected a list of rules (or a "
+            "mapping with a 'rules' list)"
+        )
+    rules = [
+        rule_from_dict(d, where=f"{p.name} rule #{i}")
+        for i, d in enumerate(data)
+    ]
+    names = [r.name for r in rules]
+    dupes = {n for n in names if names.count(n) > 1}
+    if dupes:
+        raise ValueError(f"alert rules file {p}: duplicate names {sorted(dupes)}")
+    return rules
+
+
+def save_rules_file(path, rules: list[AlertRule]) -> None:
+    """Write rules as YAML (or JSON for a .json path) — the exact shape
+    `load_rules_file` reads back."""
+    import json
+    from pathlib import Path
+
+    import yaml
+
+    p = Path(path)
+    doc = {"rules": [rule_to_dict(r) for r in rules]}
+    if p.suffix == ".json":
+        p.write_text(json.dumps(doc, indent=2))
+    else:
+        p.write_text(yaml.safe_dump(doc, sort_keys=False))
+
+
 class _SeriesState:
     """One label set's state machine (Prometheus keys alert state by
     series, not by rule)."""
@@ -308,6 +407,40 @@ class AlertEngine:
     def remove_rule(self, name: str) -> None:
         with self._lock:
             self._rules.pop(name, None)
+
+    # -- persistence (ISSUE 13 satellite: rules survive a restart) --------
+    def save_rules(self, path) -> int:
+        """Serialize every registered rule to a YAML/JSON file (shape:
+        {"rules": [...]}). Returns the rule count. Per-series STATES are
+        deliberately not persisted: they rebuild from evaluations after
+        a restart (the for-ladder restarts from the next breach — a
+        restart must not resurrect a stale pager state)."""
+        with self._lock:
+            rules = [r for r, _ in self._rules.values()]
+        save_rules_file(path, rules)
+        return len(rules)
+
+    def load_rules(self, path, *, replace: bool = False) -> int:
+        """Load + register rules from a YAML/JSON file. The WHOLE file
+        validates before any rule registers (atomic); malformed entries
+        raise ValueError naming the entry and field. With
+        `replace=False` (default) a name collision with a live rule is
+        an error — silently shadowing an active pager rule is worse
+        than failing the load. Each loaded rule starts with FRESH
+        per-series states; the next evaluations rebuild them."""
+        rules = load_rules_file(path)
+        with self._lock:
+            if not replace:
+                clash = [r.name for r in rules if r.name in self._rules]
+                if clash:
+                    raise ValueError(
+                        f"alert rules file {path}: rules already "
+                        f"registered: {clash} (load_rules(replace=True) "
+                        "to replace them)"
+                    )
+            for r in rules:
+                self._rules[r.name] = (r, _RuleState())
+        return len(rules)
 
     def add_sink(self, fn, *, name: str = "?") -> _Sink:
         s = _Sink(fn, name)

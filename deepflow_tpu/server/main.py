@@ -154,6 +154,11 @@ class Server:
             self.store, bus=self.event_bus, name="server"
         )
         self.alerts = AlertEngine(self.store, bus=self.event_bus, name="server")
+        if cfg.alert_rules:
+            # rule persistence (ISSUE 13 satellite): alert rules load
+            # from the config-named file at boot — a malformed rule
+            # fails the boot loudly rather than dropping the page
+            self.alerts.load_rules(cfg.alert_rules)
         # device profiling plane (ISSUE 12): each collector tick that
         # lands profiling rows publishes a ProfileSnapshot, so standing
         # queries / span-latency alert rules over deepflow_system
@@ -306,6 +311,18 @@ class Server:
         from ..tracing.query import query_trace
 
         return query_trace(self.store, trace_id, org=org)
+
+    def query_window_trace(self, window_idx: int, *, interval: int = 1,
+                           service: str | None = None, org: int = 1):
+        """Window lineage plane (ISSUE 13): the assembled trace tree of
+        one pipeline window — exported spans from the store when
+        present, else live from a registered LineageTracker."""
+        from ..tracing.lineage import DEFAULT_SERVICE, query_window_trace
+
+        return query_window_trace(
+            self.store, window_idx, interval=interval,
+            service=service or DEFAULT_SERVICE, org=org,
+        )
 
     def trace_map(self, time_range=None, org: int = 1):
         from ..tracing.query import trace_map

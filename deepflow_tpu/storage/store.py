@@ -134,6 +134,13 @@ class ColumnarStore:
         # QueryEventBus so a window close push-invalidates standing
         # queries the instant its flushed rows land
         self._mutation_hook = None
+        # window lineage plane (ISSUE 13): optional scan hooks, called
+        # (db, table, time_range) after every scan resolves its table —
+        # tracing/lineage.connect_store_reads marks a flushed window's
+        # first query (query.first hop) from here. A LIST (unlike the
+        # single mutation hook): multiple trackers may watch different
+        # tables of one store.
+        self._scan_hooks: list = []
         if self.root is not None:
             self.root.mkdir(parents=True, exist_ok=True)
             self._load_existing()
@@ -256,6 +263,26 @@ class ColumnarStore:
                 db, table, exc_info=True,
             )
 
+    def add_scan_hook(self, hook) -> None:
+        """`hook(db, table, time_range)` fires after every successful
+        scan (exceptions contained — observability must never fail a
+        read). The lineage plane's query.first seam (ISSUE 13)."""
+        self._scan_hooks.append(hook)
+
+    def remove_scan_hook(self, hook) -> None:
+        if hook in self._scan_hooks:
+            self._scan_hooks.remove(hook)
+
+    def _notify_scan(self, db: str, table: str, time_range) -> None:
+        for hook in list(self._scan_hooks):
+            try:
+                hook(db, table, time_range)
+            except Exception:
+                logging.getLogger(__name__).debug(
+                    "store scan hook failed for %s.%s (contained)",
+                    db, table, exc_info=True,
+                )
+
     def scan(
         self,
         db: str,
@@ -298,9 +325,14 @@ class ColumnarStore:
         empty = {
             c.name: np.empty(0, np.dtype(c.dtype)) for c in s.columns if c.name in read
         }
-        return {
+        out = {
             nm: (np.concatenate(cols[nm]) if cols[nm] else empty[nm]) for nm in names
         }
+        if self._scan_hooks:
+            # AFTER the read completed — a failed scan must not mark a
+            # window as queried (add_scan_hook's contract)
+            self._notify_scan(db, table, time_range)
+        return out
 
     def row_count(self, db: str, table: str) -> int:
         t = self._get(db, table)

@@ -85,6 +85,11 @@ class ServerConfig:
     # "data_sources": [table prefixes]} — built by
     # server.main.build_exporters at boot
     exporters: tuple = ()
+    # path to a YAML/JSON alert-rules file (querier/alerts.py
+    # save_rules/load_rules shape) loaded at boot — rules survive a
+    # restart; a malformed file fails the boot LOUDLY (ISSUE 13
+    # satellite / ROADMAP r15 leftover)
+    alert_rules: str = ""
 
 
 def _overlay(cls, defaults, data: dict[str, Any], path: str, unknown: list[str]):

@@ -1,0 +1,950 @@
+"""Window lineage tracing + data-freshness plane (ISSUE 13).
+
+The pins, in acceptance order:
+
+  * a closed window's trace tree assembles via the EXISTING
+    TraceTreeBuilder/assemble_trace with every hop from receiver frame
+    admission to store insert present and correctly parented (no
+    orphans, no pseudo-links) — the pipeline dogfooding the
+    reference's signature feature onto itself;
+  * the dogfood loop closes over the wire: lineage spans exported
+    through the OTLP exporter re-ingest via the integration collector
+    and assemble to the SAME tree shape;
+  * `tpu_freshness_*` lag lanes are PINNED against an oracle computed
+    from the flushed stream's own timestamps + an injected clock —
+    under stats_ring=4, async_drain, AND sharded (2 devices);
+  * partial (live-snapshot) reads land in a DISTINCT lane from
+    post-flush visibility;
+  * the lanes answer via SQL AND PromQL, and a visibility-lag alert
+    rule fires end-to-end through the r15 engine;
+  * alert rules persist to YAML/JSON and reload (satellite): states
+    rebuild from evaluations, malformed files fail loudly.
+"""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deepflow_tpu.aggregator.pipeline import L4Pipeline, PipelineConfig
+from deepflow_tpu.aggregator.window import WindowConfig
+from deepflow_tpu.datamodel.batch import FlowBatch
+from deepflow_tpu.ingest.replay import SyntheticFlowGen
+from deepflow_tpu.storage.store import ColumnarStore
+from deepflow_tpu.tracing.lineage import (
+    HOP_FEEDER_PUMP,
+    HOP_FLUSH_DRAIN,
+    HOP_INGEST_DISPATCH,
+    HOP_JOURNAL_APPEND,
+    HOP_QUERY_FIRST,
+    HOP_QUERY_SNAPSHOT,
+    HOP_RECEIVER_ADMIT,
+    HOP_STORE_INSERT,
+    HOP_UPLOAD_STAGE,
+    HOP_WINDOW_ADVANCE,
+    FreshnessTracker,
+    LineageTracker,
+    connect_store_reads,
+    hop_span_id,
+    query_window_trace,
+    window_trace_id,
+)
+
+T0 = 1_700_000_000
+
+
+class _FakeClock:
+    """Frozen injectable clock: every stamp taken while `t` holds a
+    value records EXACTLY that value, so lag oracles are equalities,
+    not tolerances."""
+
+    def __init__(self, t: float):
+        self.t = float(t)
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def _tracker(clock=None, **kw):
+    fr = FreshnessTracker(autoregister=False)
+    lin = LineageTracker(
+        "tpu.pipeline", 1, freshness=fr,
+        **({"clock": clock} if clock is not None else {}), **kw,
+    )
+    return lin, fr
+
+
+# ---------------------------------------------------------------------------
+# trace ids
+
+
+def test_window_trace_ids_deterministic_and_distinct():
+    a = window_trace_id("tpu.pipeline", T0, 1)
+    assert a == window_trace_id("tpu.pipeline", T0, 1)
+    assert len(a) == 32 and int(a, 16) >= 0
+    # tier and service both fold into the id — a 1m tier window never
+    # collides with the 1s window of the same index
+    assert a != window_trace_id("tpu.pipeline", T0, 60)
+    assert a != window_trace_id("other", T0, 1)
+    assert a.endswith(f"{T0:016x}")
+    s = hop_span_id(a, HOP_FLUSH_DRAIN)
+    assert s == hop_span_id(a, HOP_FLUSH_DRAIN) and len(s) == 16
+    assert s != hop_span_id(a, HOP_INGEST_DISPATCH)
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance pin: full-hop tree through the real stack
+
+
+def _full_hop_stack(tmp_path):
+    """receiver → feeder(+journal) → staged pipeline → store sink →
+    first query: the complete lineage chain, no network."""
+    from deepflow_tpu.feeder import (
+        FeederConfig,
+        FeederRuntime,
+        PipelineFeedSink,
+        encode_flowbatch_frames,
+    )
+    from deepflow_tpu.feeder.journal import FrameJournal
+    from deepflow_tpu.ingest.framing import HEADER_LEN, FlowHeader, MessageType
+    from deepflow_tpu.ingest.queues import PyOverwriteQueue
+    from deepflow_tpu.ingest.receiver import Receiver
+    from deepflow_tpu.integration.dfstats import (
+        DEEPFLOW_SYSTEM_DB,
+        DEEPFLOW_SYSTEM_TABLE,
+        docbatch_window_sink,
+    )
+
+    store = ColumnarStore()
+    lin, fr = _tracker()
+    pipe = L4Pipeline(PipelineConfig(
+        window=WindowConfig(capacity=1 << 12), batch_size=256,
+        bucket_sizes=(64, 128, 256),
+    ))
+    pipe.attach_lineage(lin)
+    q = PyOverwriteQueue(1 << 10)
+    recv = Receiver()
+    recv.lineage = lin
+    recv.register_handler(MessageType.TAGGEDFLOW, [q])
+    feeder = FeederRuntime(
+        [q], PipelineFeedSink(pipe), FeederConfig(frames_per_queue=8),
+        journal=FrameJournal(str(tmp_path / "lineage.journal")),
+        lineage=lin,
+    )
+    wsink = docbatch_window_sink(store, lineage=lin)
+    connect_store_reads(store, lin, DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE)
+
+    gen = SyntheticFlowGen(num_tuples=60, seed=3)
+    for i in range(10):
+        fb = gen.flow_batch(128, T0 + i)
+        for frame in encode_flowbatch_frames(fb, max_rows_per_frame=64):
+            recv._dispatch(FlowHeader.parse(frame[:HEADER_LEN]), frame, None)
+        out = feeder.pump()
+        if out:
+            wsink(out)
+    # the first query over the dogfood table closes the lineage
+    from deepflow_tpu.querier.engine import QueryEngine
+
+    res = QueryEngine(store, cache=False).execute(
+        "SELECT value FROM deepflow_system.deepflow_system "
+        "WHERE metric = 'deepflow_window_rows'"
+    )
+    assert res.rows > 0
+    return store, lin, fr
+
+
+FULL_CHAIN_HOPS = {
+    HOP_RECEIVER_ADMIT, HOP_FEEDER_PUMP, HOP_JOURNAL_APPEND,
+    HOP_UPLOAD_STAGE, HOP_INGEST_DISPATCH, HOP_WINDOW_ADVANCE,
+    HOP_FLUSH_DRAIN, HOP_STORE_INSERT, HOP_QUERY_FIRST,
+}
+
+#: hop → expected parent in the assembled tree (the full-chain case)
+FULL_CHAIN_PARENTS = {
+    HOP_RECEIVER_ADMIT: None,
+    HOP_FEEDER_PUMP: HOP_RECEIVER_ADMIT,
+    HOP_JOURNAL_APPEND: HOP_FEEDER_PUMP,
+    HOP_UPLOAD_STAGE: HOP_FEEDER_PUMP,
+    HOP_INGEST_DISPATCH: HOP_UPLOAD_STAGE,
+    HOP_WINDOW_ADVANCE: HOP_INGEST_DISPATCH,
+    HOP_FLUSH_DRAIN: HOP_WINDOW_ADVANCE,
+    HOP_STORE_INSERT: HOP_FLUSH_DRAIN,
+    HOP_QUERY_FIRST: HOP_STORE_INSERT,
+}
+
+
+def _assert_full_tree(tree):
+    assert tree is not None
+    nodes = tree["nodes"]
+    by_svc = {n["app_service"]: n for n in nodes}
+    assert set(by_svc) == FULL_CHAIN_HOPS
+    for hop, parent in FULL_CHAIN_PARENTS.items():
+        n = by_svc[hop]
+        # correctly parented, never a pseudo-link orphan
+        assert n["pseudo_link"] == 0, (hop, n)
+        if parent is None:
+            assert n["parent_node_index"] == -1 or n["level"] == 0
+        else:
+            assert nodes[n["parent_node_index"]]["app_service"] == parent, hop
+    assert by_svc[HOP_QUERY_FIRST]["level"] == 7  # the full chain depth
+
+
+def test_window_trace_tree_assembles_every_hop(tmp_path):
+    """ISSUE 13 acceptance: every hop from receiver admission to store
+    insert (and the first query) present + correctly parented, via the
+    repo's own TraceTreeBuilder over real exported l7 rows."""
+    from deepflow_tpu.tracing.builder import TraceTreeBuilder
+
+    store, lin, _fr = _full_hop_stack(tmp_path)
+    rec = lin.record_of(T0)
+    assert rec is not None and FULL_CHAIN_HOPS <= set(rec.hops)
+
+    builder = TraceTreeBuilder(
+        store, close_after_s=0.0, writer_args={"flush_interval_s": 0.01}
+    )
+    assert lin.export_store(store, builder=builder) > 0
+    builder.tick()
+    builder.flush()
+    # served from the trace_tree table the builder wrote
+    _assert_full_tree(query_window_trace(store, T0))
+    # the live (pre-export) fallback assembles the same hop set
+    live = lin.assemble(T0)
+    assert {n["app_service"] for n in live["nodes"]} == FULL_CHAIN_HOPS
+    # incremental export: nothing new → nothing re-exported
+    assert lin.drain_spans() == []
+
+
+def test_lineage_otlp_roundtrip_dogfood(tmp_path):
+    """Satellite: self-spans exported through the EXISTING OtlpExporter,
+    re-ingested via the integration collector's OTLP lane, assembled by
+    TraceTreeBuilder — the dogfood loop closed end-to-end over the
+    wire, tree shape pinned (parents + no orphans)."""
+    from deepflow_tpu.ingest.receiver import Receiver
+    from deepflow_tpu.integration.collector import IntegrationCollector
+    from deepflow_tpu.server.exporters import OtlpExporter
+    from deepflow_tpu.server.integration import IntegrationIngester
+    from deepflow_tpu.tracing.builder import TraceTreeBuilder
+
+    src_store, lin, _fr = _full_hop_stack(tmp_path)
+
+    recv = Receiver()
+    recv.start()
+    dst_store = ColumnarStore()
+    builder = TraceTreeBuilder(
+        dst_store, close_after_s=0.0, writer_args={"flush_interval_s": 0.01}
+    )
+    ing = IntegrationIngester(
+        recv, dst_store, writer_args={"flush_interval_s": 0.05},
+        trace_builder=builder,
+    )
+    col = IntegrationCollector([("127.0.0.1", recv.tcp_port)])
+    try:
+        exporter = OtlpExporter(
+            traces_url=f"http://127.0.0.1:{col.port}/v1/traces"
+        )
+        n = lin.export_otlp(exporter)
+        assert n >= len(FULL_CHAIN_HOPS)
+        assert exporter.get_counters()["errors"] == 0
+
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if builder.get_counters()["spans_in"] >= n:
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail(
+                f"collector round-trip stalled: "
+                f"{builder.get_counters()} vs {n} exported"
+            )
+        builder.tick()
+        builder.flush()
+        _assert_full_tree(
+            query_window_trace(dst_store, T0)
+        )
+    finally:
+        col.stop()
+        ing.stop()
+        builder.stop()
+        recv.stop()
+
+
+# ---------------------------------------------------------------------------
+# freshness oracles — lag values pinned against the flushed stream's
+# own timestamps + the injected clock
+
+
+def _run_freshness(pipe, lin, clk, *, batches=12, rows=128):
+    """Drive one window per batch with the clock frozen per call;
+    return {window: clock-at-flush} + {window: clock-at-cover} maps —
+    the oracle inputs, derived ONLY from the flushed stream and the
+    test's own clock schedule."""
+    gen = SyntheticFlowGen(num_tuples=60, seed=7)
+    covered_at: dict[int, float] = {}
+    flushed_at: dict[int, float] = {}
+    for i in range(batches):
+        clk.t = 2_000_000_000.0 + 10.0 * i
+        fb = gen.flow_batch(rows, T0 + i)
+        covered_at[T0 + i] = clk.t
+        for db in pipe.ingest(fb):
+            flushed_at[int(db.timestamp[0])] = clk.t
+    clk.t = 2_000_000_000.0 + 10.0 * batches
+    for db in pipe.drain():
+        flushed_at[int(db.timestamp[0])] = clk.t
+    return covered_at, flushed_at
+
+
+def _assert_lag_oracle(lin, fr, covered_at, flushed_at):
+    assert len(flushed_at) >= 8
+    last_w = None
+    for w, v_flush in flushed_at.items():
+        rec = lin.record_of(w)
+        assert rec is not None, w
+        # flush lag = clock at the call that RETURNED the window, minus
+        # the window's event-time end — exact equality, no tolerance
+        assert rec.lags["flush"] == v_flush - (w + 1), w
+        # ingest lag anchors on the dispatch that covered the window
+        assert rec.lags["ingest"] == covered_at[w] - (w + 1), w
+        last_w = max(w, last_w) if last_w is not None else w
+    # the Countable lane mirrors the LAST observation exactly
+    lanes = fr.get_counters()
+    assert lanes["1s.flush_samples"] == len(flushed_at)
+    assert lanes["1s.flush_lag_ms"] == round(
+        (flushed_at[last_w] - (last_w + 1)) * 1e3, 3
+    )
+    ex = fr.exemplars()["1s.flush"]
+    assert ex["window"] == last_w
+    assert ex["trace_id"] == window_trace_id("tpu.pipeline", last_w, 1)
+
+
+def test_freshness_lag_oracle_stats_ring4():
+    clk = _FakeClock(2_000_000_000.0)
+    lin, fr = _tracker(clock=clk)
+    pipe = L4Pipeline(PipelineConfig(
+        window=WindowConfig(capacity=1 << 12, stats_ring=4), batch_size=256,
+    ))
+    pipe.attach_lineage(lin)
+    covered_at, flushed_at = _run_freshness(pipe, lin, clk)
+    _assert_lag_oracle(lin, fr, covered_at, flushed_at)
+    # the K-ring defers discovery: at least one window must have
+    # flushed at a LATER clock value than its covering dispatch — the
+    # lag lanes see the deferral, not an idealized zero
+    assert any(flushed_at[w] > covered_at[w] for w in flushed_at)
+
+
+def test_freshness_lag_oracle_async_drain():
+    clk = _FakeClock(2_000_000_000.0)
+    lin, fr = _tracker(clock=clk)
+    pipe = L4Pipeline(PipelineConfig(
+        window=WindowConfig(capacity=1 << 12, async_drain=True),
+        batch_size=256,
+    ))
+    pipe.attach_lineage(lin)
+    covered_at, flushed_at = _run_freshness(pipe, lin, clk)
+    _assert_lag_oracle(lin, fr, covered_at, flushed_at)
+
+
+def test_freshness_sharded_two_devices():
+    """ISSUE 13 satellite: the sharded twin records dispatch/advance/
+    flush hops and the same oracle-exact lag lanes, 2 devices."""
+    from deepflow_tpu.integration.dfstats import docbatch_window_sink
+    from deepflow_tpu.parallel.mesh import make_mesh
+    from deepflow_tpu.parallel.sharded import (
+        ShardedConfig,
+        ShardedPipeline,
+        ShardedWindowManager,
+    )
+
+    clk = _FakeClock(2_000_000_000.0)
+    lin, fr = _tracker(clock=clk)
+    mesh = make_mesh(2, n_hosts=1)
+    pipe = ShardedPipeline(mesh, ShardedConfig(
+        capacity_per_device=1 << 11, num_services=16, hll_precision=8,
+    ))
+    swm = ShardedWindowManager(pipe)
+    swm.attach_lineage(lin)
+    store = ColumnarStore()
+    wsink = docbatch_window_sink(store, lineage=lin)
+
+    gen = SyntheticFlowGen(num_tuples=60, seed=9)
+    covered_at, flushed_at, insert_at = {}, {}, {}
+    for i in range(8):
+        clk.t = 2_000_000_000.0 + 10.0 * i
+        fb = gen.flow_batch(256, T0 + i)
+        covered_at[T0 + i] = clk.t
+        out = swm.ingest(fb.tags, fb.meters, fb.valid)
+        for db in out:
+            flushed_at[int(db.timestamp[0])] = clk.t
+        if out:
+            clk.t += 1.0
+            wsink(out)
+            for db in out:
+                insert_at[int(db.timestamp[0])] = clk.t
+    assert len(flushed_at) >= 4
+    for w, v in flushed_at.items():
+        rec = lin.record_of(w)
+        assert rec is not None
+        assert {HOP_INGEST_DISPATCH, HOP_WINDOW_ADVANCE,
+                HOP_FLUSH_DRAIN} <= set(rec.hops)
+        assert rec.lags["flush"] == v - (w + 1)
+        assert rec.lags["ingest"] == covered_at[w] - (w + 1)
+        assert rec.lags["visibility"] == insert_at[w] - (w + 1)
+        assert HOP_STORE_INSERT in rec.hops
+    lanes = fr.get_counters()
+    assert lanes["1s.visibility_samples"] == len(insert_at)
+
+
+def test_partial_snapshot_lane_distinct_from_visibility():
+    """A live-snapshot read of a still-open window lands in the
+    `partial` lane (anchored on window START), never in `visibility` —
+    a dashboard can always tell a partial answer from a flushed one."""
+    clk = _FakeClock(2_000_000_000.0)
+    lin, fr = _tracker(clock=clk)
+    pipe = L4Pipeline(PipelineConfig(
+        window=WindowConfig(capacity=1 << 12, min_snapshot_interval=0.0),
+        batch_size=256,
+    ))
+    pipe.attach_lineage(lin)
+    gen = SyntheticFlowGen(num_tuples=40, seed=5)
+    pipe.ingest(FlowBatch.from_records(gen.records(96, T0)))
+    clk.t = 2_000_000_005.0
+    snap = pipe.snapshot_open(force=True)
+    assert snap.windows and all(w.partial for w in snap.windows)
+    open_w = snap.windows[-1].window_idx
+    rec = lin.record_of(open_w)
+    assert HOP_QUERY_SNAPSHOT in rec.hops
+    assert HOP_STORE_INSERT not in rec.hops
+    # partial anchors on the window START (the window has no end yet)
+    assert rec.lags["partial"] == clk.t - open_w * 1
+    lanes = fr.get_counters()
+    assert lanes["1s.partial_samples"] >= 1
+    assert "1s.visibility_samples" not in lanes  # nothing inserted yet
+
+
+def test_cascade_tier_lineage_and_lag():
+    """Cascade tier closes get their own trace (tier interval in the
+    id) + the `cascade` lag lane keyed by the TIER window's end."""
+    from deepflow_tpu.aggregator.cascade import CascadeConfig
+
+    clk = _FakeClock(2_000_000_000.0)
+    lin, fr = _tracker(clock=clk)
+    pipe = L4Pipeline(PipelineConfig(
+        window=WindowConfig(
+            capacity=1 << 12,
+            cascade=CascadeConfig(intervals=(60,), capacity=1 << 12),
+        ),
+        batch_size=256,
+    ))
+    pipe.attach_lineage(lin)
+    gen = SyntheticFlowGen(num_tuples=40, seed=13)
+    base = (T0 // 60) * 60
+    tier_at = {}
+    for i, t in enumerate((base, base + 30, base + 61, base + 70, base + 125)):
+        clk.t = 2_000_000_000.0 + 10.0 * i
+        pipe.ingest(gen.flow_batch(128, t))
+        for iv, _db in pipe.pop_tier_docbatches():
+            assert iv == 60
+    clk.t = 2_000_000_100.0
+    pipe.drain()
+    tiers = pipe.pop_tier_docbatches()
+    minute_w = base // 60
+    rec = lin.record_of(minute_w, interval=60)
+    assert rec is not None
+    from deepflow_tpu.tracing.lineage import HOP_CASCADE_CLOSE
+
+    assert HOP_CASCADE_CLOSE in rec.hops
+    assert rec.lags["cascade"] == pytest.approx(
+        rec.hops[HOP_CASCADE_CLOSE].end_s - (minute_w + 1) * 60
+    )
+    assert "60s.cascade_samples" in fr.get_counters()
+    # tier trace id ≠ base trace id of the same index
+    assert lin.trace_id_of(minute_w, 60) != lin.trace_id_of(minute_w, 1)
+    assert tiers or True  # drained above mid-run or at the end
+
+
+# ---------------------------------------------------------------------------
+# SQL + PromQL + alert e2e
+
+
+def test_freshness_lanes_sql_promql_and_alert_fires():
+    """The lanes dogfood into deepflow_system (per-tier Countable with
+    a `tier` label), answer via SQL AND PromQL, and a visibility-lag
+    rule fires END TO END through the r15 push engine — evaluation
+    triggered by the dogfood insert's own StoreMutation event."""
+    from deepflow_tpu.integration.dfstats import (
+        docbatch_window_sink,
+        system_sink,
+    )
+    from deepflow_tpu.querier.alerts import STATE_FIRING, AlertEngine, AlertRule
+    from deepflow_tpu.querier.events import QueryEventBus, connect_store_events
+    from deepflow_tpu.querier.promql import query_instant
+    from deepflow_tpu.utils.stats import StatsCollector
+
+    store = ColumnarStore()
+    col = StatsCollector()
+    fr = FreshnessTracker(autoregister=True, collector=col)
+    lin = LineageTracker("tpu.pipeline", 1, freshness=fr)
+    pipe = L4Pipeline(PipelineConfig(
+        window=WindowConfig(capacity=1 << 12), batch_size=256,
+    ))
+    pipe.attach_lineage(lin)
+    wsink = docbatch_window_sink(store, lineage=lin)
+    bus = QueryEventBus(name="lineage-test")
+    connect_store_events(store, bus)
+    engine = AlertEngine(store, bus=bus, name="lineage", log_sink=False)
+    fired = []
+    engine.add_sink(fired.append, name="capture")
+    engine.add_rule(AlertRule(
+        name="visibility_lag_high",
+        query="tpu_freshness_visibility_lag_ms",
+        comparator=">", threshold=1000.0, for_s=0,
+    ))
+    gen = SyntheticFlowGen(num_tuples=40, seed=21)
+    outs = []
+    for i in range(8):
+        outs += pipe.ingest(gen.flow_batch(128, T0 + i))
+    outs += pipe.drain()
+    wsink(outs)
+    assert outs
+
+    col.add_sink(system_sink(store))
+    now = int(time.time())
+    col.tick(now=now)  # lanes → deepflow_system; insert → bus → rule
+
+    # SQL
+    from deepflow_tpu.querier.engine import QueryEngine
+
+    res = QueryEngine(store, cache=False).execute(
+        "SELECT value FROM deepflow_system.deepflow_system "
+        "WHERE metric = 'tpu_freshness_visibility_lag_ms'"
+    )
+    assert res.rows >= 1
+    # PromQL (with the per-tier label)
+    rows = query_instant(
+        store, 'tpu_freshness_visibility_lag_ms{tier="1s"}', now,
+        db="deepflow_system", table="deepflow_system",
+    )
+    assert rows and rows[0]["value"] > 1000.0
+    # the rule fired through the event path (per-series state)
+    assert engine.state("visibility_lag_high") == STATE_FIRING
+    assert fired and fired[0]["state"] == STATE_FIRING
+    assert fired[0]["labels"].get("tier") == "1s"
+    engine.close()
+    lin.close()
+
+
+def test_rest_and_cli_window_trace(tmp_path):
+    """`GET /v1/trace/window/<id>` serves the lineage tree (the dfctl
+    `trace window` target) — live fallback, no export needed."""
+    from deepflow_tpu.server.main import Server
+    from deepflow_tpu.utils.config import load_config
+
+    cfg, _ = load_config({"receiver": {"tcp_port": 0, "udp_port": 0}})
+    srv = Server(cfg, exporters=[]).start()
+    try:
+        lin, _fr = _tracker()
+        pipe = L4Pipeline(PipelineConfig(
+            window=WindowConfig(capacity=1 << 12), batch_size=256,
+        ))
+        pipe.attach_lineage(lin)
+        gen = SyntheticFlowGen(num_tuples=30, seed=2)
+        for i in range(6):
+            pipe.ingest(gen.flow_batch(96, T0 + i))
+        pipe.drain()
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.rest.port}/v1/trace/window/{T0}"
+            "?interval=1&service=tpu.pipeline"
+        ) as r:
+            got = json.loads(r.read())
+        assert got["window"] == T0
+        assert got["trace_id"] == window_trace_id("tpu.pipeline", T0, 1)
+        hops = {n["app_service"] for n in got["nodes"]}
+        assert {HOP_INGEST_DISPATCH, HOP_WINDOW_ADVANCE,
+                HOP_FLUSH_DRAIN} <= hops
+        assert "freshness" in got
+        # unknown window → 404
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.rest.port}/v1/trace/window/12345"
+            )
+        assert ei.value.code == 404
+        lin.close()
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# spans.py lineage-context extension
+
+
+def test_span_tracer_carries_lineage_ids_through_otlp_export():
+    from deepflow_tpu.utils.spans import SpanTracer
+
+    tr = SpanTracer(service="t")
+    tid = window_trace_id("tpu.pipeline", T0, 1)
+    tr.record("window.advance", 123, trace_id=tid,
+              span_id=hop_span_id(tid, "window.advance"),
+              parent_span_id=hop_span_id(tid, "ingest.dispatch"),
+              window=f"{T0}@1s")
+    tr.record("stats.fetch", 7)  # a plain span keeps synthesized ids
+    got = {}
+
+    class _Exp:
+        def export(self, table, cols):
+            got[table] = cols
+
+    assert tr.export_otlp(_Exp()) == 2
+    cols = got["l7_flow_log"]
+    i = list(cols["endpoint"]).index(f"window.advance:{T0}@1s")
+    assert cols["trace_id"][i] == tid
+    assert cols["parent_span_id"][i] == hop_span_id(tid, "ingest.dispatch")
+    j = 1 - i
+    assert cols["trace_id"][j] != tid and cols["parent_span_id"][j] == ""
+
+
+# ---------------------------------------------------------------------------
+# alert rule persistence (satellite)
+
+
+def _rules():
+    from deepflow_tpu.querier.alerts import AlertRule
+
+    return [
+        AlertRule(name="lag", query="tpu_freshness_visibility_lag_ms",
+                  comparator=">", threshold=5.0, for_s=30,
+                  labels=(("severity", "page"),)),
+        AlertRule(name="shed", query="tpu_feeder_shed_records",
+                  comparator=">=", threshold=1.0, engine="promql",
+                  lookback_s=60),
+    ]
+
+
+@pytest.mark.parametrize("suffix", [".yaml", ".json"])
+def test_alert_rules_save_load_roundtrip(tmp_path, suffix):
+    from deepflow_tpu.querier.alerts import AlertEngine
+
+    store = ColumnarStore()
+    a = AlertEngine(store, name="a", log_sink=False)
+    for r in _rules():
+        a.add_rule(r)
+    path = tmp_path / f"rules{suffix}"
+    assert a.save_rules(path) == 2
+
+    b = AlertEngine(store, name="b", log_sink=False)
+    assert b.load_rules(path) == 2
+    assert [r["name"] for r in b.list_rules()] == ["lag", "shed"]
+    got = {r.name: r for r, _ in b._rules.values()}
+    for want in _rules():
+        assert got[want.name] == want  # frozen dataclass equality
+    # collision is loud unless replace=True
+    with pytest.raises(ValueError, match="already registered"):
+        b.load_rules(path)
+    assert b.load_rules(path, replace=True) == 2
+    a.close()
+    b.close()
+
+
+def test_alert_rules_malformed_file_fails_loudly(tmp_path):
+    from deepflow_tpu.querier.alerts import AlertEngine, load_rules_file
+
+    bad = tmp_path / "bad.yaml"
+    bad.write_text(
+        "rules:\n"
+        "  - name: ok\n    query: up\n    comparator: '>'\n    threshold: 1\n"
+        "  - name: broken\n    query: up\n    comparator: '~='\n"
+        "    threshold: 1\n"
+    )
+    with pytest.raises(ValueError, match=r"rule #1.*comparator"):
+        load_rules_file(bad)
+    # atomic: the engine registers NOTHING from a half-bad file
+    eng = AlertEngine(ColumnarStore(), name="c", log_sink=False)
+    with pytest.raises(ValueError):
+        eng.load_rules(bad)
+    assert eng.list_rules() == []
+    # unknown keys + missing keys + non-list shapes are all named
+    (tmp_path / "k.yaml").write_text(
+        "rules:\n  - name: x\n    query: up\n    comparator: '>'\n"
+        "    threshold: 1\n    zap: 2\n"
+    )
+    with pytest.raises(ValueError, match="unknown keys.*zap"):
+        load_rules_file(tmp_path / "k.yaml")
+    (tmp_path / "m.yaml").write_text("rules:\n  - query: up\n")
+    with pytest.raises(ValueError, match="missing required key 'name'"):
+        load_rules_file(tmp_path / "m.yaml")
+    (tmp_path / "s.yaml").write_text("just a string\n")
+    with pytest.raises(ValueError, match="expected a list"):
+        load_rules_file(tmp_path / "s.yaml")
+    eng.close()
+
+
+def test_alert_states_rebuild_after_restart(tmp_path):
+    """Per-series states are NOT persisted; after a reload the next
+    evaluation rebuilds the ladder cleanly — a firing condition at the
+    same data re-fires, a quiet one stays inactive."""
+    import numpy as np
+
+    from deepflow_tpu.integration.dfstats import (
+        DEEPFLOW_SYSTEM_DB,
+        DEEPFLOW_SYSTEM_TABLE,
+        ensure_system_table,
+    )
+    from deepflow_tpu.querier.alerts import STATE_FIRING, AlertEngine, AlertRule
+
+    store = ColumnarStore()
+    ensure_system_table(store)
+    t = int(time.time())
+    store.insert(DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE, {
+        "time": np.asarray([t], np.uint32),
+        "metric": np.asarray(["lag_ms"], object),
+        "labels": np.asarray(["tier=1s"], object),
+        "value": np.asarray([99.0], np.float64),
+    })
+    a = AlertEngine(store, name="a", log_sink=False)
+    a.add_rule(AlertRule(name="lag", query="lag_ms", comparator=">",
+                         threshold=10.0, for_s=0))
+    assert a.evaluate_rule("lag", now=t) == STATE_FIRING
+    path = tmp_path / "rules.yaml"
+    a.save_rules(path)
+    a.close()
+
+    b = AlertEngine(store, name="b", log_sink=False)
+    b.load_rules(path)
+    assert b.state("lag") == "inactive"  # fresh states after restart
+    assert b.evaluate_rule("lag", now=t) == STATE_FIRING  # rebuilt
+    ss = b.series_states("lag")
+    assert ss and ss[0]["state"] == STATE_FIRING
+    b.close()
+
+
+def test_server_config_alert_rules_knob(tmp_path):
+    """The config knob loads rules at boot; a malformed file fails the
+    boot loudly (never a silently ruleless pager)."""
+    from deepflow_tpu.querier.alerts import save_rules_file
+    from deepflow_tpu.server.main import Server
+    from deepflow_tpu.utils.config import load_config
+
+    path = tmp_path / "rules.yaml"
+    save_rules_file(path, _rules())
+    cfg, unknown = load_config({
+        "receiver": {"tcp_port": 0, "udp_port": 0},
+        "alert_rules": str(path),
+    })
+    assert not unknown
+    srv = Server(cfg, exporters=[]).start()
+    try:
+        assert {r["name"] for r in srv.alerts.list_rules()} == {"lag", "shed"}
+    finally:
+        srv.stop()
+
+    (tmp_path / "bad.yaml").write_text("rules:\n  - name: x\n")
+    cfg2, _ = load_config({
+        "receiver": {"tcp_port": 0, "udp_port": 0},
+        "alert_rules": str(tmp_path / "bad.yaml"),
+    })
+    with pytest.raises(ValueError, match="missing required key"):
+        Server(cfg2, exporters=[]).start()
+
+
+# ---------------------------------------------------------------------------
+# bounds
+
+
+def test_feederless_context_resets_per_dispatch():
+    """Review regression: with no feeder (no begin_pump), note_stage's
+    min-merge must NOT pin upload.stage's start at the first-ever
+    stage call — each dispatch consumes its context, so a late
+    window's upload hop never spans process uptime."""
+    clk = _FakeClock(1000.0)
+    lin, _fr = _tracker(clock=clk)
+    pipe = L4Pipeline(PipelineConfig(
+        window=WindowConfig(capacity=1 << 12), batch_size=256,
+    ))
+    pipe.attach_lineage(lin)
+    gen = SyntheticFlowGen(num_tuples=30, seed=4)
+    pipe.ingest(gen.flow_batch(96, T0))
+    # a long quiet gap, then a much later batch
+    clk.t = 5000.0
+    pipe.ingest(gen.flow_batch(96, T0 + 1))
+    rec = lin.record_of(T0 + 1)
+    stage = rec.hops[HOP_UPLOAD_STAGE]
+    assert stage.start_s >= 5000.0, (
+        "upload.stage leaked the first batch's context into a later "
+        f"window: start={stage.start_s}"
+    )
+    lin.close()
+
+
+def test_bad_frames_do_not_desync_admission_stamps(tmp_path):
+    """Review regression: a quarantined/bad frame consumes its
+    receiver admission stamp WITHOUT folding it into the context —
+    otherwise every later window's receiver.admit start drifts
+    monotonically staler (FIFO desync)."""
+    from deepflow_tpu.feeder import (
+        FeederConfig,
+        FeederRuntime,
+        PipelineFeedSink,
+        encode_flowbatch_frames,
+    )
+    from deepflow_tpu.ingest.framing import HEADER_LEN, FlowHeader, MessageType
+    from deepflow_tpu.ingest.queues import PyOverwriteQueue
+    from deepflow_tpu.ingest.receiver import Receiver
+
+    clk = _FakeClock(1000.0)
+    lin, _fr = _tracker(clock=clk)
+    pipe = L4Pipeline(PipelineConfig(
+        window=WindowConfig(capacity=1 << 12), batch_size=256,
+        bucket_sizes=(64, 128, 256),
+    ))
+    pipe.attach_lineage(lin)
+    q = PyOverwriteQueue(1 << 10)
+    recv = Receiver()
+    recv.lineage = lin
+    recv.register_handler(MessageType.TAGGEDFLOW, [q])
+    feeder = FeederRuntime(
+        [q], PipelineFeedSink(pipe), FeederConfig(frames_per_queue=8),
+        lineage=lin,
+    )
+    gen = SyntheticFlowGen(num_tuples=30, seed=6)
+
+    def send(frame):
+        recv._dispatch(FlowHeader.parse(frame[:HEADER_LEN]), frame, None)
+
+    good = encode_flowbatch_frames(gen.flow_batch(64, T0))[0]
+    # an old good frame admitted + pumped at t=1000
+    send(good)
+    feeder.pump()
+    # a burst of CORRUPT frames admitted at a stale time...
+    clk.t = 1100.0
+    for _ in range(5):
+        send(good[:HEADER_LEN] + b"\x00" * (len(good) - HEADER_LEN))
+    feeder.pump()
+    assert feeder.get_counters()["bad_frames"] == 5
+    # ...must not donate their stamps to a later good frame
+    clk.t = 9000.0
+    send(encode_flowbatch_frames(gen.flow_batch(64, T0 + 5))[0])
+    feeder.pump()
+    feeder.flush()  # dispatch the double-buffered staged batch
+    rec = lin.record_of(T0 + 5)
+    admit = rec.hops[HOP_RECEIVER_ADMIT]
+    assert admit.start_s >= 9000.0, (
+        f"stale stamp paired with a later frame: start={admit.start_s}"
+    )
+    assert lin.get_counters()["admit_stamps_pending"] == 0
+    lin.close()
+
+
+def test_drain_spans_never_duplicates_a_span_id():
+    """Review regression: the l7 lane is append-only and the tree
+    assemblers have no span-id dedup, so a hop that keeps merging
+    across drains must export exactly ONCE — open windows defer to
+    close, and post-export merges never re-emit."""
+    clk = _FakeClock(1000.0)
+    lin, _fr = _tracker(clock=clk)
+    pipe = L4Pipeline(PipelineConfig(
+        window=WindowConfig(capacity=1 << 12), batch_size=256,
+    ))
+    pipe.attach_lineage(lin)
+    gen = SyntheticFlowGen(num_tuples=30, seed=12)
+    seen: dict[tuple[str, str], int] = {}
+    for i in range(10):
+        clk.t = 1000.0 + i
+        pipe.ingest(gen.flow_batch(96, T0 + i))
+        # an every-batch consumer: drains interleave with merges
+        for r in lin.drain_spans():
+            seen[(r.trace_id, r.span_id)] = seen.get(
+                (r.trace_id, r.span_id), 0
+            ) + 1
+    pipe.drain()
+    for r in lin.drain_spans():
+        seen[(r.trace_id, r.span_id)] = seen.get((r.trace_id, r.span_id), 0) + 1
+    assert seen, "nothing exported"
+    dupes = {k: n for k, n in seen.items() if n > 1}
+    assert not dupes, f"duplicated span ids: {dupes}"
+    # every closed window DID export its pre-close hops
+    tid = window_trace_id("tpu.pipeline", T0, 1)
+    assert (tid, hop_span_id(tid, HOP_INGEST_DISPATCH)) in seen
+    assert (tid, hop_span_id(tid, HOP_FLUSH_DRAIN)) in seen
+    lin.close()
+
+
+def test_queue_overwrite_drops_admission_stamps():
+    """Review regression: frames the OverwriteQueue silently replaced
+    never reach the feeder — their admission stamps must be consumed
+    by the overwritten-counter delta, not donated to later frames."""
+    from deepflow_tpu.feeder import (
+        FeederConfig,
+        FeederRuntime,
+        PipelineFeedSink,
+        encode_flowbatch_frames,
+    )
+    from deepflow_tpu.ingest.framing import HEADER_LEN, FlowHeader, MessageType
+    from deepflow_tpu.ingest.queues import PyOverwriteQueue
+    from deepflow_tpu.ingest.receiver import Receiver
+
+    clk = _FakeClock(1000.0)
+    lin, _fr = _tracker(clock=clk)
+    pipe = L4Pipeline(PipelineConfig(
+        window=WindowConfig(capacity=1 << 12), batch_size=256,
+        bucket_sizes=(64, 128, 256),
+    ))
+    pipe.attach_lineage(lin)
+    q = PyOverwriteQueue(4)  # tiny: floods overwrite
+    recv = Receiver()
+    recv.lineage = lin
+    recv.register_handler(MessageType.TAGGEDFLOW, [q])
+    feeder = FeederRuntime(
+        [q], PipelineFeedSink(pipe), FeederConfig(frames_per_queue=8),
+        lineage=lin,
+    )
+    gen = SyntheticFlowGen(num_tuples=30, seed=14)
+    frame = encode_flowbatch_frames(gen.flow_batch(48, T0))[0]
+    for _ in range(12):  # 12 admits into a 4-deep queue → 8 overwrites
+        recv._dispatch(FlowHeader.parse(frame[:HEADER_LEN]), frame, None)
+    assert int(q.overwritten) > 0
+    feeder.pump()
+    feeder.flush()
+    # every stamp consumed: popped by a processed frame or dropped by
+    # the overwrite delta — nothing left to go stale
+    assert lin.get_counters()["admit_stamps_pending"] == 0
+    lin.close()
+
+
+def test_failed_scan_does_not_mark_query_first():
+    """Review regression: the scan hook fires AFTER a successful read
+    — a raising scan must not close a window's lineage."""
+    from deepflow_tpu.integration.dfstats import (
+        DEEPFLOW_SYSTEM_DB,
+        DEEPFLOW_SYSTEM_TABLE,
+        ensure_system_table,
+    )
+
+    clk = _FakeClock(1000.0)
+    lin, _fr = _tracker(clock=clk)
+    store = ColumnarStore()
+    ensure_system_table(store)
+    connect_store_reads(store, lin, DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE)
+    lin.note_flush_windows([(T0, 4)])
+    lin.note_store_insert([(1, T0)])
+    with pytest.raises(KeyError):
+        store.scan(DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE,
+                   columns=["no_such_column"])
+    assert HOP_QUERY_FIRST not in lin.record_of(T0).hops
+    store.scan(DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE)
+    assert HOP_QUERY_FIRST in lin.record_of(T0).hops
+    lin.close()
+
+
+def test_lineage_tracker_bounded_and_counted():
+    clk = _FakeClock(1000.0)
+    lin, _fr = _tracker(clock=clk, max_windows=8)
+    lin.note_flush_windows([(w, 1) for w in range(32)])
+    c = lin.get_counters()
+    assert c["windows_live"] == 8
+    assert c["windows_evicted"] == 24
+    # a corrupt-timestamp span binds only the clamped tail, counted
+    lin.note_dispatch((0, 10_000_000), 1000.0)
+    c = lin.get_counters()
+    assert c["bind_span_clamped"] == 1
+    assert c["windows_live"] <= 8
+    lin.close()
