@@ -2,10 +2,24 @@
 """Mesh-scaling rows for BASELINE config 5 — the r4 verdict's demand
 that c5 be a *mesh* statement, not a tunnel-latency measurement.
 
-Runs the sharded pipeline on a virtual CPU mesh at 1/2/4/8 devices
-(XLA_FLAGS=--xla_force_host_platform_device_count=8, the same
-environment dryrun_multichip validates), at a FIXED per-device batch
-(weak scaling, the pod-firehose shape), timing:
+Two recipes in one tool:
+
+**MESH_PROCS=N1,N2,... (ISSUE 14)** — the multi-HOST recipe: for each
+N, spawn N clean-env subprocesses (the dryrun_multichip pattern), each
+one host of an N-process `jax.distributed` deployment
+(MeshTopology.distributed, one shard group per process, fully-local
+data path) running the §14 feeder-shaped workload — frames → queues →
+FeederRuntime → ShardedFeedSink → windowed drains — against ITS group
+only (key-hash routing already steered the agents there; the routing
+itself is CI-pinned in tests/test_mesh_multiproc.py). Reports per-host
+and AGGREGATE rec/s per process count plus the distributed bring-up
+wall. Emits {"proc_rows": [...]} alongside (or instead of) the device
+rows; MESHBENCH_r01.json holds the committed snapshot.
+
+**Default (device) recipe** — the single-process virtual CPU mesh at
+1/2/4/8 devices (XLA_FLAGS=--xla_force_host_platform_device_count=8,
+the same environment dryrun_multichip validates), at a FIXED
+per-device batch (weak scaling, the pod-firehose shape), timing:
 
   * steady ingest cycles (step + amortized fold) — chained, no host
     round trip inside the loop; one measured fetch latency is
@@ -138,7 +152,196 @@ def run(n_dev: int, per_dev: int, iters: int, fold_mode: str = "full") -> dict:
     return row
 
 
+# ---------------------------------------------------------------------------
+# multi-process recipe (ISSUE 14)
+
+
+def _proc_body(spec: dict) -> None:
+    """One host of an N-process deployment (subprocess entry): real
+    `jax.distributed` bring-up at N>1, one shard group, the §14
+    feeder-shaped workload against it, one JSON result file."""
+    import time as _time
+
+    from deepflow_tpu.feeder import FeederConfig, encode_flowbatch_frames
+    from deepflow_tpu.ingest.queues import PyOverwriteQueue
+    from deepflow_tpu.parallel.topology import MeshTopology
+    from deepflow_tpu.parallel.sharded import ShardedWindowManager
+
+    nproc = spec["num_processes"]
+    pid = spec["process_id"]
+    t_init = _time.perf_counter()
+    if nproc > 1:
+        topo = MeshTopology.distributed(
+            spec["coordinator"], nproc, pid,
+            n_groups=nproc, devices_per_group=1,
+        )
+    else:
+        topo = MeshTopology.single(n_groups=1, devices_per_group=1)
+    init_s = _time.perf_counter() - t_init
+    group = topo.owned_groups()[0]
+
+    cfg = ShardedConfig(
+        capacity_per_device=1 << 13,
+        num_services=64,
+        hll_precision=8,
+        hist=LogHistSpec(bins=128, vmin=1.0, gamma=1.1),
+    )
+    wm = ShardedWindowManager(ShardedPipeline(topo, cfg, shard_group=group))
+    queues = [PyOverwriteQueue(1 << 12) for _ in range(2)]
+    buckets = (512, 1024, 2048)
+    feeder = wm.make_feeder(
+        queues, buckets, FeederConfig(frames_per_queue=16)
+    )
+
+    iters = spec["iters"]
+    t0s = 1_700_000_000
+    gen = SyntheticFlowGen(num_tuples=2000, seed=100 + pid)
+    # pre-encode every step's frames (the probe times fan-in + decode +
+    # coalesce + dispatch + windowed drains, not the generator); time
+    # advances every 4 steps so windows close through the fused drain
+    sizes = [buckets[i % len(buckets)] - (31 * i) % 128 for i in range(iters)]
+    steps = [
+        encode_flowbatch_frames(
+            gen.flow_batch(n, t0s + 10 + i // 4),
+            agent_id=pid * 64 + i, max_rows_per_frame=512,
+        )
+        for i, n in enumerate(sizes)
+    ]
+    # warm every bucket's compile path
+    for b in buckets:
+        for fr in encode_flowbatch_frames(
+            gen.flow_batch(b, t0s), max_rows_per_frame=512
+        ):
+            queues[0].put(fr)
+        feeder.pump()
+
+    f0 = feeder.get_counters()
+    docs = 0
+    start = _time.perf_counter()
+    for i, frames in enumerate(steps):
+        for j, fr in enumerate(frames):
+            queues[j % len(queues)].put(fr)
+        docs += sum(d.size for d in feeder.pump())
+    docs += sum(d.size for d in wm.drain())
+    elapsed = _time.perf_counter() - start
+    f1 = feeder.get_counters()
+    records = f1["records_out"] - f0["records_out"]
+    res = {
+        "process_id": pid,
+        "records": int(records),
+        "elapsed_s": round(elapsed, 4),
+        "rec_s": round(records / max(elapsed, 1e-9), 1),
+        "init_s": round(init_s, 3),
+        "flushed_docs": int(docs),
+        "host_fetches": wm.get_counters()["host_fetches"],
+    }
+    from pathlib import Path
+
+    from deepflow_tpu.parallel.hostproc import exit_after_barrier
+
+    Path(spec["out"]).write_text(json.dumps(res))
+    # shared done-file exit barrier (parallel/hostproc.py): process 0
+    # hosts the coordination service and must outlive its peers; skip
+    # the atexit shutdown barrier (results are already durable)
+    exit_after_barrier(Path(spec["out"]).parent, pid, nproc)
+
+
+def _spawn_proc_row(nproc: int, iters: int) -> dict:
+    """Spawn nproc clean-env hosts, aggregate their rates."""
+    import subprocess
+    import tempfile
+    from pathlib import Path
+
+    from deepflow_tpu.parallel.topology import free_coordinator_port
+
+    from deepflow_tpu.parallel.hostproc import clean_cpu_env
+
+    d = Path(tempfile.mkdtemp(prefix=f"meshprocs{nproc}-"))
+    coord = f"127.0.0.1:{free_coordinator_port()}"
+    here = os.path.abspath(__file__)
+    procs = []
+    for pid in range(nproc):
+        spec = {
+            "num_processes": nproc, "process_id": pid,
+            "coordinator": coord, "iters": iters,
+            "out": str(d / f"res.p{pid}.json"),
+        }
+        procs.append(subprocess.Popen(
+            [sys.executable, here, "--mesh-proc", json.dumps(spec)],
+            env=clean_cpu_env(1), stdout=subprocess.DEVNULL,
+            stderr=subprocess.PIPE, text=True,
+        ))
+    per_host = []
+    try:
+        for pid, p in enumerate(procs):
+            try:
+                _out, err = p.communicate(timeout=900)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                _out, err = p.communicate()
+                raise RuntimeError(
+                    f"mesh proc {pid}/{nproc} timed out:\n" + err[-2000:]
+                )
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"mesh proc {pid}/{nproc} rc={p.returncode}:\n"
+                    + err[-2000:]
+                )
+            per_host.append(
+                json.loads((d / f"res.p{pid}.json").read_text())
+            )
+    except Exception:
+        # never leak live jax.distributed children (a wedged process 0
+        # would also keep the coordinator port bound for the next row)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        raise
+    agg = round(sum(r["rec_s"] for r in per_host), 1)
+    return {
+        "n_processes": nproc,
+        "aggregate_rec_s": agg,
+        "per_host_rec_s": [r["rec_s"] for r in per_host],
+        "records": sum(r["records"] for r in per_host),
+        "init_s_max": max(r["init_s"] for r in per_host),
+        "host_fetches": [r["host_fetches"] for r in per_host],
+    }
+
+
+def run_procs(proc_counts: list[int], iters: int,
+              rows: list[dict] | None = None) -> list[dict]:
+    """Appends each completed row into `rows` AS IT LANDS, so a later
+    process count's failure still leaves the finished rows for the
+    partial record (the bench.py contract)."""
+    rows = [] if rows is None else rows
+    base = None
+    for n in proc_counts:
+        row = _spawn_proc_row(n, iters)
+        if base is None and row["n_processes"] == 1:
+            base = row["aggregate_rec_s"]
+        if base:
+            row["scale_vs_1proc"] = round(row["aggregate_rec_s"] / base, 2)
+        rows.append(row)
+    return rows
+
+
 def main():
+    proc_env = os.environ.get("MESH_PROCS", "")
+    if proc_env:
+        proc_counts = [int(p) for p in proc_env.split(",") if p]
+        iters = int(os.environ.get("MESHBENCH_ITERS", 48))
+        rows = []
+        try:
+            run_procs(proc_counts, iters, rows)
+            print(json.dumps({"proc_rows": rows}), flush=True)
+        except Exception as e:  # parseable partial, never a traceback
+            print(
+                json.dumps(
+                    {"proc_rows": rows, "partial": True, "error": repr(e)}
+                ),
+                flush=True,
+            )
+        return
     per_dev = int(os.environ.get("MESH_PER_DEV", 1 << 13))
     iters = int(os.environ.get("MESH_ITERS", 8))
     # fold-mode A/B (ISSUE 5): the windowed cadence's drain_ms is what
@@ -163,4 +366,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--mesh-proc":
+        _proc_body(json.loads(sys.argv[2]))
+    else:
+        main()

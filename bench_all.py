@@ -638,6 +638,36 @@ def config14(quick: bool):
          passive=rec["passive"], iters=rec["iters"])
 
 
+def config15(quick: bool):
+    """Multi-host mesh scale-out (ISSUE 14): N-process jax.distributed
+    deployments via bench/mesh_scaling.py MESH_PROCS — each host one
+    shard group, key-hash-routed agents, fully-local data path — the
+    aggregate rec/s statement the pod-scale ROADMAP item demanded
+    (protocol: PERF.md §23, committed numbers: MESHBENCH_r01.json;
+    acceptance: ≥1.7× aggregate at 2 processes)."""
+    import os
+    import subprocess
+
+    env = {**os.environ, "MESH_PROCS": "1,2" if quick else "1,2,4"}
+    if quick:
+        env["MESHBENCH_ITERS"] = "16"
+    out = subprocess.run(
+        [sys.executable, "bench/mesh_scaling.py"],
+        capture_output=True, text=True, timeout=1800, env=env,
+    )
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    if rec.get("partial"):
+        emit("c15_multihost_mesh", 0, "error", 0, error=rec.get("error"))
+        return
+    rows = rec["proc_rows"]
+    last = rows[-1]
+    emit("c15_multihost_mesh", last["aggregate_rec_s"], "records/s",
+         last.get("scale_vs_1proc", 0),
+         n_processes=last["n_processes"],
+         per_host_rec_s=last["per_host_rec_s"],
+         init_s_max=last["init_s_max"], rows=rows)
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--cpu", action="store_true")
@@ -645,7 +675,7 @@ def main():
     args = p.parse_args()
     for fn in (config1, config2, config3, config4, config5, config6, config7,
                config8, config9, config10, config11, config12, config13,
-               config14):
+               config14, config15):
         try:
             fn(args.quick)
         except Exception as e:  # one config must not kill the others

@@ -600,6 +600,14 @@ def save_sharded_state(swm, path: str | Path, *, extra_meta=None) -> list:
             "total_flushed": swm.total_flushed,
             "n_advances": swm.n_advances,
         }
+        # multi-host placement (ISSUE 14): the mesh topology this group
+        # was saved under — process_count × devices_per_group and the
+        # group index — so a restore onto the wrong host/topology fails
+        # loudly at load, not as a shape error deep in shard_map
+        topo = getattr(swm.pipe, "topology", None)
+        if topo is not None:
+            meta.update(topo.describe())
+            meta["shard_group"] = swm.pipe.shard_group
         meta.update(_sketch_meta(swm.sketches, c.sketch_config()))
         meta["sketch_ring"] = c.sketch_ring
         if swm._tier_ratios:
@@ -656,6 +664,37 @@ def restore_sharded_state(swm, path: str | Path):
             f"checkpoint {path} was saved on {meta['n_devices']} devices; "
             f"this mesh has {swm.pipe.n_devices} — per-device stashes "
             "cannot be re-split"
+        )
+    # multi-host mesh topology (ISSUE 14): device count × process count
+    # and group placement must match the restore topology exactly —
+    # loudly, instead of a shape error deep in shard_map (or worse, a
+    # group silently serving another host's keys)
+    topo = getattr(swm.pipe, "topology", None)
+    ck_pc = meta.get("process_count")
+    if topo is not None:
+        topo.validate_restore(meta, path)
+        ck_group = meta.get("shard_group")
+        if ck_group is not None and int(ck_group) != swm.pipe.shard_group:
+            # (group ownership itself is enforced at pipeline
+            # construction — group_mesh refuses remote groups)
+            raise ValueError(
+                f"checkpoint {path} holds shard group {ck_group} but this "
+                f"manager serves group {swm.pipe.shard_group} — restoring "
+                "it here would serve another group's key-hash range"
+            )
+    elif ck_pc is not None and (
+        int(ck_pc) > 1 or int(meta.get("n_groups", 1)) > 1
+    ):
+        # multi-process OR multi-group: either way the checkpoint holds
+        # one shard group's slice of a partitioned key space — a bare
+        # manager restoring it would silently serve the FULL key range
+        # with only that group's stashes
+        raise ValueError(
+            f"checkpoint {path} was saved under a sharded mesh topology "
+            f"({ck_pc} process(es), {meta.get('n_groups')} shard groups); "
+            "restoring into a topology-less manager would collapse the "
+            "key-hash placement — build the pipeline from a MeshTopology "
+            "(parallel/topology.py)"
         )
     t = TAG_SCHEMA.num_fields
     if meta["num_tags"] != t:

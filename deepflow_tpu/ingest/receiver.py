@@ -30,7 +30,8 @@ from .framing import (
 
 
 class AgentStatus:
-    __slots__ = ("agent_id", "org_id", "team_id", "addr", "first_seen", "last_seen", "frames", "bytes")
+    __slots__ = ("agent_id", "org_id", "team_id", "addr", "first_seen",
+                 "last_seen", "frames", "bytes", "route")
 
     def __init__(self, agent_id, org_id, team_id, addr):
         self.agent_id = agent_id
@@ -40,6 +41,13 @@ class AgentStatus:
         self.first_seen = self.last_seen = time.time()
         self.frames = 0
         self.bytes = 0
+        # key-hash routing cache (ISSUE 14): the (org, agent) → group
+        # map is pure, so it is computed once per agent per topology
+        # epoch instead of a numpy fingerprint fold per FRAME. ONE
+        # (epoch, group) tuple — epoch and group are never split
+        # across two stores, so a re-attach race cannot stamp a
+        # new-topology group with an old epoch
+        self.route: tuple | None = None
 
 
 class Receiver:
@@ -49,7 +57,9 @@ class Receiver:
         self.host = host
         self.tcp_port = tcp_port
         self.udp_port = udp_port
-        self._handlers: dict[int, list] = {}
+        # msg_type → {shard_group_or_None: [queues]} — the None slot is
+        # the ungrouped handler every pre-topology caller registers
+        self._handlers: dict[int, dict] = {}
         self._threads: list[threading.Thread] = []
         self._conn_threads: list[threading.Thread] = []
         self._stats_lock = threading.Lock()
@@ -67,8 +77,28 @@ class Receiver:
             "queue_closed": 0,
             "udp_frames": 0,
             "tcp_conns": 0,
+            # key-hash fan-in routing (ISSUE 14): frames whose shard
+            # group another process owns — counted and forwarded
+            # through the control-plane handoff, NEVER enqueued into a
+            # wrong-group handler (the data path never crosses hosts)
+            "frames_misrouted": 0,
+            "frames_handoff": 0,
+            "handoff_errors": 0,
         }
+        # multi-host fan-in (ISSUE 14): key-hash topology routing +
+        # the control-plane forward for misrouted frames, published as
+        # ONE immutable (topology, handoff, epoch) tuple so a dispatch
+        # thread racing a re-attach can never pair the new topology
+        # with a stale per-agent cached group (the epoch invalidates
+        # those caches, and it travels WITH the topology it stamps)
+        self._routing: tuple | None = None
+        self._route_epoch = 0
         self._queue_stat_sources: list = []
+        # misroute/drop visibility in deepflow_system: the receiver is
+        # a Countable like the queues it fans into
+        from ..utils.stats import register_countable
+
+        self._stats_src = register_countable("tpu_receiver", self)
         # window lineage plane (ISSUE 13): when a LineageTracker is
         # attached, every frame admitted into a handler queue leaves a
         # wall stamp — the feeder pairs stamps to frames FIFO, so the
@@ -82,18 +112,54 @@ class Receiver:
         with self._stats_lock:
             return list(self.agents.values())
 
+    def get_counters(self) -> dict:
+        """Countable face (→ deepflow_system as tpu_receiver_*): frame/
+        byte totals, drop classes, and the fan-in routing lanes."""
+        with self._stats_lock:
+            out = dict(self.counters)
+            out["agents_seen"] = len(self.agents)
+        return out
+
+    # -- key-hash fan-in routing (ISSUE 14) ------------------------------
+    def attach_topology(self, topology, handoff=None) -> None:
+        """Route agents to shard groups by key-hash (MeshTopology.
+        group_for_agent over the packed identity words). Frames of
+        locally-owned groups enqueue into that group's handler queues;
+        misrouted frames are counted (`frames_misrouted`) and forwarded
+        through `handoff(group, raw_frame)` — the control-plane path to
+        the owning host (e.g. a UniformSender), guarded and counted.
+        With no handoff attached misroutes are counted drops: silently
+        feeding a wrong-group pipeline would split one agent's keys
+        across two exact stashes.
+
+        Routing applies PER MESSAGE TYPE, and only to types with at
+        least one group-registered handler — lanes whose handlers are
+        all ungrouped (METRICS, SYSLOG, ...) keep delivering every
+        agent's frames locally, sharded-plane topology or not."""
+        self._route_epoch += 1
+        # single atomic publish: dispatch threads read the tuple once
+        self._routing = (topology, handoff, self._route_epoch)
+
     # -- registry (receiver.go:444 RegistHandler) -----------------------
-    def register_handler(self, msg_type: MessageType, queues: list) -> None:
+    def register_handler(self, msg_type: MessageType, queues: list,
+                         *, shard_group: int | None = None) -> None:
+        """Register a handler's queue fanout; `shard_group` pins the
+        queues to one key-hash group (one handler per owned group —
+        the ISSUE 14 fan-in shape). Ungrouped registration (None) stays
+        the fallback for every group this process owns."""
         if not queues:
             raise ValueError("need at least one queue")
-        self._handlers[int(msg_type)] = list(queues)
+        self._handlers.setdefault(int(msg_type), {})[shard_group] = list(queues)
         # surface each queue's depth/overrun counters on the default
         # stats collector — overwrite drops were previously invisible
         # unless an owner polled .overwritten (ISSUE 4 satellite)
         from .queues import register_queue_stats
 
+        tags = {"msg_type": str(int(msg_type))}
+        if shard_group is not None:
+            tags["group"] = str(shard_group)
         self._queue_stat_sources += register_queue_stats(
-            "ingest_queue", queues, msg_type=str(int(msg_type))
+            "ingest_queue", queues, **tags
         )
 
     # -- lifecycle ------------------------------------------------------
@@ -121,6 +187,9 @@ class Receiver:
 
     def stop(self) -> None:
         self._running = False
+        from ..utils.stats import default_collector
+
+        default_collector.deregister(self._stats_src)
         for s in (self._tcp_sock, self._udp_sock):
             if s is not None:
                 try:
@@ -171,7 +240,47 @@ class Receiver:
             st.frames += 1
             st.bytes += len(raw_frame)
 
-        queues = self._handlers.get(header.msg_type)
+        groups = self._handlers.get(header.msg_type)
+        if not groups:
+            self._count("no_handler")
+            return
+        routing = self._routing  # one read: (topology, handoff, epoch)
+        group = None
+        if routing is not None and any(k is not None for k in groups):
+            topo, handoff, epoch = routing
+            # key-hash fan-in (ISSUE 14): the agent's packed identity
+            # words pick the shard group; only locally-owned frames may
+            # enqueue — the data path never crosses hosts, so a frame
+            # for a remote group forwards via the control-plane handoff.
+            # Scope: ONLY message types with group-registered handlers
+            # route — a lane whose handlers are all ungrouped serves
+            # every agent locally regardless of the sharded topology.
+            # The pure (org, agent) → group map is cached per agent
+            # (st is this frame's AgentStatus from the stats block) as
+            # ONE (epoch, group) tuple; pairing the epoch from the
+            # SAME tuple as the topology guarantees the cache is never
+            # read or written against a different attach.
+            route = st.route
+            if route is None or route[0] != epoch:
+                route = (epoch, topo.group_for_agent(
+                    header.organization_id, header.agent_id
+                ))
+                st.route = route
+            group = route[1]
+            if not topo.owns_group(group):
+                self._count("frames_misrouted")
+                if handoff is not None:
+                    try:
+                        handoff(group, raw_frame)
+                        self._count("frames_handoff")
+                    except Exception:
+                        # the forward path must never raise into the
+                        # conn/UDP loop; the drop is counted
+                        self._count("handoff_errors")
+                return
+        queues = groups.get(group)
+        if queues is None and group is not None:
+            queues = groups.get(None)
         if not queues:
             self._count("no_handler")
             return

@@ -1,4 +1,11 @@
 from .mesh import make_mesh
 from .sharded import ShardedPipeline, SketchPlanes
+from .topology import MeshTopology, key_shard_group
 
-__all__ = ["make_mesh", "ShardedPipeline", "SketchPlanes"]
+__all__ = [
+    "make_mesh",
+    "ShardedPipeline",
+    "SketchPlanes",
+    "MeshTopology",
+    "key_shard_group",
+]

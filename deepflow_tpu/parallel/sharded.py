@@ -162,9 +162,26 @@ class ShardedConfig:
 
 
 class ShardedPipeline:
-    """shard_map'd ingest step + collective window-close merges."""
+    """shard_map'd ingest step + collective window-close merges.
 
-    def __init__(self, mesh: Mesh, config: ShardedConfig = ShardedConfig()):
+    `mesh` may be a `parallel.topology.MeshTopology` instead of a raw
+    Mesh (ISSUE 14): the pipeline then compiles against the topology's
+    fully-addressable per-group mesh for `shard_group` — same
+    ("host", "chip") axis names, so every shard_map body below is
+    unchanged — and carries the topology through to checkpoint meta
+    (per-host restore validation) and Countable labels."""
+
+    def __init__(self, mesh, config: ShardedConfig = ShardedConfig(),
+                 *, shard_group: int = 0):
+        from .topology import MeshTopology
+
+        if isinstance(mesh, MeshTopology):
+            self.topology: MeshTopology | None = mesh
+            self.shard_group = shard_group
+            mesh = mesh.group_mesh(shard_group)
+        else:
+            self.topology = None
+            self.shard_group = shard_group
         self.mesh = mesh
         self.config = config
         self.n_devices = mesh.devices.size
@@ -765,13 +782,23 @@ class ShardedWindowManager:
         # (the sharded path computes its window spans from the host
         # timestamp arrays it already gates on)
         self.lineage = None
+        # multi-host placement labels (ISSUE 14): with a MeshTopology,
+        # rows carry the shard group + process so a fleet dashboard can
+        # tell hosts apart without scraping hostnames
+        topo_tags = {}
+        if pipe.topology is not None:
+            topo_tags = {
+                "group": str(pipe.shard_group),
+                "process": str(pipe.topology.process_index),
+            }
         self._stats_srcs = [
             register_countable(
-                "tpu_sharded_pipeline", self, devices=str(pipe.n_devices)
+                "tpu_sharded_pipeline", self, devices=str(pipe.n_devices),
+                **topo_tags,
             ),
             register_countable(
                 "tpu_sharded_pipeline_spans", self.tracer,
-                devices=str(pipe.n_devices),
+                devices=str(pipe.n_devices), **topo_tags,
             ),
         ]
         # device profiling plane (ISSUE 12): weakly registered on the
@@ -1447,13 +1474,37 @@ class ShardedWindowManager:
             self.n_advances += 1
         return flushed
 
-    def make_feeder(self, queues, bucket_sizes, config=None, **kw):
+    def make_feeder(self, queues, bucket_sizes, config=None, *,
+                    journal_dir=None, **kw):
         """Wire this shard group behind a feeder runtime (ISSUE 4: one
         feeder per shard group): TAGGEDFLOW flowframes from `queues`
         coalesce into bucket-shaped flow batches whose sizes divide the
-        mesh's device count (feeder/runtime.ShardedFeedSink)."""
+        mesh's device count (feeder/runtime.ShardedFeedSink).
+
+        `journal_dir` (ISSUE 14, per-host ownership): open this host's
+        crc-framed FrameJournal under it — the filename carries the
+        shard group AND process index (MeshTopology.host_path), so
+        kill-and-recover replays ONLY this host's frames. Requires the
+        pipeline to have been built from a MeshTopology."""
         from ..feeder import FeederConfig, FeederRuntime, ShardedFeedSink
 
+        if journal_dir is not None:
+            if "journal" in kw:
+                raise ValueError("pass journal= or journal_dir=, not both")
+            from pathlib import Path
+
+            from ..feeder.journal import FrameJournal
+
+            topo = self.pipe.topology
+            if topo is None:
+                raise ValueError(
+                    "journal_dir= needs a MeshTopology-built pipeline — "
+                    "per-host journal naming derives from the process index"
+                )
+            path = topo.host_path(
+                Path(journal_dir) / "feeder.journal", group=self.pipe.shard_group
+            )
+            kw["journal"] = FrameJournal(path)
         return FeederRuntime(
             queues, ShardedFeedSink(self, bucket_sizes),
             config or FeederConfig(), **kw,

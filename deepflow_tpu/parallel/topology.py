@@ -1,0 +1,338 @@
+"""Multi-host mesh topology + key-hash shard-group placement (ISSUE 14).
+
+Promotes the sharded plane from one process to a process-spanning
+deployment the way the reference scales analyzers horizontally
+(agent→analyzer assignment, SURVEY §2.3): the pod's devices form ONE
+logical mesh, partitioned into **shard groups**, and every shard group
+is pinned to exactly one process (host). Agents route to shard groups
+by hashing their packed identity words at the receiver, so:
+
+  * the **data path never crosses hosts** — every shard_map kernel of
+    ShardedPipeline runs on a *fully-addressable* per-group mesh (this
+    process's devices only), which is also why the per-host ≤3-fetch
+    budget and counter-block contract hold unchanged at any process
+    count;
+  * **cross-host traffic is control-plane only** — misrouted frames
+    forward through a counted handoff (ingest/receiver.py), and
+    pod-wide sketch views merge HOST-SIDE with the r12 associative
+    algebra (register max / counter add), exactly how per-device
+    blocks already host-merge inside one drain;
+  * each host owns its **feeder + journal + checkpoint** — filenames
+    carry the process index (`host_path`), so the r11 kill-and-recover
+    machinery replays only local frames, per host.
+
+Bring-up is `jax.distributed.initialize` + `jax.make_mesh` over the
+global device view (the SNIPPETS pjit/NamedSharding shape). The global
+mesh is the *topology statement* — checkpoint validation and the
+device→process map derive from it — while data-path kernels compile
+against the per-group submeshes with the SAME ("host", "chip") axis
+names, so shard_map bodies are untouched.
+
+Recovery independence: because no data-path kernel spans hosts, a host
+can restore its checkpoint and drain its journal WITHOUT the
+coordination service (`MeshTopology.standalone`) — a dead coordinator
+never blocks per-host recovery.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from pathlib import Path
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ..ops.hashing import fingerprint64_words
+
+_log = logging.getLogger(__name__)
+
+AXIS_HOST = "host"
+AXIS_CHIP = "chip"
+MESH_AXES = (AXIS_HOST, AXIS_CHIP)
+
+
+# ---------------------------------------------------------------------------
+# key-hash fan-in (the receiver's routing function)
+
+
+def agent_key_words(org_id, agent_id) -> list:
+    """The packed identity words the fan-in hash folds: org and agent
+    ids bin-packed into u32 words the same way the datamodel packs tag
+    fingerprints (datamodel/code.py RAW_TAG_PACK stance: u16 fields
+    share a word). Vectorized: scalars or equal-length arrays."""
+    # at-least-1d: numpy emits overflow RuntimeWarnings for u32 scalar
+    # wraparound but not for arrays — the hash fold relies on wrapping
+    org = np.atleast_1d(np.asarray(org_id, dtype=np.uint32))
+    agent = np.atleast_1d(np.asarray(agent_id, dtype=np.uint32))
+    return [(org << np.uint32(16)) | (agent & np.uint32(0xFFFF)),
+            agent >> np.uint32(16)]
+
+
+def key_shard_group(org_id, agent_id, n_groups: int):
+    """Key-hash fan-in: (org, agent) identity → shard group, via the
+    SAME fingerprint fold the packed doc keys use (ops/hashing
+    fingerprint64_words), so the assignment is a pure function every
+    host (and the controller) computes identically with no shared
+    state. Scalars in → int out; arrays in → int array out."""
+    if n_groups <= 0:
+        raise ValueError(f"n_groups must be positive, got {n_groups}")
+    hi, lo = fingerprint64_words(agent_key_words(org_id, agent_id), xp=np)
+    group = (hi.astype(np.uint64) ^ lo.astype(np.uint64)) % np.uint64(n_groups)
+    if np.ndim(org_id) == 0 and np.ndim(agent_id) == 0:
+        return int(group[0])
+    return group.astype(np.int64)
+
+
+# ---------------------------------------------------------------------------
+# the topology
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshTopology:
+    """Placement of shard groups onto processes over one logical mesh.
+
+    `process_count × devices-per-process` devices, split into
+    `n_groups` shard groups of `devices_per_group` each, block-assigned
+    to processes in order (groups_per_process = n_groups /
+    process_count, validated divisible). Construct via `single` (one
+    process owns everything — today's deployments and every in-process
+    test), `distributed` (the real multi-host bring-up through
+    `jax.distributed.initialize`) or `standalone` (a host's
+    coordination-free view of a multi-host topology — recovery and
+    per-host tooling)."""
+
+    process_index: int
+    process_count: int
+    n_groups: int
+    devices_per_group: int
+    local_devices: tuple = dataclasses.field(repr=False)
+    # True only for jax.distributed-initialized topologies (NB: named
+    # is_distributed — the `distributed` classmethod shares the class
+    # namespace)
+    is_distributed: bool = False
+
+    def __post_init__(self):
+        if not (0 <= self.process_index < self.process_count):
+            raise ValueError(
+                f"process_index {self.process_index} outside "
+                f"[0, {self.process_count})"
+            )
+        if self.n_groups % self.process_count:
+            raise ValueError(
+                f"{self.n_groups} shard groups cannot block-assign onto "
+                f"{self.process_count} processes (must divide evenly)"
+            )
+        need = self.groups_per_process * self.devices_per_group
+        if len(self.local_devices) < need:
+            raise ValueError(
+                f"process {self.process_index} owns "
+                f"{self.groups_per_process} groups × "
+                f"{self.devices_per_group} devices = {need} devices but "
+                f"only {len(self.local_devices)} are local"
+            )
+
+    # -- construction ----------------------------------------------------
+    @classmethod
+    def single(cls, n_groups: int = 1, *, devices_per_group: int = 1,
+               devices=None) -> "MeshTopology":
+        """One process owning every shard group (today's deployment
+        shape; also the multi-process oracle in tests)."""
+        devs = tuple(jax.devices() if devices is None else devices)
+        return cls(
+            process_index=0, process_count=1, n_groups=n_groups,
+            devices_per_group=devices_per_group, local_devices=devs,
+        )
+
+    @classmethod
+    def distributed(cls, coordinator_address: str, num_processes: int,
+                    process_id: int, *, n_groups: int | None = None,
+                    devices_per_group: int | None = None,
+                    initialize: bool = True) -> "MeshTopology":
+        """The real multi-host bring-up: `jax.distributed.initialize`
+        against the coordinator, then the topology over the GLOBAL
+        device view. `n_groups` defaults to one group per process;
+        `devices_per_group` defaults to local devices / local groups."""
+        if initialize:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+        pc = jax.process_count()
+        pi = jax.process_index()
+        if pc != num_processes or pi != process_id:
+            raise ValueError(
+                f"jax.distributed reports process {pi}/{pc}, caller "
+                f"expected {process_id}/{num_processes}"
+            )
+        local = tuple(jax.local_devices())
+        if n_groups is None:
+            n_groups = pc
+        gpp = n_groups // max(pc, 1)
+        if devices_per_group is None:
+            devices_per_group = max(len(local) // max(gpp, 1), 1)
+        return cls(
+            process_index=pi, process_count=pc, n_groups=n_groups,
+            devices_per_group=devices_per_group, local_devices=local,
+            is_distributed=True,
+        )
+
+    @classmethod
+    def standalone(cls, process_index: int, process_count: int, *,
+                   n_groups: int | None = None, devices_per_group: int = 1,
+                   devices=None) -> "MeshTopology":
+        """One host's view of a multi-host topology WITHOUT the
+        coordination service. The data path never crosses hosts, so a
+        restoring host can rebuild its shard groups, replay its
+        journal and drain — even while the coordinator (or every other
+        host) is down. `global_mesh()` is unavailable in this mode."""
+        devs = tuple(jax.local_devices() if devices is None else devices)
+        return cls(
+            process_index=process_index, process_count=process_count,
+            n_groups=process_count if n_groups is None else n_groups,
+            devices_per_group=devices_per_group, local_devices=devs,
+        )
+
+    # -- placement -------------------------------------------------------
+    @property
+    def groups_per_process(self) -> int:
+        return self.n_groups // self.process_count
+
+    def group_process(self, group: int) -> int:
+        """The process that owns `group` (block assignment)."""
+        self._check_group(group)
+        return group // self.groups_per_process
+
+    def owned_groups(self) -> tuple[int, ...]:
+        g0 = self.process_index * self.groups_per_process
+        return tuple(range(g0, g0 + self.groups_per_process))
+
+    def owns_group(self, group: int) -> bool:
+        self._check_group(group)
+        return self.group_process(group) == self.process_index
+
+    def group_for_agent(self, org_id: int, agent_id: int) -> int:
+        """Key-hash fan-in routing (the receiver's function)."""
+        return key_shard_group(org_id, agent_id, self.n_groups)
+
+    def _check_group(self, group: int) -> None:
+        if not (0 <= group < self.n_groups):
+            raise ValueError(
+                f"shard group {group} outside [0, {self.n_groups})"
+            )
+
+    # -- meshes ----------------------------------------------------------
+    def group_mesh(self, group: int) -> Mesh:
+        """The fully-addressable per-group mesh every data-path
+        shard_map kernel compiles against — SAME ("host", "chip") axis
+        names as the single-process mesh, so kernel bodies are
+        unchanged. Loud for remote groups: the data path never crosses
+        hosts, a remote group's mesh must never be dispatched to."""
+        self._check_group(group)
+        if not self.owns_group(group):
+            raise ValueError(
+                f"shard group {group} is owned by process "
+                f"{self.group_process(group)}, not this process "
+                f"({self.process_index}) — the data path never crosses "
+                "hosts; route the frames there instead (key-hash fan-in)"
+            )
+        k = group - self.process_index * self.groups_per_process
+        devs = self.local_devices[
+            k * self.devices_per_group : (k + 1) * self.devices_per_group
+        ]
+        arr = np.asarray(devs, dtype=object).reshape(1, self.devices_per_group)
+        return Mesh(arr, axis_names=MESH_AXES)
+
+    def global_mesh(self) -> Mesh:
+        """The pod-wide (host, chip) mesh over the GLOBAL device view —
+        the topology statement (`jax.make_mesh` shape): checkpoint
+        validation and the device→process map derive from it. Data-path
+        kernels never compile against it (group_mesh is the dispatch
+        surface); collective use requires a backend with cross-process
+        computations (TPU/GPU — the CPU backend refuses)."""
+        if not self.is_distributed and self.process_count > 1:
+            raise ValueError(
+                "standalone topology has no global device view — only "
+                "jax.distributed-initialized processes (or single-process "
+                "topologies) can build the pod mesh"
+            )
+        devs = jax.devices()
+        per_host = len(devs) // self.process_count
+        return jax.make_mesh(
+            (self.process_count, per_host), MESH_AXES, devices=devs
+        )
+
+    # -- per-host ownership ----------------------------------------------
+    def host_path(self, base, group: int | None = None) -> Path:
+        """Decorate a journal/checkpoint path with the process index
+        (and optionally the shard group): per-host ownership means
+        recovery replays ONLY local frames, so the filename must say
+        whose frames these are."""
+        base = Path(base)
+        tag = f"p{self.process_index}of{self.process_count}"
+        if group is not None:
+            tag = f"g{group}.{tag}"
+        return base.with_name(f"{base.name}.{tag}")
+
+    # -- checkpoint topology contract ------------------------------------
+    def describe(self) -> dict:
+        """Meta the sharded checkpoint embeds (aggregator/checkpoint
+        validates it loudly at restore — satellite: a mesh-shape
+        mismatch must fail at load, not as a shape error deep in
+        shard_map)."""
+        return {
+            "process_index": self.process_index,
+            "process_count": self.process_count,
+            "n_groups": self.n_groups,
+            "devices_per_group": self.devices_per_group,
+        }
+
+    def validate_restore(self, meta: dict, path) -> None:
+        """Loud topology check for a checkpoint's meta: the saved mesh
+        shape (device count × process count) and group layout must
+        match this restore topology exactly — per-device stashes
+        cannot be re-split, and a group restored onto the wrong host
+        would silently serve another host's keys."""
+        saved_pc = meta.get("process_count")
+        if saved_pc is None:
+            return  # pre-topology checkpoint: device-count check (the
+            # existing n_devices validation) is the whole contract
+        mismatches = []
+        for key, have in (
+            ("process_count", self.process_count),
+            ("n_groups", self.n_groups),
+            ("devices_per_group", self.devices_per_group),
+        ):
+            want = meta.get(key)
+            if want is not None and int(want) != int(have):
+                mismatches.append(f"{key}: checkpoint={want} restore={have}")
+        if mismatches:
+            saved_shape = (
+                f"{meta.get('devices_per_group')}d×{saved_pc}p"
+                f"/{meta.get('n_groups')}g"
+            )
+            here_shape = (
+                f"{self.devices_per_group}d×{self.process_count}p"
+                f"/{self.n_groups}g"
+            )
+            raise ValueError(
+                f"checkpoint {path} was saved on mesh topology "
+                f"{saved_shape} but this process restores into "
+                f"{here_shape} ({'; '.join(mismatches)}) — per-device "
+                "stashes cannot be re-split across a different topology"
+            )
+
+
+def free_coordinator_port() -> int:
+    """A free localhost TCP port for `jax.distributed` coordinators
+    (test/bench bring-up helper)."""
+    import socket
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port

@@ -270,8 +270,13 @@ class FreshnessTracker:
     SECONDS in, milliseconds out on the Countable face."""
 
     def __init__(self, *, name: str = "freshness", collector=None,
-                 autoregister: bool = True):
+                 autoregister: bool = True, group: str | None = None):
         self.name = name
+        # per-shard-group freshness lanes (ISSUE 14): a multi-host
+        # deployment runs one tracker per shard group, labelled
+        # tpu_freshness{tier=..., group=...} — cross-host skew between
+        # groups is a dashboard diff of the same lane across labels
+        self.group = group
         self._lock = threading.Lock()
         # (interval, kind) → _FreshLane
         self._lanes: dict[tuple[int, str], _FreshLane] = {}
@@ -302,10 +307,12 @@ class FreshnessTracker:
                         self, interval
                     )
                     if self._autoregister:
+                        tags = {"tier": f"{interval}s", "name": self.name}
+                        if self.group is not None:
+                            tags["group"] = self.group
                         self._srcs.append(
                             self._get_collector().register(
-                                "tpu_freshness", view,
-                                tier=f"{interval}s", name=self.name,
+                                "tpu_freshness", view, **tags
                             )
                         )
             lane.last_ms = lag_ms
@@ -397,12 +404,19 @@ class LineageTracker:
 
     def __init__(self, service: str = DEFAULT_SERVICE, interval: int = 1,
                  *, clock=time.time, freshness: FreshnessTracker | None = None,
-                 max_windows: int = 4096, name: str = "lineage"):
+                 max_windows: int = 4096, name: str = "lineage",
+                 group: str | None = None):
         self.service = service
         self.interval = int(interval)
         self.clock = clock
         self.freshness = freshness
         self.name = name
+        # multi-host mesh (ISSUE 14): shard-group label for the
+        # Countable rows. Trace ids stay PURE functions of (service,
+        # window, interval) — deliberately NOT of the group — so every
+        # host's hops for one window join ONE trace with no wire
+        # context; the group label only distinguishes tracker rows
+        self.group = group
         self.max_windows = int(max_windows)
         self._lock = threading.RLock()
         # (interval, window_idx) → WindowLineage, eviction order
@@ -435,7 +449,10 @@ class LineageTracker:
             "spans_exported": 0,
             "bind_span_clamped": 0,
         }
-        self._stats_src = register_countable("tpu_lineage", self, name=name)
+        tags = {"name": name}
+        if group is not None:
+            tags["group"] = group
+        self._stats_src = register_countable("tpu_lineage", self, **tags)
         _REGISTRY.add(self)
 
     # -- countable face ---------------------------------------------------

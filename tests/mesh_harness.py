@@ -1,0 +1,508 @@
+"""Multi-host mesh harness (ISSUE 14): one file, three hats.
+
+1. **Subprocess entry** (`python tests/mesh_harness.py '<spec json>'`):
+   runs ONE host of a multi-process deployment — clean-env CPU
+   subprocess (the dryrun_multichip pattern), real
+   `jax.distributed.initialize` against a coordinator, one
+   receiver + per-owned-group (queues → FeederRuntime(journal) →
+   ShardedWindowManager) stack, key-hash fan-in routing, per-host
+   journal/checkpoint filenames, deterministic injected lineage
+   clocks — emits one JSON result file.
+2. **Spawn helper** for tests: `run_mesh(...)` launches N such
+   processes concurrently (free coordinator port, partial-tolerant),
+   plus the mid-stream **kill-and-recover** recipe (gen-1 dies via
+   os._exit after a checkpoint; gen-2 rejoins COORDINATION-FREE via
+   MeshTopology.standalone, restores the sharded checkpoint, replays
+   its OWN journal, and finishes).
+3. **Single-process oracle**: `run_oracle()` executes the identical
+   workload in the calling process over `MeshTopology.single` — same
+   per-group meshes, same frames, same pump cadence — so every
+   per-group result is comparable BIT-EXACT (flushed rows, counter
+   blocks, freshness lags, sketch blocks).
+
+Results are memoized module-wide (`mesh2_result`/`mesh2_kill_result`/
+`oracle_result`) so the bit-exact, recovery and perf-gate tests share
+one subprocess run each instead of paying the spawn three times.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+HERE = Path(__file__).resolve().parent
+REPO = HERE.parent
+
+# -- the shared workload (module constants: oracle and every subprocess
+#    must build byte-identical frames) ---------------------------------
+N_GROUPS = 2
+DEVICES_PER_GROUP = 1
+N_AGENTS = 8
+ORG_ID = 1
+ROWS_PER_FRAME = 48
+N_STEPS = 10
+CHECKPOINT_AT = 3  # kill recipe: checkpoint after this step's pumps
+KILL_AFTER = 6     # ... and die (os._exit) after this step's pumps
+T0 = 1_700_000_000
+BUCKETS = (64, 128, 256)
+KILL_EXIT = 7
+
+_COUNTER_KEYS = (
+    "flow_in", "flushed_doc", "drop_before_window", "window_advances",
+    "sketch_blocks_closed",
+)
+
+
+def _sharded_cfg():
+    from deepflow_tpu.ops.histogram import LogHistSpec
+    from deepflow_tpu.parallel.sharded import ShardedConfig
+
+    return ShardedConfig(
+        capacity_per_device=1 << 10, num_services=8, hll_precision=6,
+        cms_depth=2, cms_width=256,
+        hist=LogHistSpec(bins=32, vmin=1.0, gamma=1.3),
+        topk_cols=64, sketch_pending=8,
+    )
+
+
+def step_frames():
+    """[step][...] of (agent_id, raw_frame) — deterministic, identical
+    in every process (the generator is stateful, so construction order
+    IS the contract)."""
+    from deepflow_tpu.feeder import encode_flowbatch_frames
+    from deepflow_tpu.ingest.replay import SyntheticFlowGen
+
+    gen = SyntheticFlowGen(num_tuples=64, seed=7)
+    steps = []
+    for i in range(N_STEPS):
+        frames = []
+        for a in range(N_AGENTS):
+            fb = gen.flow_batch(ROWS_PER_FRAME, T0 + i)
+            for raw in encode_flowbatch_frames(
+                fb, agent_id=a, org_id=ORG_ID
+            ):
+                frames.append((a, raw))
+        steps.append(frames)
+    return steps
+
+
+class _TickClock:
+    """Injected deterministic lineage clock — one per shard group, so
+    each group's call sequence (and therefore its freshness lags) is
+    identical between the oracle and the process that owns it."""
+
+    def __init__(self, group: int):
+        self.t = 1_000.0 * (group + 1)
+
+    def __call__(self) -> float:
+        self.t += 0.0005
+        return self.t
+
+
+def _digest(*arrays) -> str:
+    h = hashlib.sha256()
+    for a in arrays:
+        h.update(a.tobytes())
+    return h.hexdigest()[:24]
+
+
+class HostRunner:
+    """One host's stack: receiver (key-hash routed) + one
+    queues→feeder(journal)→ShardedWindowManager lane per owned group."""
+
+    def __init__(self, topology, workdir: Path, *, restore: bool = False):
+        import numpy as np
+
+        from deepflow_tpu.aggregator.checkpoint import (
+            read_checkpoint_meta,
+            restore_sharded_state,
+        )
+        from deepflow_tpu.feeder import FeederConfig
+        from deepflow_tpu.ingest.framing import MessageType
+        from deepflow_tpu.ingest.queues import PyOverwriteQueue
+        from deepflow_tpu.ingest.receiver import Receiver
+        from deepflow_tpu.parallel.sharded import (
+            ShardedPipeline,
+            ShardedWindowManager,
+        )
+        from deepflow_tpu.tracing.lineage import (
+            FreshnessTracker,
+            LineageTracker,
+        )
+
+        self.np = np
+        self.topology = topology
+        self.workdir = Path(workdir)
+        self.receiver = Receiver()
+        self.handoffs: list[tuple[int, int]] = []  # (group, nbytes)
+        self.receiver.attach_topology(
+            topology,
+            handoff=lambda g, raw: self.handoffs.append((g, len(raw))),
+        )
+        self.groups: dict[int, dict] = {}
+        self.n_ingests = 0
+        cfg = _sharded_cfg()
+        for g in topology.owned_groups():
+            queues = [PyOverwriteQueue(1 << 12)]
+            self.receiver.register_handler(
+                MessageType.TAGGEDFLOW, queues, shard_group=g
+            )
+            pipe = ShardedPipeline(topology, cfg, shard_group=g)
+            swm = ShardedWindowManager(pipe, delay=2)
+            clock = _TickClock(g)
+            tracker = LineageTracker(
+                service="mesh.harness", interval=1, clock=clock,
+                group=str(g),
+                freshness=FreshnessTracker(name=f"g{g}", group=str(g)),
+            )
+            swm.attach_lineage(tracker)
+            feeder = swm.make_feeder(
+                queues, BUCKETS,
+                FeederConfig(frames_per_queue=16),
+                journal_dir=self.workdir, lineage=tracker,
+            )
+            real_ingest = swm.ingest
+
+            def counted(tags, meters, valid, _r=real_ingest):
+                self.n_ingests += 1
+                return _r(tags, meters, valid)
+
+            swm.ingest = counted
+            ckpt = topology.host_path(self.workdir / "mesh.ckpt", group=g)
+            self.groups[g] = {
+                "swm": swm, "feeder": feeder, "tracker": tracker,
+                "ckpt": ckpt, "out": [], "blocks": [],
+            }
+            if restore:
+                restore_sharded_state(swm, ckpt)
+                meta = read_checkpoint_meta(ckpt)
+                barrier = {
+                    "journal_epoch": meta["journal_epoch"],
+                    "journal_offset": meta["journal_offset"],
+                }
+                jpath = topology.host_path(
+                    self.workdir / "feeder.journal", group=g
+                )
+                self.groups[g]["out"].extend(
+                    feeder.replay_journal(jpath, barrier=barrier)
+                )
+                self.groups[g]["out"].extend(feeder.pump())
+
+    # -- driving ---------------------------------------------------------
+    def dispatch_step(self, frames) -> None:
+        from deepflow_tpu.ingest.framing import HEADER_LEN, FlowHeader
+
+        for _agent, raw in frames:
+            header = FlowHeader.parse(raw[:HEADER_LEN])
+            self.receiver._dispatch(header, raw, ("mesh-harness", 0))
+
+    def pump(self) -> None:
+        for g in sorted(self.groups):
+            st = self.groups[g]
+            st["out"].extend(st["feeder"].pump())
+            st["blocks"].extend(st["swm"].pop_closed_sketches())
+
+    def checkpoint(self) -> None:
+        from deepflow_tpu.aggregator.checkpoint import save_sharded_state
+
+        for g in sorted(self.groups):
+            st = self.groups[g]
+
+            def save(barrier, _st=st):
+                return save_sharded_state(
+                    _st["swm"], _st["ckpt"], extra_meta=barrier
+                )
+
+            st["out"].extend(st["feeder"].checkpoint(save))
+            if not st["feeder"].last_checkpoint_ok:
+                raise RuntimeError(f"group {g} checkpoint aborted")
+            # outputs after this point are in-flight if the process
+            # dies: the journal re-creates them at replay, so the
+            # combined kill stream is out[:ckpt_len] + the recovered
+            # generation's stream
+            st["ckpt_stream_len"] = len(st["out"])
+            st["ckpt_blocks_len"] = len(st["blocks"])
+
+    def finish(self) -> None:
+        for g in sorted(self.groups):
+            st = self.groups[g]
+            st["out"].extend(st["feeder"].flush())
+            st["out"].extend(st["swm"].drain())
+            st["blocks"].extend(st["swm"].pop_closed_sketches())
+
+    def close(self) -> None:
+        self.receiver.stop()
+        for st in self.groups.values():
+            st["tracker"].close()
+            st["swm"].close()
+
+    # -- result shape ----------------------------------------------------
+    def results(self, *, counters: bool = True) -> dict:
+        out: dict = {"groups": {}, "receiver": self.receiver.get_counters(),
+                     "handoffs": len(self.handoffs)}
+        for g in sorted(self.groups):
+            st = self.groups[g]
+            stream = [
+                [int(db.timestamp[0]), int(db.size),
+                 _digest(db.tags, db.meters, db.timestamp)]
+                for db in st["out"]
+            ]
+            blocks = [
+                [int(b.window),
+                 _digest(b.hll, b.cms, b.hist, b.tk_votes, b.tk_hi)]
+                for b in st["blocks"]
+            ]
+            rec: dict = {
+                "stream": stream,
+                "blocks": blocks,
+                "fresh": st["tracker"].freshness.get_counters(),
+                "trace_id": st["tracker"].trace_id_of(T0 + 2),
+                "ckpt_stream_len": st.get("ckpt_stream_len"),
+                "ckpt_blocks_len": st.get("ckpt_blocks_len"),
+            }
+            if counters:
+                c = st["swm"].get_counters()
+                rec["counters"] = {k: c[k] for k in _COUNTER_KEYS}
+                rec["host_fetches"] = c["host_fetches"]
+            out["groups"][str(g)] = rec
+        return out
+
+
+# ---------------------------------------------------------------------------
+# subprocess body
+
+
+def run_host(spec: dict) -> None:
+    import jax
+
+    from deepflow_tpu.aggregator import window as window_mod
+    from deepflow_tpu.parallel.topology import MeshTopology
+
+    workdir = Path(spec["workdir"])
+    if spec["mode"] == "standalone":
+        topology = MeshTopology.standalone(
+            spec["process_id"], spec["num_processes"],
+            n_groups=N_GROUPS, devices_per_group=DEVICES_PER_GROUP,
+        )
+    else:
+        topology = MeshTopology.distributed(
+            spec["coordinator"], spec["num_processes"], spec["process_id"],
+            n_groups=N_GROUPS, devices_per_group=DEVICES_PER_GROUP,
+        )
+
+    # per-host fetch accounting through the shared host_fetch seam: the
+    # perf gate asserts ≤3 fetches/ingest AND that no fetched array
+    # lives on a non-local device (zero cross-host data-path transfers)
+    fetch = {"n": 0, "nonlocal": 0}
+    local = set(jax.local_devices())
+    real_fetch = window_mod.host_fetch
+
+    def counting_fetch(x):
+        fetch["n"] += 1
+        try:
+            devs = set(x.devices())
+        except Exception:
+            devs = set()
+        if devs - local:
+            fetch["nonlocal"] += 1
+        return real_fetch(x)
+
+    window_mod.host_fetch = counting_fetch
+
+    runner = HostRunner(
+        topology, workdir, restore=bool(spec.get("restore"))
+    )
+    steps = step_frames()
+    first = int(spec.get("first_step", 0))
+    cache_sizes = None
+    for i in range(first, N_STEPS):
+        runner.dispatch_step(steps[i])
+        runner.pump()
+        if i == first + 1:
+            # steady state reached (every bucket compiled): record the
+            # jit cache footprint — growth after this is a RETRACE
+            cache_sizes = [
+                st["swm"].pipe._step._cache_size()
+                for st in runner.groups.values()
+            ]
+        if i == CHECKPOINT_AT:
+            # every run checkpoints at the same step — the barrier
+            # flush changes batch cadence, so the oracle and both
+            # generations must share it for bit-exactness
+            runner.checkpoint()
+        if spec.get("kill") and i == KILL_AFTER:
+            from deepflow_tpu.parallel.hostproc import mark_done
+
+            res = runner.results()
+            res["killed_after"] = i
+            Path(spec["out"]).write_text(json.dumps(res))
+            # a dying host marks done (peers stop waiting) but does NOT
+            # wait — it is the process death under test
+            mark_done(spec["workdir"], spec["process_id"])
+            sys.stdout.flush()
+            sys.stderr.flush()
+            os._exit(KILL_EXIT)
+    runner.finish()
+    res = runner.results()
+    res["fetch"] = {
+        **fetch,
+        "n_ingests": runner.n_ingests,
+        "retraces": sum(
+            st["swm"].pipe._step._cache_size()
+            for st in runner.groups.values()
+        ) - sum(cache_sizes or [0]),
+    }
+    res["process_index"] = topology.process_index
+    Path(spec["out"]).write_text(json.dumps(res))
+    # results are durable; exit through the shared done-file barrier
+    # (parallel/hostproc.py) so the coordination leader outlives its
+    # peers and nobody enters the wedgeable atexit shutdown barrier
+    from deepflow_tpu.parallel.hostproc import exit_after_barrier
+
+    exit_after_barrier(
+        spec["workdir"], spec["process_id"],
+        spec["num_processes"] if spec["mode"] == "distributed" else 1,
+    )
+
+
+# ---------------------------------------------------------------------------
+# parent-side spawn + oracle
+
+
+def _spawn_env() -> dict:
+    from deepflow_tpu.parallel.hostproc import clean_cpu_env
+
+    return clean_cpu_env(N_GROUPS * DEVICES_PER_GROUP)  # per-proc worst case
+
+
+def spawn_hosts(specs: list[dict], timeout_s: int = 300) -> list[dict]:
+    """Launch one subprocess per spec concurrently; wait; parse each
+    spec's result file. A killed process (spec["kill"]) is EXPECTED to
+    exit with KILL_EXIT."""
+    procs = []
+    for spec in specs:
+        procs.append((spec, subprocess.Popen(
+            [sys.executable, str(HERE / "mesh_harness.py"), json.dumps(spec)],
+            cwd=str(REPO), env=_spawn_env(),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )))
+    results = []
+    for spec, p in procs:
+        try:
+            out, err = p.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, err = p.communicate()
+            raise RuntimeError(
+                f"mesh harness process {spec['process_id']} timed out:\n"
+                + err[-2000:]
+            )
+        want_rc = KILL_EXIT if spec.get("kill") else 0
+        if p.returncode != want_rc:
+            raise RuntimeError(
+                f"mesh harness process {spec['process_id']} rc="
+                f"{p.returncode} (wanted {want_rc}):\n" + err[-3000:]
+            )
+        results.append(json.loads(Path(spec["out"]).read_text()))
+    return results
+
+
+def two_process_specs(workdir: Path, *, kill: bool = False) -> list[dict]:
+    from deepflow_tpu.parallel.topology import free_coordinator_port
+
+    coord = f"127.0.0.1:{free_coordinator_port()}"
+    specs = []
+    for pid in range(2):
+        specs.append({
+            "mode": "distributed", "coordinator": coord,
+            "num_processes": 2, "process_id": pid,
+            "workdir": str(workdir),
+            "out": str(Path(workdir) / f"result.p{pid}.json"),
+            "kill": kill and pid == 1,
+        })
+    return specs
+
+
+def run_oracle() -> dict:
+    """The single-process oracle: identical workload, every shard group
+    local (MeshTopology.single over the parent's own devices), same
+    per-group mesh shape — per-group outputs are the bit-exact pin for
+    every process's results."""
+    from deepflow_tpu.parallel.topology import MeshTopology
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="mesh-oracle-") as d:
+        topology = MeshTopology.single(
+            n_groups=N_GROUPS, devices_per_group=DEVICES_PER_GROUP
+        )
+        runner = HostRunner(topology, Path(d))
+        try:
+            steps = step_frames()
+            for i in range(N_STEPS):
+                runner.dispatch_step(steps[i])
+                runner.pump()
+                if i == CHECKPOINT_AT:
+                    runner.checkpoint()
+            runner.finish()
+            return runner.results()
+        finally:
+            runner.close()
+
+
+# memoized cross-test sharing (bit-exact + recovery + perf gate tests
+# all consume one run each; pytest runs them in one process)
+_CACHE: dict = {}
+
+
+def oracle_result() -> dict:
+    if "oracle" not in _CACHE:
+        _CACHE["oracle"] = run_oracle()
+    return _CACHE["oracle"]
+
+
+def mesh2_result(tmp_root: Path | None = None) -> list[dict]:
+    """The clean 2-process distributed run (memoized)."""
+    if "mesh2" not in _CACHE:
+        import tempfile
+
+        d = Path(tempfile.mkdtemp(prefix="mesh2-", dir=tmp_root))
+        _CACHE["mesh2"] = spawn_hosts(two_process_specs(d))
+    return _CACHE["mesh2"]
+
+
+def mesh2_kill_result(tmp_root: Path | None = None) -> dict:
+    """The kill-and-recover 2-process run (memoized): gen-1 process 1
+    checkpoints after step CHECKPOINT_AT and dies after KILL_AFTER;
+    gen-2 rejoins standalone (no coordinator), restores, replays its
+    own journal, finishes. Returns {"p0":…, "p1_gen1":…, "p1_gen2":…}."""
+    if "mesh2_kill" not in _CACHE:
+        import tempfile
+
+        d = Path(tempfile.mkdtemp(prefix="mesh2kill-", dir=tmp_root))
+        specs = two_process_specs(d, kill=True)
+        p0, p1_gen1 = spawn_hosts(specs)
+        gen2_spec = {
+            "mode": "standalone", "num_processes": 2, "process_id": 1,
+            "workdir": str(d),
+            "out": str(Path(d) / "result.p1.gen2.json"),
+            "restore": True, "first_step": KILL_AFTER + 1,
+        }
+        (p1_gen2,) = spawn_hosts([gen2_spec])
+        _CACHE["mesh2_kill"] = {
+            "p0": p0, "p1_gen1": p1_gen1, "p1_gen2": p1_gen2,
+        }
+    return _CACHE["mesh2_kill"]
+
+
+if __name__ == "__main__":
+    _spec = json.loads(sys.argv[1])
+    # platform forcing must precede ANY jax import in this process
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path.insert(0, str(REPO))
+    run_host(_spec)

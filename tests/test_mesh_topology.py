@@ -1,0 +1,454 @@
+"""Multi-host mesh topology + key-hash fan-in units (ISSUE 14).
+
+In-process coverage of the placement layer: key-hash group assignment,
+MeshTopology ownership math, per-host path naming, receiver routing
+(misroute counting + control-plane handoff, queryable in
+deepflow_system), checkpoint topology validation, and the per-group
+freshness/lineage labels. The REAL 2-process deployment is covered by
+tests/test_mesh_multiproc.py over the mesh_harness subprocess run.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from deepflow_tpu.ingest.framing import HEADER_LEN, FlowHeader, MessageType
+from deepflow_tpu.ingest.queues import PyOverwriteQueue
+from deepflow_tpu.ingest.receiver import Receiver
+from deepflow_tpu.parallel.topology import (
+    MeshTopology,
+    key_shard_group,
+)
+
+T0 = 1_700_000_000
+
+
+# ---------------------------------------------------------------------------
+# key-hash fan-in
+
+
+def test_key_shard_group_deterministic_and_vectorized():
+    a = key_shard_group(1, 5, 4)
+    assert a == key_shard_group(1, 5, 4)  # pure function
+    assert 0 <= a < 4
+    orgs = np.full(64, 1, np.uint32)
+    agents = np.arange(64, dtype=np.uint32)
+    vec = key_shard_group(orgs, agents, 4)
+    assert vec.shape == (64,)
+    # vector path == scalar path, element for element
+    for i in (0, 3, 17, 63):
+        assert int(vec[i]) == key_shard_group(1, i, 4)
+    # the hash actually spreads agents over every group
+    assert set(vec.tolist()) == {0, 1, 2, 3}
+    # org participates in the key words (different org can move agents)
+    vec2 = key_shard_group(np.full(64, 7, np.uint32), agents, 4)
+    assert vec2.tolist() != vec.tolist()
+
+
+def test_key_shard_group_rejects_bad_group_count():
+    with pytest.raises(ValueError):
+        key_shard_group(1, 2, 0)
+
+
+# ---------------------------------------------------------------------------
+# placement math
+
+
+def test_single_topology_owns_everything_with_disjoint_group_meshes():
+    t = MeshTopology.single(n_groups=4, devices_per_group=2)
+    assert t.owned_groups() == (0, 1, 2, 3)
+    seen = set()
+    for g in range(4):
+        mesh = t.group_mesh(g)
+        # the data-path contract: same axis names as the single-process
+        # mesh, so shard_map bodies are untouched
+        assert mesh.axis_names == ("host", "chip")
+        assert mesh.devices.size == 2
+        devs = {d.id for d in mesh.devices.ravel()}
+        assert not (devs & seen), "group meshes must not share devices"
+        seen |= devs
+    gm = t.global_mesh()
+    assert gm.axis_names == ("host", "chip")
+
+
+def test_standalone_topology_is_coordination_free_but_loud():
+    t = MeshTopology.standalone(1, 2, devices_per_group=1)
+    assert t.owned_groups() == (1,)
+    assert t.group_mesh(1).devices.size == 1
+    # a remote group's mesh must never be constructible — the data
+    # path never crosses hosts
+    with pytest.raises(ValueError, match="never crosses hosts"):
+        t.group_mesh(0)
+    with pytest.raises(ValueError, match="no global device view"):
+        t.global_mesh()
+
+
+def test_topology_validation_is_loud():
+    with pytest.raises(ValueError, match="divide evenly"):
+        MeshTopology.standalone(0, 3, n_groups=4)
+    with pytest.raises(ValueError, match="outside"):
+        MeshTopology.standalone(5, 2)
+    with pytest.raises(ValueError, match="only .* are local"):
+        MeshTopology.single(n_groups=1, devices_per_group=1024)
+
+
+def test_host_path_carries_process_and_group():
+    t = MeshTopology.standalone(1, 4, n_groups=4, devices_per_group=1)
+    p = t.host_path("/var/lib/deepflow/feeder.journal", group=1)
+    assert p.name == "feeder.journal.g1.p1of4"
+    q = t.host_path("/var/lib/deepflow/mesh.ckpt")
+    assert q.name == "mesh.ckpt.p1of4"
+
+
+# ---------------------------------------------------------------------------
+# receiver key-hash routing
+
+
+def _frames_for_agents(n_agents: int, rows: int = 16):
+    from deepflow_tpu.feeder import encode_flowbatch_frames
+    from deepflow_tpu.ingest.replay import SyntheticFlowGen
+
+    gen = SyntheticFlowGen(num_tuples=32, seed=3)
+    out = []
+    for a in range(n_agents):
+        fb = gen.flow_batch(rows, T0)
+        out += [
+            (a, raw)
+            for raw in encode_flowbatch_frames(fb, agent_id=a, org_id=1)
+        ]
+    return out
+
+
+def test_receiver_routes_by_key_hash_and_counts_misroutes():
+    topo = MeshTopology.standalone(0, 2, devices_per_group=1)
+    rx = Receiver()
+    handed = []
+    rx.attach_topology(topo, handoff=lambda g, raw: handed.append(g))
+    q_own = PyOverwriteQueue(256)
+    rx.register_handler(MessageType.TAGGEDFLOW, [q_own], shard_group=0)
+    # a wrong-group handler that must NEVER see a frame
+    q_other = PyOverwriteQueue(256)
+    rx.register_handler(MessageType.TAGGEDFLOW, [q_other], shard_group=1)
+
+    frames = _frames_for_agents(12)
+    own = misrouted = 0
+    for agent, raw in frames:
+        g = topo.group_for_agent(1, agent)
+        if topo.owns_group(g):
+            own += 1
+        else:
+            misrouted += 1
+        rx._dispatch(FlowHeader.parse(raw[:HEADER_LEN]), raw, ("test", 0))
+    assert own > 0 and misrouted > 0  # the hash split this agent set
+    c = rx.get_counters()
+    assert len(q_own) == own
+    # the misrouted frames were counted and handed off — NOT enqueued
+    # into the wrong-group handler registered on this same receiver
+    assert len(q_other) == 0
+    assert c["frames_misrouted"] == misrouted
+    assert c["frames_handoff"] == misrouted
+    assert handed and all(not topo.owns_group(g) for g in handed)
+    rx.stop()
+
+
+def test_receiver_handoff_errors_are_contained_and_counted():
+    topo = MeshTopology.standalone(0, 2, devices_per_group=1)
+    rx = Receiver()
+
+    def broken(_g, _raw):
+        raise RuntimeError("control-plane link down")
+
+    rx.attach_topology(topo, handoff=broken)
+    rx.register_handler(
+        MessageType.TAGGEDFLOW, [PyOverwriteQueue(64)], shard_group=0
+    )
+    for agent, raw in _frames_for_agents(12):
+        rx._dispatch(FlowHeader.parse(raw[:HEADER_LEN]), raw, ("test", 0))
+    c = rx.get_counters()
+    assert c["frames_misrouted"] > 0
+    assert c["handoff_errors"] == c["frames_misrouted"]
+    assert c["frames_handoff"] == 0
+    rx.stop()
+
+
+def test_receiver_misroute_counter_queryable_in_deepflow_system():
+    from deepflow_tpu.integration.dfstats import (
+        system_metric_name,
+        system_sink,
+    )
+    from deepflow_tpu.querier.engine import QueryEngine
+    from deepflow_tpu.storage.store import ColumnarStore
+    from deepflow_tpu.utils.stats import StatsCollector
+
+    topo = MeshTopology.standalone(0, 2, devices_per_group=1)
+    rx = Receiver()
+    rx.attach_topology(topo)  # no handoff: counted drops
+    rx.register_handler(
+        MessageType.TAGGEDFLOW, [PyOverwriteQueue(256)], shard_group=0
+    )
+    for agent, raw in _frames_for_agents(12):
+        rx._dispatch(FlowHeader.parse(raw[:HEADER_LEN]), raw, ("test", 0))
+    want = rx.get_counters()["frames_misrouted"]
+    assert want > 0
+
+    store = ColumnarStore()
+    col = StatsCollector(interval_s=999)
+    col.register("tpu_receiver", rx)
+    col.add_sink(system_sink(store))
+    col.tick(now=float(T0 + 100))
+    res = QueryEngine(store).execute(
+        "SELECT value FROM deepflow_system.deepflow_system WHERE metric = "
+        f"'{system_metric_name('tpu_receiver', 'frames_misrouted')}'"
+    )
+    assert res.rows == 1
+    assert float(res.values["value"][0]) == float(want)
+    rx.stop()
+
+
+def test_ungrouped_lanes_bypass_routing_even_with_topology_attached():
+    """Review regression: routing applies ONLY to message types with
+    group-registered handlers. A receiver serving the sharded
+    TAGGEDFLOW plane AND an ungrouped lane (METRICS/SYSLOG-style) must
+    keep delivering the ungrouped lane's frames from EVERY agent —
+    gating them behind the key-hash would drop half the fleet's
+    metrics the moment a topology attaches."""
+    from deepflow_tpu.ingest.framing import FlowHeader, MessageType
+
+    topo = MeshTopology.standalone(0, 2, devices_per_group=1)
+    rx = Receiver()
+    rx.attach_topology(topo)
+    q_flow = PyOverwriteQueue(256)
+    rx.register_handler(MessageType.TAGGEDFLOW, [q_flow], shard_group=0)
+    q_metrics = PyOverwriteQueue(256)
+    rx.register_handler(MessageType.METRICS, [q_metrics])  # ungrouped
+
+    frames = _frames_for_agents(12)
+    n_own = sum(
+        1 for a, _ in frames if topo.owns_group(topo.group_for_agent(1, a))
+    )
+    for _agent, raw in frames:
+        rx._dispatch(FlowHeader.parse(raw[:HEADER_LEN]), raw, ("t", 0))
+        # the same agent's frame re-framed onto the ungrouped lane
+        header = FlowHeader.parse(raw[:HEADER_LEN])
+        header.msg_type = int(MessageType.METRICS)
+        m_raw = header.encode() + raw[HEADER_LEN:]
+        rx._dispatch(FlowHeader.parse(m_raw[:HEADER_LEN]), m_raw, ("t", 0))
+    # grouped lane routed; ungrouped lane delivered EVERYTHING
+    assert len(q_flow) == n_own
+    assert len(q_metrics) == len(frames)
+    # misroutes counted only for the grouped lane
+    assert rx.get_counters()["frames_misrouted"] == len(frames) - n_own
+    rx.stop()
+
+
+def test_reattach_invalidates_cached_agent_groups():
+    """Review regression: the (topology, handoff, epoch) tuple is
+    published atomically — after a re-attach with a different group
+    count, every agent's cached group is recomputed under the NEW
+    topology (a stale group could land in a wrong-group handler or
+    fall outside the new range)."""
+    from deepflow_tpu.ingest.framing import FlowHeader, MessageType
+
+    rx = Receiver()
+    frames = _frames_for_agents(8)
+    t2 = MeshTopology.single(n_groups=2, devices_per_group=1)
+    rx.attach_topology(t2)
+    q = {g: PyOverwriteQueue(256) for g in range(4)}
+    for g in range(2):
+        rx.register_handler(MessageType.TAGGEDFLOW, [q[g]], shard_group=g)
+    for _a, raw in frames:
+        rx._dispatch(FlowHeader.parse(raw[:HEADER_LEN]), raw, ("t", 0))
+    t4 = MeshTopology.single(n_groups=4, devices_per_group=1)
+    rx.attach_topology(t4)
+    for g in range(2, 4):
+        rx.register_handler(MessageType.TAGGEDFLOW, [q[g]], shard_group=g)
+    for a, raw in frames:
+        rx._dispatch(FlowHeader.parse(raw[:HEADER_LEN]), raw, ("t", 0))
+    # second pass routed under the 4-group map, not the cached 2-group
+    # (the cache is one atomic (epoch, group) tuple)
+    for a in {a for a, _ in frames}:
+        epoch, group = rx.agents[(1, a)].route
+        assert group == t4.group_for_agent(1, a)
+        assert epoch == rx._route_epoch
+    assert rx.get_counters()["frames_misrouted"] == 0  # all groups local
+    rx.stop()
+
+
+def test_ungrouped_handler_still_works_without_topology():
+    rx = Receiver()
+    q = PyOverwriteQueue(64)
+    rx.register_handler(MessageType.TAGGEDFLOW, [q])
+    _, raw = _frames_for_agents(1)[0]
+    rx._dispatch(FlowHeader.parse(raw[:HEADER_LEN]), raw, ("test", 0))
+    assert len(q) == 1
+    assert rx.get_counters()["frames_misrouted"] == 0
+    rx.stop()
+
+
+# ---------------------------------------------------------------------------
+# pipeline threading + per-host journal naming
+
+
+def _mk_swm(topology, group):
+    from deepflow_tpu.parallel.sharded import (
+        ShardedPipeline,
+        ShardedWindowManager,
+    )
+
+    # the mesh_harness config: identical shapes → the sharded kernels
+    # compiled by the multiproc oracle (earlier in the suite) are jit
+    # cache hits here
+    from mesh_harness import _sharded_cfg
+
+    return ShardedWindowManager(
+        ShardedPipeline(topology, _sharded_cfg(), shard_group=group), delay=2
+    )
+
+
+def test_sharded_pipeline_from_topology_keeps_axes_and_journals_per_host(
+    tmp_path,
+):
+    from deepflow_tpu.ingest.replay import SyntheticFlowGen
+
+    topo = MeshTopology.single(n_groups=2, devices_per_group=1)
+    wm = _mk_swm(topo, 1)
+    assert wm.pipe.axes == ("host", "chip")
+    assert wm.pipe.n_devices == 1
+    assert wm.pipe.topology is topo and wm.pipe.shard_group == 1
+    feeder = wm.make_feeder(
+        [PyOverwriteQueue(64)], (64, 128), journal_dir=tmp_path
+    )
+    jpath = tmp_path / "feeder.journal.g1.p0of1"
+    assert jpath.exists(), "journal filename must carry group + process"
+    gen = SyntheticFlowGen(num_tuples=16, seed=5)
+    fb = gen.flow_batch(64, T0)
+    wm.ingest(fb.tags, fb.meters, fb.valid)
+    fb2 = gen.flow_batch(64, T0 + 8)
+    assert wm.ingest(fb2.tags, fb2.meters, fb2.valid)  # windows closed
+    feeder._journal.close()
+    wm.close()
+
+
+def test_remote_group_pipeline_refused_at_construction():
+    from deepflow_tpu.parallel.sharded import ShardedPipeline
+
+    topo = MeshTopology.standalone(0, 2, devices_per_group=1)
+    with pytest.raises(ValueError, match="never crosses hosts"):
+        ShardedPipeline(topo, shard_group=1)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint topology validation (satellite: loud at load, not a shape
+# error deep in shard_map)
+
+
+def _ingest_one(wm):
+    from deepflow_tpu.ingest.replay import SyntheticFlowGen
+
+    gen = SyntheticFlowGen(num_tuples=16, seed=9)
+    fb = gen.flow_batch(32, T0)
+    wm.ingest(fb.tags, fb.meters, fb.valid)
+    return wm
+
+
+def test_sharded_checkpoint_validates_mesh_topology_loudly(tmp_path):
+    from deepflow_tpu.aggregator.checkpoint import (
+        read_checkpoint_meta,
+        restore_sharded_state,
+        save_sharded_state,
+    )
+
+    topo = MeshTopology.single(n_groups=2, devices_per_group=1)
+    wm = _ingest_one(_mk_swm(topo, 0))
+    path = tmp_path / "g0.ckpt"
+    save_sharded_state(wm, path)
+    meta = read_checkpoint_meta(path)
+    assert meta["process_count"] == 1 and meta["n_groups"] == 2
+    assert meta["shard_group"] == 0
+
+    # same topology, same group → restores
+    fresh = _mk_swm(MeshTopology.single(n_groups=2, devices_per_group=1), 0)
+    restore_sharded_state(fresh, path)
+    assert fresh.start_window == wm.start_window
+
+    # a different process count is a different mesh shape → loud
+    bad_topo = MeshTopology.standalone(0, 2, devices_per_group=1)
+    with pytest.raises(ValueError, match="mesh topology"):
+        restore_sharded_state(_mk_swm(bad_topo, 0), path)
+
+    # the right topology but the WRONG shard group → loud (the restore
+    # would silently serve another group's key-hash range)
+    with pytest.raises(ValueError, match="key-hash range"):
+        restore_sharded_state(
+            _mk_swm(MeshTopology.single(n_groups=2, devices_per_group=1), 1),
+            path,
+        )
+
+
+def test_multiproc_checkpoint_refuses_topologyless_restore(tmp_path):
+    from deepflow_tpu.aggregator import checkpoint as ckpt_mod
+    from deepflow_tpu.aggregator.checkpoint import (
+        restore_sharded_state,
+        save_sharded_state,
+    )
+    from deepflow_tpu.parallel.mesh import make_mesh
+    from deepflow_tpu.parallel.sharded import ShardedPipeline, ShardedWindowManager
+
+    topo = MeshTopology.single(n_groups=2, devices_per_group=1)
+    wm = _ingest_one(_mk_swm(topo, 0))
+    path = tmp_path / "g0.ckpt"
+    save_sharded_state(wm, path)
+
+    bare = ShardedWindowManager(
+        ShardedPipeline(make_mesh(1), wm.pipe.config), delay=2
+    )
+    # review regression: even a SINGLE-process save is one shard
+    # group's slice when n_groups > 1 — a bare manager restoring it
+    # would serve the full key range with only that group's stashes
+    with pytest.raises(ValueError, match="topology-less"):
+        restore_sharded_state(bare, path)
+
+    # forge a 2-process save (the single-process harness cannot produce
+    # one in-process; the meta contract is what matters here)
+    meta, arrays = ckpt_mod._read_checkpoint(path)
+    meta.pop("digest", None)
+    meta["process_count"] = 2
+    ckpt_mod._write_checkpoint(path, meta, arrays)
+    with pytest.raises(ValueError, match="topology-less"):
+        restore_sharded_state(bare, path)
+
+
+# ---------------------------------------------------------------------------
+# per-shard-group freshness lanes + cross-host trace identity
+
+
+def test_freshness_lanes_carry_group_label():
+    from deepflow_tpu.tracing.lineage import FreshnessTracker
+    from deepflow_tpu.utils.stats import StatsCollector
+
+    col = StatsCollector(interval_s=999)
+    ft = FreshnessTracker(name="gtest", group="3", collector=col)
+    ft.observe("flush", 1, 0.5, T0, "tid")
+    srcs = [s for s in col._sources if s.module == "tpu_freshness"]
+    assert srcs
+    tags = dict(srcs[0].tags)
+    assert tags.get("group") == "3"
+    assert tags.get("tier") == "1s"
+    ft.close()
+
+
+def test_trace_ids_are_host_invariant_but_lanes_are_per_group():
+    """One trace per window ACROSS hosts: the id is a pure function of
+    (service, window, interval) — two hosts' trackers for different
+    shard groups join the same trace with zero wire context."""
+    from deepflow_tpu.tracing.lineage import LineageTracker
+
+    a = LineageTracker(service="podsvc", interval=1, group="0")
+    b = LineageTracker(service="podsvc", interval=1, group="1")
+    try:
+        assert a.trace_id_of(12345) == b.trace_id_of(12345)
+        assert a.group == "0" and b.group == "1"
+    finally:
+        a.close()
+        b.close()
