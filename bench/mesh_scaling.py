@@ -2,7 +2,7 @@
 """Mesh-scaling rows for BASELINE config 5 — the r4 verdict's demand
 that c5 be a *mesh* statement, not a tunnel-latency measurement.
 
-Two recipes in one tool:
+Three recipes in one tool:
 
 **MESH_PROCS=N1,N2,... (ISSUE 14)** — the multi-HOST recipe: for each
 N, spawn N clean-env subprocesses (the dryrun_multichip pattern), each
@@ -15,6 +15,19 @@ itself is CI-pinned in tests/test_mesh_multiproc.py). Reports per-host
 and AGGREGATE rec/s per process count plus the distributed bring-up
 wall. Emits {"proc_rows": [...]} alongside (or instead of) the device
 rows; MESHBENCH_r01.json holds the committed snapshot.
+
+**MESH_REBALANCE=1 (ISSUE 15)** — the rebalance-pause protocol row
+(PERF.md §24): a feeder-shaped shard group on the OLD owner's
+standalone topology view is preloaded to a given state size and timed
+at steady state, then handed over — `GroupRebalancer.release` (quiesce
+→ manifest checkpoint → journal rotate) and `adopt`
+(restore_sharded_state into a fresh manager under the NEW owner's
+view) — with the pause decomposed into release/build/restore, the
+first post-adopt pump (the cold manager's compile) reported
+separately, and the per-step cadence walked until it re-enters 1.5× of
+the pre-handover steady step (recovery-to-steady). One row per
+MESH_REBALANCE_PRELOADS entry (state size sweep). Emits
+{"rebalance_rows": [...]}.
 
 **Default (device) recipe** — the single-process virtual CPU mesh at
 1/2/4/8 devices (XLA_FLAGS=--xla_force_host_platform_device_count=8,
@@ -325,7 +338,189 @@ def run_procs(proc_counts: list[int], iters: int,
     return rows
 
 
+# ---------------------------------------------------------------------------
+# rebalance-pause recipe (ISSUE 15)
+
+
+def _rebalance_row(preload_steps: int, iters: int) -> dict:
+    """One pause measurement at one state size, in a scratch dir that
+    is removed afterward (the large-preload checkpoints are exactly
+    the rows the state sweep makes big — repeated runs must not
+    accumulate them in /tmp)."""
+    import shutil
+    import tempfile
+    from pathlib import Path
+
+    d = Path(tempfile.mkdtemp(prefix="meshreb-"))
+    try:
+        return _rebalance_row_in(preload_steps, iters, d)
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def _rebalance_row_in(preload_steps: int, iters: int, d) -> dict:
+    """One pause measurement at one state size. Both topology views
+    live in THIS process (MeshTopology.standalone — the protocol is
+    control-plane only, so the pause does not depend on which process
+    hosts which half), which keeps the row a protocol cost, not a
+    process-spawn cost."""
+
+    from deepflow_tpu.aggregator.checkpoint import save_sharded_state
+    from deepflow_tpu.feeder import FeederConfig, encode_flowbatch_frames
+    from deepflow_tpu.ingest.queues import PyOverwriteQueue
+    from deepflow_tpu.parallel.rebalance import GroupRebalancer
+    from deepflow_tpu.parallel.topology import MeshTopology
+
+    group, old_pid, new_pid = 1, 1, 0
+    cfg = ShardedConfig(
+        capacity_per_device=1 << 13,
+        num_services=64,
+        hll_precision=8,
+        hist=LogHistSpec(bins=128, vmin=1.0, gamma=1.1),
+    )
+    buckets = (512, 1024, 2048)
+    t0s = 1_700_000_000
+    gen = SyntheticFlowGen(num_tuples=2000, seed=41)
+    ckpt = d / "handover.ckpt"
+
+    def build(pid, topology=None):
+        topo = topology if topology is not None else MeshTopology.standalone(
+            pid, 2, n_groups=2, devices_per_group=1
+        )
+        wm = ShardedWindowManager(
+            ShardedPipeline(topo, cfg, shard_group=group)
+        )
+        queues = [PyOverwriteQueue(1 << 12)]
+        jdir = d / f"p{pid}"
+        jdir.mkdir(exist_ok=True)
+        feeder = wm.make_feeder(
+            queues, buckets, FeederConfig(frames_per_queue=16),
+            journal_dir=jdir,
+        )
+        return topo, wm, queues, feeder
+
+    def step(queues, feeder, i):
+        n = buckets[i % len(buckets)] - (31 * i) % 128
+        for fr in encode_flowbatch_frames(
+            gen.flow_batch(n, t0s + 10 + i // 4),
+            agent_id=i, max_rows_per_frame=512,
+        ):
+            queues[0].put(fr)
+        feeder.pump()
+        return n
+
+    old_topo, wm_old, queues_old, feeder_old = build(old_pid)
+    # warm compiles, then preload to the target state size
+    records = 0
+    for i in range(preload_steps):
+        records += step(queues_old, feeder_old, i)
+    # steady cadence before the handover
+    t0 = time.perf_counter()
+    pre_records = sum(
+        step(queues_old, feeder_old, preload_steps + i)
+        for i in range(iters)
+    )
+    pre_s = time.perf_counter() - t0
+    pre_step_s = pre_s / iters
+    records += pre_records
+    # the group state the checkpoint actually captures: everything fed
+    # BEFORE the handover (recovery/post traffic is measurement-only)
+    records_at_handover = records
+
+    # -- the pause: release on the old owner ... -------------------------
+    reb_old = GroupRebalancer(old_topo)
+    plan = reb_old.plan(group, new_pid)
+    t_pause = time.perf_counter()
+    reb_old.release(
+        plan, feeder=feeder_old,
+        save=lambda extra: save_sharded_state(
+            wm_old, ckpt, extra_meta=extra
+        ),
+    )
+    release_ms = (time.perf_counter() - t_pause) * 1e3
+    # -- ... adopt on the new owner --------------------------------------
+    reb_new = GroupRebalancer(
+        MeshTopology.standalone(new_pid, 2, n_groups=2, devices_per_group=1)
+    )
+    plan2 = reb_new.plan(group, new_pid)
+    reb_new.claim(plan2)
+    t1 = time.perf_counter()
+    _topo, wm_new, queues_new, feeder_new = build(
+        new_pid, topology=plan2.topology
+    )
+    build_ms = (time.perf_counter() - t1) * 1e3
+    t1 = time.perf_counter()
+    reb_new.adopt(plan2, swm=wm_new, ckpt_path=str(ckpt))
+    restore_ms = (time.perf_counter() - t1) * 1e3
+    pause_ms = (time.perf_counter() - t_pause) * 1e3
+
+    # recovery: the first pump pays the fresh manager's compiles; walk
+    # the cadence until a step lands back inside 1.5× the pre-handover
+    # steady step
+    t1 = time.perf_counter()
+    records += step(queues_new, feeder_new, preload_steps + iters)
+    first_pump_ms = (time.perf_counter() - t1) * 1e3
+    recovery_steps = 1
+    t_rec = time.perf_counter()
+    for i in range(1, 4 * iters):
+        t1 = time.perf_counter()
+        records += step(queues_new, feeder_new, preload_steps + iters + i)
+        recovery_steps += 1
+        if time.perf_counter() - t1 <= 1.5 * pre_step_s:
+            break
+    recovery_ms = first_pump_ms + (time.perf_counter() - t_rec) * 1e3
+    t0 = time.perf_counter()
+    post_records = sum(
+        step(queues_new, feeder_new, preload_steps + 5 * iters + i)
+        for i in range(iters)
+    )
+    post_s = time.perf_counter() - t0
+    return {
+        "preload_steps": preload_steps,
+        "records_at_handover": int(records_at_handover),
+        "ckpt_bytes": int(os.path.getsize(ckpt)),
+        "pause_ms": round(pause_ms, 2),
+        "release_ms": round(release_ms, 2),
+        "build_ms": round(build_ms, 2),
+        "restore_ms": round(restore_ms, 2),
+        "first_pump_ms": round(first_pump_ms, 2),
+        "recovery_ms": round(recovery_ms, 2),
+        "recovery_steps": recovery_steps,
+        "pre_rec_s": round(pre_records / max(pre_s, 1e-9), 1),
+        "post_rec_s": round(post_records / max(post_s, 1e-9), 1),
+    }
+
+
+def run_rebalance(preloads: list[int], iters: int,
+                  rows: list[dict] | None = None) -> list[dict]:
+    rows = [] if rows is None else rows
+    for p in preloads:
+        rows.append(_rebalance_row(p, iters))
+    return rows
+
+
 def main():
+    reb_env = os.environ.get("MESH_REBALANCE", "")
+    if reb_env:
+        preloads = [
+            int(p) for p in os.environ.get(
+                "MESH_REBALANCE_PRELOADS", "8,32"
+            ).split(",") if p
+        ]
+        iters = int(os.environ.get("MESHBENCH_ITERS", 24))
+        rows = []
+        try:
+            run_rebalance(preloads, iters, rows)
+            print(json.dumps({"rebalance_rows": rows}), flush=True)
+        except Exception as e:  # parseable partial, never a traceback
+            print(
+                json.dumps({
+                    "rebalance_rows": rows, "partial": True,
+                    "error": repr(e),
+                }),
+                flush=True,
+            )
+        return
     proc_env = os.environ.get("MESH_PROCS", "")
     if proc_env:
         proc_counts = [int(p) for p in proc_env.split(",") if p]

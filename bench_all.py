@@ -668,6 +668,37 @@ def config15(quick: bool):
          init_s_max=last["init_s_max"], rows=rows)
 
 
+def config16(quick: bool):
+    """Rebalance-pause protocol (ISSUE 15): bench/mesh_scaling.py
+    MESH_REBALANCE=1 — the shard-group handover pause (quiesce →
+    manifest checkpoint → restore on the new owner) decomposed by
+    phase, plus recovery-to-steady rate, swept over group state size
+    (protocol + committed CPU numbers: PERF.md §24). The headline value
+    is the largest-state row's pause; vs_baseline is post/pre steady
+    rate — 1.0 means the flip left no lingering cost."""
+    import os
+    import subprocess
+
+    env = {**os.environ, "MESH_REBALANCE": "1"}
+    if quick:
+        env["MESH_REBALANCE_PRELOADS"] = "8"
+        env["MESHBENCH_ITERS"] = "8"
+    out = subprocess.run(
+        [sys.executable, "bench/mesh_scaling.py"],
+        capture_output=True, text=True, timeout=1800, env=env,
+    )
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    rows = rec.get("rebalance_rows", [])
+    if rec.get("partial") or not rows:
+        emit("c16_rebalance_pause", 0, "error", 0, error=rec.get("error"))
+        return
+    last = rows[-1]
+    emit("c16_rebalance_pause", last["pause_ms"], "ms",
+         last["post_rec_s"] / max(last["pre_rec_s"], 1e-9),
+         ckpt_bytes=last["ckpt_bytes"], recovery_ms=last["recovery_ms"],
+         first_pump_ms=last["first_pump_ms"], rows=rows)
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--cpu", action="store_true")
@@ -675,7 +706,7 @@ def main():
     args = p.parse_args()
     for fn in (config1, config2, config3, config4, config5, config6, config7,
                config8, config9, config10, config11, config12, config13,
-               config14, config15):
+               config14, config15, config16):
         try:
             fn(args.quick)
         except Exception as e:  # one config must not kill the others

@@ -563,6 +563,72 @@ def load_window_state(
 # sharded ShardedWindowManager
 
 
+def _validate_ownership_transfer(meta: dict, topo, shard_group: int,
+                                 path) -> None:
+    """Elastic-topology restore contract (ISSUE 15): same-process
+    restores (the r11/r18 kill-and-recover path) need nothing; a
+    cross-process restore is legal ONLY through a handover manifest
+    (`parallel/rebalance.transfer_manifest`) naming this process and
+    this topology epoch. Every refusal names both epochs — the one the
+    checkpoint was published under and the one this process restores
+    into — so a stale pre-handover file is diagnosable at a glance."""
+    saved_pi = meta.get("process_index")
+    if saved_pi is None:
+        return  # pre-topology file: the normal restore
+    saved_epoch = meta.get("topology_epoch", 0)
+    hand = meta.get("handover")
+    here = (
+        f"process {topo.process_index} at topology epoch "
+        f"{topo.topology_epoch}"
+    )
+    if int(saved_pi) == topo.process_index:
+        # same host: the r11/r18 kill-and-recover path — EXCEPT a
+        # handover checkpoint that transfers the group AWAY. The old
+        # owner restoring its own handover barrier would resurrect a
+        # group another process now serves (split-brain over one
+        # key-hash range); only the named to_process may load it.
+        if hand is not None and int(
+            hand.get("to_process", -1)
+        ) != topo.process_index:
+            raise ValueError(
+                f"checkpoint {path} is the handover barrier that "
+                f"transferred group {hand.get('group')} to process "
+                f"{hand.get('to_process')} (epoch "
+                f"{hand.get('topology_epoch')}); {here} released it — "
+                "restoring it here would serve the group on two hosts "
+                "at once"
+            )
+        return
+    if hand is None:
+        raise ValueError(
+            f"checkpoint {path} was saved by process {saved_pi} at "
+            f"topology epoch {saved_epoch} with NO ownership-transfer "
+            f"manifest, but {here} is restoring it — a stale "
+            "(pre-handover) checkpoint cannot change hosts; re-run the "
+            "handover so the owner publishes a manifest-bearing barrier "
+            "checkpoint"
+        )
+    if int(hand.get("to_process", -1)) != topo.process_index:
+        raise ValueError(
+            f"checkpoint {path} transfers group {hand.get('group')} to "
+            f"process {hand.get('to_process')} (epoch "
+            f"{hand.get('topology_epoch')}), but {here} is restoring it"
+        )
+    if int(hand.get("group", -1)) != int(shard_group):
+        raise ValueError(
+            f"checkpoint {path} ownership-transfer manifest names group "
+            f"{hand.get('group')} but this manager serves group "
+            f"{shard_group}"
+        )
+    if int(hand.get("topology_epoch", -1)) != topo.topology_epoch:
+        raise ValueError(
+            f"checkpoint {path} was handed over under topology epoch "
+            f"{hand.get('topology_epoch')} but {here} — the checkpoint "
+            "is stale relative to this rebalance (or this process never "
+            "applied the move); publish a fresh handover barrier"
+        )
+
+
 def save_sharded_state(swm, path: str | Path, *, extra_meta=None) -> list:
     """Snapshot a ShardedWindowManager (kind="sharded"). Folds the
     accumulator ring first (sharded flushes are synchronous, so unlike
@@ -682,6 +748,12 @@ def restore_sharded_state(swm, path: str | Path):
                 f"manager serves group {swm.pipe.shard_group} — restoring "
                 "it here would serve another group's key-hash range"
             )
+        # elastic topology (ISSUE 15): a checkpoint restoring onto a
+        # DIFFERENT process must carry an ownership-transfer manifest
+        # published for THIS topology epoch — a stale (pre-handover)
+        # save, or one published under some other rebalance, would
+        # silently split the group's key range across two owners
+        _validate_ownership_transfer(meta, topo, swm.pipe.shard_group, path)
     elif ck_pc is not None and (
         int(ck_pc) > 1 or int(meta.get("n_groups", 1)) > 1
     ):

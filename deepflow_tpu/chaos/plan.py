@@ -23,6 +23,30 @@ that harness:
 
 Frame-corruption helpers (`truncate_frame` / `bitflip_frame`) cover
 the fault class that arrives as bytes rather than exceptions.
+
+THE SEAM LIST (ISSUE 15 satellite — the named seams have grown across
+r11/r15/r18 and were only discoverable by grep; this table is the one
+place that enumerates them). Every seam is a `chaos.maybe_fail(site)`
+call in production code; the "fires in" column is the exact module:
+
+    site              fires in                          covers
+    ----------------  --------------------------------  -----------------------------
+    device.dispatch   aggregator/window.py,             fused-step dispatch (single-
+                      parallel/sharded.py               chip AND sharded)
+    host.fetch        aggregator/window.py,             device→host fetch (the
+                      parallel/sharded.py               ≤3-fetch budget's seam)
+    feeder.decode     feeder/runtime.py                 sink codec decode (poisoned-
+                      (FrameCodecBase.decode_frame)     frame quarantine boundary)
+    sink.write        storage/writer.py                 TableWriter → store.insert
+    checkpoint.io     aggregator/checkpoint.py          window-state snapshot write
+    journal.io        feeder/journal.py                 frame-journal append/rotate
+    handoff.send      ingest/handoff.py                 misroute-handoff transport
+                      (HandoffSender peer loop)         write (ISSUE 15: scripted
+                                                        transport loss)
+    rebalance.step    parallel/rebalance.py             each protocol step of a
+                      (GroupRebalancer release/adopt)   shard-group handover
+                                                        (ISSUE 15: mid-protocol
+                                                        death via KillPoint)
 """
 
 from __future__ import annotations
@@ -43,6 +67,8 @@ SITE_DECODE = "feeder.decode"  # sink codec decode (quarantine boundary)
 SITE_SINK_WRITE = "sink.write"  # storage TableWriter → store.insert
 SITE_CHECKPOINT_IO = "checkpoint.io"  # window-state snapshot write
 SITE_JOURNAL_IO = "journal.io"  # frame-journal append/rotate
+SITE_HANDOFF_SEND = "handoff.send"  # misroute-handoff transport write
+SITE_REBALANCE_STEP = "rebalance.step"  # shard-group handover protocol step
 
 FAULT_SITES = (
     SITE_DISPATCH,
@@ -51,6 +77,8 @@ FAULT_SITES = (
     SITE_SINK_WRITE,
     SITE_CHECKPOINT_IO,
     SITE_JOURNAL_IO,
+    SITE_HANDOFF_SEND,
+    SITE_REBALANCE_STEP,
 )
 
 
@@ -90,6 +118,15 @@ class KillPoint(BaseException):
     quarantine guards catch Exception, so a KillPoint rips straight
     through to the test driver exactly like SIGKILL would — nothing
     in-process may 'handle' its own death."""
+
+
+class RebalanceAbortError(Exception):
+    """A shard-group handover (parallel/rebalance.py) could not
+    complete: quiesce never drained, the barrier checkpoint aborted, a
+    concurrent rebalance holds the single-flight guard, or a scripted
+    fault at the `rebalance.step` seam. Part of the fault taxonomy so
+    CI can inject it mid-protocol; also raised by the real protocol —
+    the old owner keeps serving the group, nothing has moved."""
 
 
 # ---------------------------------------------------------------------------

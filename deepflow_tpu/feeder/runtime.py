@@ -1014,6 +1014,74 @@ class FeederRuntime:
             self.last_checkpoint_ok = True
             return out
 
+    def quiesce(self, save, *, max_pumps: int = 64) -> list:
+        """Drain-to-barrier for an ownership handover (ISSUE 15): pump
+        until every queue is empty and no rows are pending, then run
+        the flush→snapshot→rotate checkpoint barrier. The resulting
+        snapshot + rotated journal are the complete transferable state
+        of this feeder's sink — the old owner of a rebalancing shard
+        group calls this, the new owner restores from what it wrote.
+
+        Loud by contract: a queue whose backlog stops SHRINKING across
+        a full pump (a producer still feeding it — the caller must
+        fence admission FIRST, e.g. by flipping the receiver's route
+        epoch) or an aborted barrier checkpoint raises
+        RebalanceAbortError — a handover must never publish state it
+        is not sure is complete. `max_pumps` is slack on top of the
+        backlog-sized budget (each pump drains a bounded frame budget,
+        so a large FENCED backlog legitimately needs many pumps — the
+        abort keys on progress, not an iteration count). Returns every
+        output the drain and barrier flushed; the caller emits them
+        before treating the handover as durable (the checkpoint()
+        contract)."""
+        from ..chaos import RebalanceAbortError
+
+        with self._pump_mutex:
+            out: list = []
+            qlen = sum(len(q) for q in self.queues)
+            # fenced admission ⇒ every pump strictly shrinks the
+            # backlog ⇒ at most one pump per queued frame (+ slack for
+            # pending-row tail emits); unfenced admission trips the
+            # no-progress check long before this budget
+            for _ in range(qlen + max_pumps):
+                out.extend(self.pump())
+                if self._rows == 0 and all(
+                    len(q) == 0 for q in self.queues
+                ):
+                    break
+                now_qlen = sum(len(q) for q in self.queues)
+                if now_qlen >= qlen and now_qlen > 0:
+                    err = RebalanceAbortError(
+                        f"feeder {self.name}: queue backlog did not "
+                        f"shrink across a quiesce pump ({qlen} → "
+                        f"{now_qlen} frames) — admission was not "
+                        "fenced before the handover (flip the route "
+                        "epoch first)"
+                    )
+                    err.outputs = out  # already-flushed windows must
+                    # still reach the caller: the abort cancels the
+                    # MOVE, not the drain's deliveries
+                    raise err
+                qlen = now_qlen
+            else:
+                err = RebalanceAbortError(
+                    f"feeder {self.name}: rows still pending after the "
+                    "quiesce pump budget — the sink is not draining"
+                )
+                err.outputs = out
+                raise err
+            out.extend(self.checkpoint(save))
+            if not self.last_checkpoint_ok:
+                err = RebalanceAbortError(
+                    f"feeder {self.name}: handover barrier checkpoint "
+                    "aborted — state not transferable; the previous "
+                    "checkpoint and the un-rotated journal still "
+                    "recover everything on THIS host"
+                )
+                err.outputs = out
+                raise err
+            return out
+
     def replay_journal(self, path, *, barrier: dict | None = None) -> list:
         """Recovery: replay a (crashed) feeder's journal through the
         NORMAL decode path. FRAME records flow through _process_frame

@@ -53,7 +53,8 @@ class AgentStatus:
 class Receiver:
     """Framed TCP/UDP intake with per-msg-type queue fanout."""
 
-    def __init__(self, host: str = "127.0.0.1", tcp_port: int = 0, udp_port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", tcp_port: int = 0, udp_port: int = 0,
+                 *, held_frames_cap: int = 256):
         self.host = host
         self.tcp_port = tcp_port
         self.udp_port = udp_port
@@ -84,7 +85,25 @@ class Receiver:
             "frames_misrouted": 0,
             "frames_handoff": 0,
             "handoff_errors": 0,
+            # epoch-flip hold buffer (ISSUE 15): frames for a group
+            # this process owns in the NEW epoch but whose handler is
+            # still mid-restore are held-and-redelivered, never counted
+            # as misroutes against a peer that no longer owns them;
+            # overflow sheds the OLDEST held frame, counted
+            "frames_held": 0,
+            "frames_held_dropped": 0,
+            "frames_redelivered": 0,
         }
+        # bounded (msg_type, group, raw_frame, addr) hold ring — sized
+        # for the re-route window of one rebalance, not a durability
+        # buffer (the journal is; this only bridges the flip)
+        self._held_cap = int(held_frames_cap)
+        self._held: list = []
+        # serializes whole redelivery PASSES (the swap is under
+        # _stats_lock, but routing the swapped batch happens outside
+        # it — two concurrent passes could interleave one agent's
+        # frames out of arrival order)
+        self._redeliver_mutex = threading.Lock()
         # multi-host fan-in (ISSUE 14): key-hash topology routing +
         # the control-plane forward for misrouted frames, published as
         # ONE immutable (topology, handoff, epoch) tuple so a dispatch
@@ -121,6 +140,13 @@ class Receiver:
         return out
 
     # -- key-hash fan-in routing (ISSUE 14) ------------------------------
+    @property
+    def routing(self):
+        """The published (topology, handoff, epoch) tuple, or None
+        before any attach — the rebalance rollback reads the pre-flip
+        handoff from here so an aborted move restores forwarding."""
+        return self._routing
+
     def attach_topology(self, topology, handoff=None) -> None:
         """Route agents to shard groups by key-hash (MeshTopology.
         group_for_agent over the packed identity words). Frames of
@@ -135,10 +161,16 @@ class Receiver:
         Routing applies PER MESSAGE TYPE, and only to types with at
         least one group-registered handler — lanes whose handlers are
         all ungrouped (METRICS, SYSLOG, ...) keep delivering every
-        agent's frames locally, sharded-plane topology or not."""
+        agent's frames locally, sharded-plane topology or not.
+
+        Re-attaching publishes a new epoch (ISSUE 15 rebalance flip):
+        per-agent route caches invalidate, and any held frames re-route
+        under the new table — a frame held for a group this process
+        just stopped owning forwards instead of rotting in the hold."""
         self._route_epoch += 1
         # single atomic publish: dispatch threads read the tuple once
         self._routing = (topology, handoff, self._route_epoch)
+        self._redeliver_held()
 
     # -- registry (receiver.go:444 RegistHandler) -----------------------
     def register_handler(self, msg_type: MessageType, queues: list,
@@ -161,6 +193,10 @@ class Receiver:
         self._queue_stat_sources += register_queue_stats(
             "ingest_queue", queues, **tags
         )
+        # epoch-flip hold (ISSUE 15): frames that arrived for this
+        # group while its handler was mid-restore redeliver now, in
+        # arrival order, ahead of anything the conn threads enqueue next
+        self._redeliver_held()
 
     # -- lifecycle ------------------------------------------------------
     def start(self) -> None:
@@ -239,11 +275,19 @@ class Receiver:
             st.last_seen = time.time()
             st.frames += 1
             st.bytes += len(raw_frame)
+        self._route_frame(header, raw_frame, addr, st)
 
+    def _route_frame(self, header: FlowHeader, raw_frame: bytes, addr,
+                     st: "AgentStatus", *, from_hold: bool = False) -> bool:
+        """Route one rx-accounted frame: key-hash topology routing,
+        misroute handoff, the epoch-flip hold buffer, queue fanout.
+        Shared by live dispatch and held-frame redelivery (which must
+        not re-count rx). Returns False only when the frame was
+        (re-)held."""
         groups = self._handlers.get(header.msg_type)
         if not groups:
             self._count("no_handler")
-            return
+            return True
         routing = self._routing  # one read: (topology, handoff, epoch)
         group = None
         if routing is not None and any(k is not None for k in groups):
@@ -277,13 +321,35 @@ class Receiver:
                         # the forward path must never raise into the
                         # conn/UDP loop; the drop is counted
                         self._count("handoff_errors")
-                return
+                return True
         queues = groups.get(group)
         if queues is None and group is not None:
             queues = groups.get(None)
         if not queues:
+            if group is not None:
+                # epoch-flip hold (ISSUE 15): this process owns the
+                # group in the CURRENT epoch but its handler is still
+                # mid-restore — hold and redeliver at register_handler
+                # instead of counting a misroute against a peer that no
+                # longer owns the group (or dropping outright)
+                self._hold_frame(raw_frame, addr, recount=not from_hold)
+                if not from_hold:
+                    # close the hold-vs-register race: if the handler
+                    # (or a new epoch) landed between our registry read
+                    # and the hold append, ITS redelivery pass has
+                    # already drained — re-drain so this frame cannot
+                    # strand in the hold until some future flip. The
+                    # hold append and the registering thread's drain
+                    # serialize on _stats_lock, so one of the two
+                    # passes always sees the frame.
+                    now = self._handlers.get(header.msg_type)
+                    if self._routing is not routing or (
+                        now is not None and now.get(group) is not None
+                    ):
+                        self._redeliver_held()
+                return False
             self._count("no_handler")
-            return
+            return True
         q = queues[header.agent_id % len(queues)]
         # a handler shutting down mid-stream closes its queues; frames
         # racing that close are counted and skipped — never raised into
@@ -293,17 +359,66 @@ class Receiver:
         # fast path and for queue impls whose put has no return signal.
         if getattr(q, "closed", False):
             self._count("queue_closed")
-            return
+            return True
         try:
             if q.put(raw_frame) is False:
                 self._count("queue_closed")
-                return
+                return True
         except Exception:
             self._count("queue_closed")
-            return
+            return True
         lin = self.lineage
         if lin is not None:
             lin.note_admit()
+        return True
+
+    # -- epoch-flip hold buffer (ISSUE 15) -------------------------------
+    def _hold_frame(self, raw_frame: bytes, addr, *,
+                    recount: bool = True) -> None:
+        """Bounded hold: overflow sheds the OLDEST held frame, counted
+        (`frames_held_dropped`) — freshest-wins, the OverwriteQueue
+        stance. Only (frame, addr) is held: redelivery re-parses the
+        header and re-routes under the CURRENT table, never the
+        held-time msg_type/group."""
+        with self._stats_lock:
+            self._held.append((raw_frame, addr))
+            if recount:
+                self.counters["frames_held"] += 1
+            if len(self._held) > self._held_cap:
+                self._held.pop(0)
+                self.counters["frames_held_dropped"] += 1
+
+    def _redeliver_held(self) -> None:
+        """Re-route every held frame under the current handler registry
+        and epoch (called after register_handler / attach_topology).
+        Frames that still have no home re-hold without recounting;
+        everything else leaves through its normal counted lane. The
+        whole pass serializes on _redeliver_mutex: a second caller
+        (conn thread closing the hold-vs-register race) blocks until
+        the first batch has fully routed, so one agent's held frames
+        always leave in arrival order."""
+        with self._redeliver_mutex:
+            with self._stats_lock:
+                if not self._held:
+                    return
+                held, self._held = self._held, []
+            for raw_frame, addr in held:
+                try:
+                    header = FlowHeader.parse(raw_frame[:HEADER_LEN])
+                except ValueError:
+                    self._count("bad_frames")
+                    continue
+                key = (header.organization_id, header.agent_id)
+                with self._stats_lock:
+                    st = self.agents.get(key)
+                    if st is None:
+                        st = self.agents[key] = AgentStatus(
+                            header.agent_id, header.organization_id,
+                            header.team_id, addr,
+                        )
+                if self._route_frame(header, raw_frame, addr, st,
+                                     from_hold=True):
+                    self._count("frames_redelivered")
 
     # -- TCP ------------------------------------------------------------
     def _accept_loop(self) -> None:

@@ -113,6 +113,19 @@ class MeshTopology:
     # is_distributed — the `distributed` classmethod shares the class
     # namespace)
     is_distributed: bool = False
+    # elastic topology (ISSUE 15): rebalance overrides on top of the
+    # block assignment — ((group, process), ...) pairs in ADOPTION
+    # ORDER (first override first; a group's re-move updates its entry
+    # in place) — plus the epoch that stamps them. Every host derives
+    # the SAME (overrides, epoch) by applying the same rebalance
+    # history, so epoch comparison is a pure handshake: a checkpoint
+    # handover names the epoch it was published under and restore
+    # validates it. Order is load-bearing: an adopted group's device
+    # slice is its position among this process's adoptions, so a LATER
+    # adoption (even of a lower-numbered group) never re-homes a live
+    # adopted group's devices.
+    group_overrides: tuple = ()
+    topology_epoch: int = 0
 
     def __post_init__(self):
         if not (0 <= self.process_index < self.process_count):
@@ -125,11 +138,21 @@ class MeshTopology:
                 f"{self.n_groups} shard groups cannot block-assign onto "
                 f"{self.process_count} processes (must divide evenly)"
             )
-        need = self.groups_per_process * self.devices_per_group
+        for g, p in self.group_overrides:
+            self._check_group(g)
+            if not (0 <= p < self.process_count):
+                raise ValueError(
+                    f"override sends group {g} to process {p}, outside "
+                    f"[0, {self.process_count})"
+                )
+        need = (
+            self.groups_per_process + len(self._adopted_groups())
+        ) * self.devices_per_group
         if len(self.local_devices) < need:
             raise ValueError(
                 f"process {self.process_index} owns "
-                f"{self.groups_per_process} groups × "
+                f"{self.groups_per_process} block groups + "
+                f"{len(self._adopted_groups())} adopted groups × "
                 f"{self.devices_per_group} devices = {need} devices but "
                 f"only {len(self.local_devices)} are local"
             )
@@ -202,13 +225,62 @@ class MeshTopology:
         return self.n_groups // self.process_count
 
     def group_process(self, group: int) -> int:
-        """The process that owns `group` (block assignment)."""
+        """The process that owns `group` (block assignment, unless a
+        rebalance override moved it — ISSUE 15)."""
         self._check_group(group)
+        for g, p in self.group_overrides:
+            if g == group:
+                return p
         return group // self.groups_per_process
+
+    def _adopted_groups(self) -> tuple[int, ...]:
+        """Groups this process owns via a rebalance override, in
+        ADOPTION order — they sit on local device slices AFTER the
+        block-assigned ones, so an adoption never re-homes a live
+        block group's mesh, and the order (not the group number)
+        picks the slice, so a later adoption never re-homes an
+        earlier one's either."""
+        gpp = self.groups_per_process
+        return tuple(
+            g for g, p in self.group_overrides
+            if p == self.process_index and g // gpp != self.process_index
+        )
 
     def owned_groups(self) -> tuple[int, ...]:
         g0 = self.process_index * self.groups_per_process
-        return tuple(range(g0, g0 + self.groups_per_process))
+        block = tuple(
+            g for g in range(g0, g0 + self.groups_per_process)
+            if self.group_process(g) == self.process_index
+        )
+        return block + self._adopted_groups()
+
+    def rebalanced(self, group: int, to_process: int) -> "MeshTopology":
+        """Publish a new topology epoch that moves `group` to
+        `to_process` (ISSUE 15 — the controller-driven remap). Pure:
+        every host applying the same move to the same epoch derives an
+        IDENTICAL topology, so the epoch number alone is the handshake
+        the checkpoint-handover manifest validates against. Loud when
+        the destination lacks spare local devices (checked on the
+        destination's own view at construction)."""
+        self._check_group(group)
+        if not (0 <= to_process < self.process_count):
+            raise ValueError(
+                f"cannot move group {group} to process {to_process}: "
+                f"outside [0, {self.process_count})"
+            )
+        overrides = dict(self.group_overrides)  # preserves adoption order
+        # drop the group's old entry FIRST: a re-adoption must append
+        # as the NEWEST adoption — updating in place would resurrect
+        # its original position and re-home every adopted group that
+        # arrived after it left (their slices are positional)
+        overrides.pop(group, None)
+        if group // self.groups_per_process != to_process:
+            overrides[group] = to_process
+        return dataclasses.replace(
+            self,
+            group_overrides=tuple(overrides.items()),
+            topology_epoch=self.topology_epoch + 1,
+        )
 
     def owns_group(self, group: int) -> bool:
         self._check_group(group)
@@ -239,7 +311,21 @@ class MeshTopology:
                 f"({self.process_index}) — the data path never crosses "
                 "hosts; route the frames there instead (key-hash fan-in)"
             )
-        k = group - self.process_index * self.groups_per_process
+        adopted = self._adopted_groups()
+        if group in adopted:
+            # adopted groups (rebalance overrides, ISSUE 15) sit on the
+            # spare local slices AFTER the block range, in ADOPTION
+            # order — a released block group's slice is deliberately
+            # NOT reused and a later adoption appends, so no live
+            # group's devices change under an adopting flip. (Releasing
+            # an ADOPTED group compacts the later adopted slices — the
+            # protocol rebuilds the moving group's manager from its
+            # checkpoint anyway, and a host releasing one of several
+            # adopted groups must rebuild the later-adopted managers
+            # the same way.)
+            k = self.groups_per_process + adopted.index(group)
+        else:
+            k = group - self.process_index * self.groups_per_process
         devs = self.local_devices[
             k * self.devices_per_group : (k + 1) * self.devices_per_group
         ]
@@ -288,6 +374,10 @@ class MeshTopology:
             "process_count": self.process_count,
             "n_groups": self.n_groups,
             "devices_per_group": self.devices_per_group,
+            # elastic topology (ISSUE 15): the epoch this checkpoint
+            # was saved under — restore on a DIFFERENT process requires
+            # an ownership-transfer manifest naming a matching epoch
+            "topology_epoch": self.topology_epoch,
         }
 
     def validate_restore(self, meta: dict, path) -> None:

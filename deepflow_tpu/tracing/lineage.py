@@ -350,6 +350,21 @@ class FreshnessTracker:
                 out[f"{iv}s.{k}"] = v
         return out
 
+    def hist_dump(self) -> dict[str, list[int]]:
+        """lane → raw log-histogram bin counts (nonzero (bin, count)
+        pairs, compact). The elastic-topology proof (ISSUE 15) pins
+        that a rebalanced group's lag distribution across BOTH owners
+        sums bin-for-bin to the uninterrupted oracle's — histograms
+        add; quantile summaries don't."""
+        with self._lock:
+            return {
+                f"{iv}s.{kind}": [
+                    [int(b), int(lane.hist[b])]
+                    for b in np.nonzero(lane.hist)[0]
+                ]
+                for (iv, kind), lane in self._lanes.items()
+            }
+
     def exemplars(self) -> dict[str, dict]:
         """lane → {trace_id, window, lag_ms}: the metric→trace links a
         dashboard renders next to each lag series (the ISSUE 13
@@ -505,6 +520,52 @@ class LineageTracker:
             kind, rec.interval, lag, rec.window_idx,
             window_trace_id(self.service, rec.window_idx, rec.interval),
         )
+
+    # -- ownership handover (ISSUE 15) ------------------------------------
+    def export_open(self, lo_window: int) -> dict:
+        """Serialize the hop records of every still-open window (≥
+        `lo_window` on the base tier) for a shard-group handover: the
+        moving group's state checkpoint carries its windows' partial
+        aggregates, and THIS carries their partial lineage — so the
+        new owner's flush still observes the ingest lag a window
+        accrued on the old owner, and its trace joins the hops from
+        both hosts (ids are derived, so no id mapping is needed)."""
+        with self._lock:
+            wins = []
+            for (iv, w), rec in self._windows.items():
+                if iv != self.interval or w < int(lo_window):
+                    continue
+                wins.append({
+                    "window": w,
+                    "hops": {
+                        h: [a.start_s, a.end_s, a.count, a.rows]
+                        for h, a in rec.hops.items()
+                    },
+                })
+            return {"interval": self.interval, "windows": wins}
+
+    def import_open(self, data: dict) -> None:
+        """Adopt exported open-window lineage (the export_open twin on
+        the new owner). Hop aggregates merge, so importing into a
+        tracker that already saw post-flip traffic for a window is
+        safe — first-start/last-end semantics hold across hosts."""
+        with self._lock:
+            for win in data.get("windows", ()):
+                rec = self._record(
+                    int(data.get("interval", self.interval)),
+                    int(win["window"]),
+                )
+                for hop, vals in win["hops"].items():
+                    start_s, end_s, count, rows = (
+                        float(vals[0]), float(vals[1]),
+                        int(vals[2]), int(vals[3]),
+                    )
+                    rec.note(hop, start_s, end_s, rows)
+                    agg = rec.hops[hop]
+                    # note() counted one event; fold the remaining
+                    # event count in so RED rates stay truthful
+                    agg.count += count - 1
+                self._dirty.add((rec.interval, rec.window_idx))
 
     # -- pre-window context (receiver / feeder / journal / upload) --------
     def note_admit(self, t: float | None = None) -> None:

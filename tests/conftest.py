@@ -39,3 +39,35 @@ except Exception:  # pragma: no cover - older jax fallback
 assert jax.devices()[0].platform == "cpu", jax.devices()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: depth tier excluded from tier-1 (`-m 'not slow'`) to hold "
+        "the suite under the 870 s gate — the heaviest fuzz pins for "
+        "non-default modes live here; run them with `-m slow` (or no "
+        "marker filter) when touching their subsystem",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    """Start the mesh-harness prewarm at COLLECTION time when any
+    harness-consuming test is in the run. The memoized multi-subprocess
+    artifacts (oracle/mesh2/mesh2_kill/rebalance/rebalance_kill/
+    rb_oracle) cost ~2 min of build wall; started here they overlap
+    the first ~40% of the suite instead of serializing into the middle
+    of it — the difference between tier-1 fitting the 870 s cap and
+    riding it. Gated on the consumers so `pytest -k one_fast_test`
+    does not spawn subprocess fleets it will never use."""
+    heavy = (
+        "test_mesh_multiproc", "test_mesh_rebalance", "test_perf_gate",
+        "test_recovery",
+    )
+    if any(
+        any(h in str(item.fspath) for h in heavy) for item in items
+    ):
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import mesh_harness
+
+        mesh_harness.prewarm_async()

@@ -1,0 +1,176 @@
+"""ISSUE 15: the misroute-handoff transport (ingest/handoff.py) on its
+own — real sockets, chaos-scripted transport loss at the
+`handoff.send` seam, and the counted-shed contract on every loss lane
+(unknown peer, unreachable peer, bounded-queue overwrite, shutdown).
+The end-to-end forwarding window (old owner → wire → new owner's hold
+buffer → redelivery) is tests/test_mesh_rebalance.py; this file pins
+the transport's own semantics single-process."""
+
+from __future__ import annotations
+
+import socket
+import time
+
+import pytest
+
+from deepflow_tpu import chaos
+from deepflow_tpu.ingest.framing import MessageType
+from deepflow_tpu.ingest.handoff import (
+    HandoffReceiver,
+    HandoffSender,
+    HandoffUnreachable,
+)
+from deepflow_tpu.ingest.queues import PyOverwriteQueue
+from deepflow_tpu.ingest.receiver import Receiver
+
+
+def _frame(agent_id: int = 3) -> bytes:
+    from deepflow_tpu.feeder import encode_flowbatch_frames
+    from deepflow_tpu.ingest.replay import SyntheticFlowGen
+
+    fb = SyntheticFlowGen(num_tuples=8, seed=9).flow_batch(4, 1_700_000_000)
+    (raw,) = encode_flowbatch_frames(fb, agent_id=agent_id)
+    return raw
+
+
+def _await(cond, what: str, timeout_s: float = 20.0) -> None:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _closed_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _rx_pair():
+    """A started HandoffReceiver feeding a Receiver with one ungrouped
+    TAGGEDFLOW handler queue."""
+    rx = Receiver()
+    q = PyOverwriteQueue(64)
+    rx.register_handler(MessageType.TAGGEDFLOW, [q])
+    hr = HandoffReceiver(rx)
+    hr.start()
+    return rx, hr, q
+
+
+def test_sender_delivers_frames_verbatim_over_the_wire():
+    rx, hr, q = _rx_pair()
+    sender = HandoffSender({0: ("127.0.0.1", hr.port)})
+    try:
+        frames = [_frame(a) for a in (3, 5, 9)]
+        for raw in frames:
+            sender.send(0, raw)
+        assert sender.flush(20.0)
+        _await(lambda: hr.get_counters()["rx_frames"] == 3, "3 rx frames")
+        # verbatim: the receiving dispatch saw the SAME bytes the codec
+        # lanes framed — no re-encoding on the wire
+        assert [q.gets(1, timeout_ms=100)[0] for _ in frames] == frames
+        c = sender.get_counters()
+        assert c["tx_frames"] == 3
+        assert c["shed_frames"] == 0 and c["send_errors"] == 0
+        assert hr.get_counters()["bad_frames"] == 0
+        # rx accounting is the handoff lane's own, not the front door's
+        assert rx.counters["frames_handoff"] == 0
+    finally:
+        sender.close(1.0)
+        hr.stop()
+
+
+def test_chaos_injected_send_fault_reconnects_and_resends():
+    """A scripted fault at the `handoff.send` seam behaves exactly like
+    a broken pipe: counted send error + reconnect, the in-flight frame
+    resent — at-least-once, zero shed."""
+    rx, hr, q = _rx_pair()
+    sender = HandoffSender({0: ("127.0.0.1", hr.port)})
+    plan = chaos.FaultPlan().add(chaos.FaultRule(
+        site=chaos.SITE_HANDOFF_SEND, error=chaos.InjectedFault, at=(0, 2),
+    ))
+    chaos.install(plan)
+    try:
+        for raw in (_frame(3), _frame(5)):
+            sender.send(0, raw)
+        assert sender.flush(30.0)
+        _await(lambda: hr.get_counters()["rx_frames"] == 2, "2 rx frames")
+        c = sender.get_counters()
+        assert c["tx_frames"] == 2
+        assert c["send_errors"] == 2 and c["reconnects"] == 2
+        assert c["shed_frames"] == 0  # the faults cost retries, not loss
+        assert plan.injected[chaos.SITE_HANDOFF_SEND] == 2
+    finally:
+        chaos.uninstall()
+        sender.close(1.0)
+        hr.stop()
+
+
+def test_unknown_peer_raises_and_counts_shed():
+    sender = HandoffSender({})
+    try:
+        with pytest.raises(HandoffUnreachable, match="no handoff peer"):
+            sender.send(7, b"x")
+        assert sender.get_counters()["shed_frames"] == 1
+    finally:
+        sender.close(0.1)
+
+
+def test_unreachable_peer_sheds_counted_on_shutdown():
+    """A peer that never answers: frames queue, the writer backs off
+    (capped exponential + jitter, the UniformSender stance), and
+    shutdown sheds every undelivered frame COUNTED — loss is never
+    silent."""
+    sender = HandoffSender(
+        {0: ("127.0.0.1", _closed_port())}, connect_timeout_s=0.2
+    )
+    try:
+        for _ in range(3):
+            sender.send(0, _frame())
+        assert not sender.flush(0.3)  # cannot drain: the peer is down
+    finally:
+        sender.close(0.2)
+    _await(
+        lambda: sender.get_counters()["shed_frames"] == 3,
+        "3 counted shed", timeout_s=10.0,
+    )
+    assert sender.get_counters()["send_errors"] >= 1
+    assert sender.get_counters()["tx_frames"] == 0
+
+
+def test_bounded_queue_overwrite_sheds_oldest_counted():
+    sender = HandoffSender(
+        {0: ("127.0.0.1", _closed_port())},
+        queue_capacity=2, connect_timeout_s=0.2,
+    )
+    try:
+        for _ in range(6):
+            sender.send(0, _frame())
+        # capacity 2 (+ at most 1 in flight): the rest overwrote oldest
+        assert sender.get_counters()["shed_frames"] >= 3
+    finally:
+        sender.close(0.2)
+
+
+def test_send_racing_close_counts_shed_on_closed_queue():
+    """A send that passes the _running check while close() is mid-way
+    lands put() on a CLOSED queue — put returns False (frame not
+    accepted). That must count a shed and raise, exactly like the
+    pre-check path: loss is never silent."""
+    sender = HandoffSender(
+        {0: ("127.0.0.1", _closed_port())}, connect_timeout_s=0.2
+    )
+    try:
+        # model the race deterministically: close the peer queue while
+        # _running is still True (close() does this before the flag
+        # settles for a concurrent sender thread)
+        sender._peers[0].queue.close()
+        with pytest.raises(HandoffUnreachable, match="closed mid-send"):
+            sender.send(0, _frame())
+        assert sender.get_counters()["shed_frames"] == 1
+    finally:
+        sender.close(0.2)

@@ -99,6 +99,7 @@ def _assert_state_equal(a, b, msg=""):
     assert int(a.dropped_overflow) == int(b.dropped_overflow), msg
 
 
+@pytest.mark.slow
 def test_merge_fold_bitexact_vs_full_sort_fuzz():
     """Full-set merge-fold == full-sort fold on random stashes and
     accumulators, INCLUDING capacity-overflow trials (small stash caps
@@ -126,6 +127,7 @@ def test_merge_fold_bitexact_vs_full_sort_fuzz():
     assert saw_overflow >= 3, "fuzz never exercised the overflow stance"
 
 
+@pytest.mark.slow
 def test_merge_fold_span_bounded_matches_masked_oracle():
     """Span-bounded fold == full-sort fold over (stash + acc rows with
     slot < hi); out-of-span rows stay accumulated untouched."""
@@ -381,6 +383,7 @@ def _docbatch_key(dbs):
     ]
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("n_dev", [1, 2])
 def test_sharded_merge_mode_matches_full(n_dev):
     """ShardedWindowManager fold_mode="merge" vs "full" on identical

@@ -11,8 +11,17 @@ recovery tests share these same subprocess runs.
 from __future__ import annotations
 
 import numpy as np
+import pytest
 
 import mesh_harness as mh
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _prewarm():
+    """Overlap every memoized harness build (this module's runs, the
+    rebalance recipes, the oracles) across the container's cores —
+    the suite's wall clock would otherwise pay them serially."""
+    mh.prewarm_async()
 
 
 def _oracle_and_mesh2():

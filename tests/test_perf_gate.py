@@ -1157,3 +1157,43 @@ def test_mesh_per_host_fetch_budget_and_locality():
             rec["host_fetches"] for rec in res["groups"].values()
         )
         assert per_group == f["n"]
+
+
+def test_rebalance_budget():
+    """Elastic topology (ISSUE 15): the per-host budgets HOLD ACROSS A
+    REBALANCE. On both the old and the new owner of the moved group: at
+    most 3 host fetches per ingest, zero non-local transfers, and zero
+    fused-step retraces — the adopted group's manager compiles its
+    bucket set once during its first post-adopt steps and never again,
+    and releasing a group must not invalidate the remaining group's
+    caches. Steady state after the flip matches before: misroutes STOP
+    incrementing once agents re-route (no lingering handoff traffic)
+    and the wire drains to empty. Shares the memoized rebalance run
+    with tests/test_mesh_rebalance.py."""
+    import mesh_harness as mh
+
+    r = mh.mesh_rebalance_result()
+    for res in (r["p0"], r["p1"]):
+        f = res["fetch"]
+        assert f["n_ingests"] > 0
+        assert f["n"] <= 3 * f["n_ingests"], f
+        assert f["nonlocal"] == 0, f
+        # zero retraces across the handover: every group's pjit cache
+        # is the same size at the end of the run as it was once warm
+        # (for the moved group on the old owner: at release)
+        for g, (steady, end) in res["caches"].items():
+            assert steady is not None, (res["process_index"], g)
+            assert end == steady, (res["process_index"], g, steady, end)
+    # no lingering handoff traffic: the misroute count the old owner
+    # sampled at the last forwarded step IS the final count — once the
+    # agents re-routed, nothing misroutes again — and the sender's
+    # queue fully drained (flush() fenced every forwarded step)
+    p1 = r["p1"]
+    assert p1["misrouted_after_forwarding"] is not None
+    assert p1["receiver"]["frames_misrouted"] == p1["misrouted_after_forwarding"]
+    assert p1["sender"]["queue_depth"] == 0
+    assert p1["sender"]["shed_frames"] == 0
+    # the new owner serves the moved group at full budget post-flip:
+    # its own receiver never misroutes and nothing rotted in the hold
+    assert r["p0"]["receiver"]["frames_misrouted"] == 0
+    assert r["p0"]["receiver"]["frames_held_dropped"] == 0

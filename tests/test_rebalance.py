@@ -1,0 +1,561 @@
+"""Elastic-topology unit coverage (ISSUE 15): topology overrides and
+epochs, the rebalance protocol's edge cases (same-owner counted no-op,
+single-flight guard, abort rollback), the ownership-transfer manifest
+validation (stale pre-handover checkpoints refused with an error
+naming both epochs), the receiver's epoch-flip hold buffer, and the
+controller-side ShardGroupPlanner. Everything here is single-process:
+standalone topologies for different process indices coexist in one
+test process, so the cross-"host" restore matrix needs no subprocess.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from deepflow_tpu import chaos
+from deepflow_tpu.aggregator.checkpoint import (
+    restore_sharded_state,
+    save_sharded_state,
+)
+from deepflow_tpu.chaos import RebalanceAbortError
+from deepflow_tpu.controller.rebalance import ShardGroupPlanner
+from deepflow_tpu.ops.histogram import LogHistSpec
+from deepflow_tpu.parallel.rebalance import GroupRebalancer, plan_move
+from deepflow_tpu.parallel.sharded import (
+    ShardedConfig,
+    ShardedPipeline,
+    ShardedWindowManager,
+)
+from deepflow_tpu.parallel.topology import MeshTopology
+
+
+def _cfg():
+    return ShardedConfig(
+        capacity_per_device=1 << 9, num_services=8, hll_precision=6,
+        cms_depth=2, cms_width=128,
+        hist=LogHistSpec(bins=16, vmin=1.0, gamma=1.5), topk_cols=32,
+    )
+
+
+def _swm(topology, group):
+    return ShardedWindowManager(
+        ShardedPipeline(topology, _cfg(), shard_group=group), delay=2
+    )
+
+
+def _feed(swm, t=1_700_000_000, n=64, seed=3):
+    from deepflow_tpu.ingest.replay import SyntheticFlowGen
+
+    fb = SyntheticFlowGen(num_tuples=32, seed=seed).flow_batch(n, t)
+    return swm.ingest(fb.tags, fb.meters, fb.valid)
+
+
+# ---------------------------------------------------------------------------
+# topology overrides + epochs
+
+
+def test_topology_rebalanced_overrides_and_epoch():
+    t0 = MeshTopology.standalone(0, 2, n_groups=2, devices_per_group=1)
+    t1 = MeshTopology.standalone(1, 2, n_groups=2, devices_per_group=1)
+    assert t0.owned_groups() == (0,) and t1.owned_groups() == (1,)
+    n0, n1 = t0.rebalanced(1, 0), t1.rebalanced(1, 0)
+    # pure function: both hosts derive the identical placement + epoch
+    assert n0.owned_groups() == (0, 1) and n1.owned_groups() == ()
+    assert n0.topology_epoch == n1.topology_epoch == 1
+    assert n0.group_process(1) == n1.group_process(1) == 0
+    # moving a group back home drops the override but still bumps the
+    # epoch (it IS a topology change)
+    back = n0.rebalanced(1, 1)
+    assert back.group_overrides == () and back.topology_epoch == 2
+    assert back.owned_groups() == (0,)
+
+
+def test_topology_adopted_group_mesh_uses_spare_devices():
+    t0 = MeshTopology.standalone(0, 2, n_groups=2, devices_per_group=1)
+    n0 = t0.rebalanced(1, 0)
+    # the block group's devices never move under a flip; the adopted
+    # group sits on the spare slice after the block range
+    assert n0.group_mesh(0).devices.ravel().tolist() \
+        == t0.group_mesh(0).devices.ravel().tolist()
+    adopted = n0.group_mesh(1).devices.ravel().tolist()
+    assert adopted and adopted != n0.group_mesh(0).devices.ravel().tolist()
+    # a destination without spare local devices refuses loudly
+    starved = MeshTopology.standalone(
+        0, 2, n_groups=2, devices_per_group=1,
+        devices=t0.local_devices[:1],
+    )
+    with pytest.raises(ValueError, match="local"):
+        starved.rebalanced(1, 0)
+
+
+def test_topology_later_adoption_never_rehomes_an_earlier_one():
+    """Adopted slices follow ADOPTION order, not group number: a later
+    adoption (even of a lower-numbered group) must not move a live
+    adopted group's devices."""
+    t0 = MeshTopology.standalone(0, 4, n_groups=4, devices_per_group=1)
+    one = t0.rebalanced(3, 0)
+    devs3 = one.group_mesh(3).devices.ravel().tolist()
+    two = one.rebalanced(1, 0)
+    assert two.group_mesh(3).devices.ravel().tolist() == devs3
+    assert two.group_mesh(1).devices.ravel().tolist() != devs3
+    assert two.owned_groups() == (0, 3, 1)
+
+
+def test_topology_readoption_appends_as_newest_adoption():
+    """A group that leaves and comes BACK must take the newest adopted
+    slice: updating its override in place would resurrect its original
+    position and silently re-home every adopted group that arrived
+    after it left (two live managers sharing one device slice)."""
+    t0 = MeshTopology.standalone(0, 4, n_groups=4, devices_per_group=1)
+    two = t0.rebalanced(2, 0).rebalanced(3, 0)  # adoption order (2, 3)
+    gone = two.rebalanced(2, 1)  # g2 leaves; g3 compacts to slice 0
+    devs3 = gone.group_mesh(3).devices.ravel().tolist()
+    back = gone.rebalanced(2, 0)  # g2 returns
+    assert back.owned_groups() == (0, 3, 2)  # appended, not resurrected
+    assert back.group_mesh(3).devices.ravel().tolist() == devs3
+    assert back.group_mesh(2).devices.ravel().tolist() != devs3
+
+
+def test_topology_describe_carries_epoch_and_owner():
+    t1 = MeshTopology.standalone(1, 2, n_groups=2, devices_per_group=1)
+    d = t1.describe()
+    assert d["process_index"] == 1 and d["topology_epoch"] == 0
+    assert t1.rebalanced(1, 0).describe()["topology_epoch"] == 1
+
+
+# ---------------------------------------------------------------------------
+# protocol edge cases
+
+
+def test_rebalance_to_same_owner_is_counted_noop():
+    t1 = MeshTopology.standalone(1, 2, n_groups=2, devices_per_group=1)
+    reb = GroupRebalancer(t1, name="noop-test")
+    assert plan_move(t1, 1, 1) is None
+    assert reb.plan(1, 1) is None
+    c = reb.get_counters()
+    assert c["rebalance_noops"] == 1
+    assert c["rebalances_planned"] == 0
+    assert c["topology_epoch"] == 0  # nothing published
+
+
+def test_concurrent_rebalance_same_group_fails_loudly():
+    t1 = MeshTopology.standalone(1, 2, n_groups=2, devices_per_group=1)
+    reb = GroupRebalancer(t1, name="flight-test")
+    plan = reb.plan(1, 0)
+    assert plan is not None
+    with pytest.raises(RebalanceAbortError, match="single-flight"):
+        reb.plan(1, 0)
+    # aborting the first clears the guard
+    reb.abort(plan)
+    assert reb.plan(1, 0) is not None
+    assert reb.get_counters()["rebalance_aborts"] == 1
+
+
+def test_claim_fault_counts_abort_and_releases_guard():
+    """A scripted fault at the claim step must not strand the group in
+    the single-flight guard — the counted abort frees it so the
+    controller can simply retry the plan."""
+    from deepflow_tpu import chaos
+
+    t0 = MeshTopology.standalone(0, 2, n_groups=2, devices_per_group=1)
+    reb = GroupRebalancer(t0, name="claim-test")
+    plan = reb.plan(1, 0)
+    chaos.install(chaos.FaultPlan().add(chaos.FaultRule(
+        site=chaos.SITE_REBALANCE_STEP, error=chaos.InjectedFault, at=(0,),
+    )))
+    try:
+        with pytest.raises(RebalanceAbortError, match="claim of group 1"):
+            reb.claim(plan)
+    finally:
+        chaos.uninstall()
+    c = reb.get_counters()
+    assert c["rebalance_aborts"] == 1 and c["inflight"] == 0
+    # nothing moved; the retry plans and claims cleanly
+    assert reb.topology.topology_epoch == 0
+    plan2 = reb.plan(1, 0)
+    assert reb.claim(plan2).topology_epoch == 1
+
+
+def test_claim_failure_after_flip_rolls_back_so_retry_replans():
+    """A claim that fails AFTER adopting the epoch (the receiver
+    attach raising) must roll the topology back: otherwise the
+    controller's documented retry plans against the half-flipped
+    table, sees the move as already done (counted no-op), and the
+    group strands with no handler anywhere."""
+    t0 = MeshTopology.standalone(0, 2, n_groups=2, devices_per_group=1)
+    reb = GroupRebalancer(t0, name="claim-rollback-test")
+
+    class _BoomReceiver:
+        routing = None
+        calls = 0
+
+        def attach_topology(self, topology, handoff=None):
+            self.calls += 1
+            if self.calls == 1:
+                raise RuntimeError("boom")
+
+    rx = _BoomReceiver()
+    plan = reb.plan(1, 0)
+    with pytest.raises(RebalanceAbortError, match="claim of group 1"):
+        reb.claim(plan, receiver=rx)
+    assert reb.topology.topology_epoch == 0  # rolled back
+    assert rx.calls == 2  # the rollback re-attached the previous epoch
+    plan2 = reb.plan(1, 0)  # the retry RE-PLANS — not a counted no-op
+    assert plan2 is not None
+    assert reb.claim(plan2, receiver=rx).topology_epoch == 1
+
+
+def test_release_abort_restores_preexisting_handoff(tmp_path):
+    """An aborted release must roll the receiver back to its PRE-FLIP
+    handoff — rolling back to handoff=None would silently disable
+    misroute forwarding for every group on the host after one aborted
+    move of one group."""
+    from deepflow_tpu.ingest.queues import PyOverwriteQueue
+    from deepflow_tpu.ingest.receiver import Receiver
+
+    t1 = MeshTopology.standalone(1, 2, n_groups=2, devices_per_group=1)
+    rx = Receiver()
+
+    def boot_handoff(group, raw):  # the fleet's bring-up forward
+        return None
+
+    rx.attach_topology(t1, boot_handoff)
+    reb = GroupRebalancer(t1, name="handoff-rollback-test")
+    swm = _swm(t1, 1)
+    feeder = swm.make_feeder(
+        [PyOverwriteQueue(64)], (64,), journal_dir=tmp_path
+    )
+    plan = reb.plan(1, 0)
+    fault = chaos.FaultPlan().add(chaos.FaultRule(
+        site=chaos.SITE_REBALANCE_STEP, error=chaos.TransientDeviceError,
+        at=(1,),  # after the flip, before the quiesce
+    ))
+    with chaos.active(fault):
+        with pytest.raises(RebalanceAbortError):
+            reb.release(
+                plan, feeder=feeder, save=lambda extra: None,
+                receiver=rx, handoff=lambda group, raw: None,
+            )
+    topo, handoff, _ = rx.routing
+    assert topo is t1
+    assert handoff is boot_handoff  # restored, not None
+    swm.close()
+
+
+def test_release_abort_rolls_route_table_back(tmp_path):
+    """An injected fault at the rebalance.step seam mid-release aborts
+    LOUDLY and re-publishes the previous epoch — the group stays served
+    by its old owner, the drain's outputs still reach the caller."""
+    from deepflow_tpu.ingest.queues import PyOverwriteQueue
+
+    t1 = MeshTopology.standalone(1, 2, n_groups=2, devices_per_group=1)
+    reb = GroupRebalancer(t1, name="abort-test")
+    swm = _swm(t1, 1)
+    feeder = swm.make_feeder(
+        [PyOverwriteQueue(64)], (64,), journal_dir=tmp_path
+    )
+    plan = reb.plan(1, 0)
+
+    def save(extra):
+        return save_sharded_state(swm, tmp_path / "h.ckpt", extra_meta=extra)
+
+    fault = chaos.FaultPlan().add(chaos.FaultRule(
+        site=chaos.SITE_REBALANCE_STEP, error=chaos.TransientDeviceError,
+        at=(1,),  # after the flip, before the quiesce
+    ))
+    with chaos.active(fault):
+        with pytest.raises(RebalanceAbortError):
+            reb.release(plan, feeder=feeder, save=save)
+    assert reb.topology is t1  # rolled back
+    c = reb.get_counters()
+    assert c["rebalance_aborts"] == 1 and c["inflight"] == 0
+    # the aborted move leaves the group fully operable here
+    plan2 = reb.plan(1, 0)
+    assert plan2 is not None and plan2.epoch == 1
+
+
+def test_quiesce_drains_large_fenced_backlog(tmp_path):
+    """A FENCED backlog larger than any fixed pump allowance drains to
+    completion: each pump moves a bounded frame budget, so quiesce must
+    key its abort on backlog PROGRESS, not an iteration count — a big
+    but fenced queue is a legitimate handover, not unfenced
+    admission."""
+    from deepflow_tpu.feeder import FeederConfig, encode_flowbatch_frames
+    from deepflow_tpu.ingest.queues import PyOverwriteQueue
+    from deepflow_tpu.ingest.replay import SyntheticFlowGen
+
+    t1 = MeshTopology.standalone(1, 2, n_groups=2, devices_per_group=1)
+    swm = _swm(t1, 1)
+    q = PyOverwriteQueue(512)
+    feeder = swm.make_feeder(
+        [q], (64,),
+        FeederConfig(frames_per_queue=1, rounds_per_pump=1),
+        journal_dir=tmp_path,
+    )
+    gen = SyntheticFlowGen(num_tuples=16, seed=11)
+    n_frames = 0
+    for i in range(17):
+        for fr in encode_flowbatch_frames(
+            gen.flow_batch(16, 1_700_000_000 + i), agent_id=7,
+            max_rows_per_frame=4,
+        ):
+            assert q.put(fr)
+            n_frames += 1
+    assert n_frames > 64  # a fixed 64-pump cap would spuriously abort
+    feeder.quiesce(lambda meta: None)
+    assert len(q) == 0
+    assert feeder.get_counters()["records_in"] == 17 * 16
+    swm.close()
+
+
+def test_quiesce_unfenced_admission_aborts_loudly(tmp_path):
+    """A queue whose backlog never shrinks across a pump (a producer
+    still feeding — admission NOT fenced) aborts loudly instead of
+    pumping forever or publishing incomplete state."""
+    from deepflow_tpu.feeder import FeederConfig, encode_flowbatch_frames
+    from deepflow_tpu.ingest.queues import PyOverwriteQueue  # noqa: F401
+    from deepflow_tpu.ingest.replay import SyntheticFlowGen
+
+    (frame,) = encode_flowbatch_frames(
+        SyntheticFlowGen(num_tuples=8, seed=12).flow_batch(
+            4, 1_700_000_000
+        ),
+        agent_id=7,
+    )
+
+    class _RefillingQueue:
+        """Models unfenced admission: every drained frame is
+        immediately replaced by the producer."""
+
+        capacity = 0
+        closed = False
+
+        def __len__(self):
+            return 4
+
+        def gets(self, n, timeout_ms=0):
+            return [frame] * max(1, min(n, 4))
+
+    t1 = MeshTopology.standalone(1, 2, n_groups=2, devices_per_group=1)
+    swm = _swm(t1, 1)
+    feeder = swm.make_feeder(
+        [_RefillingQueue()], (64,), FeederConfig(), journal_dir=tmp_path
+    )
+    with pytest.raises(RebalanceAbortError, match="not fenced"):
+        feeder.quiesce(lambda meta: None)
+    swm.close()
+
+
+# ---------------------------------------------------------------------------
+# ownership-transfer manifest validation at restore
+
+
+def _handover_ckpt(tmp_path, *, manifest=True, epoch_delta=0):
+    """Save group 1 under its old owner (standalone p1), optionally
+    with a transfer manifest; return (path, new-owner topology)."""
+    t1 = MeshTopology.standalone(1, 2, n_groups=2, devices_per_group=1)
+    old = _swm(t1, 1)
+    _feed(old)
+    path = tmp_path / "hand.ckpt"
+    extra = None
+    if manifest:
+        plan = plan_move(t1, 1, 0)
+        extra = dict(plan.manifest_meta())
+        if epoch_delta:
+            extra["handover"] = dict(extra["handover"])
+            extra["handover"]["topology_epoch"] += epoch_delta
+    save_sharded_state(old, path, extra_meta=extra)
+    old.close()
+    t0_new = MeshTopology.standalone(
+        0, 2, n_groups=2, devices_per_group=1
+    ).rebalanced(1, 0)
+    return path, t0_new
+
+
+def test_stale_checkpoint_without_manifest_refused_naming_both_epochs(
+        tmp_path):
+    path, t0_new = _handover_ckpt(tmp_path, manifest=False)
+    fresh = _swm(t0_new, 1)
+    with pytest.raises(ValueError) as ei:
+        restore_sharded_state(fresh, path)
+    msg = str(ei.value)
+    # both epochs named: the checkpoint's (0) and the restorer's (1)
+    assert "epoch 0" in msg and "epoch 1" in msg
+    assert "pre-handover" in msg
+    fresh.close()
+
+
+def test_manifest_with_wrong_epoch_refused_naming_both_epochs(tmp_path):
+    path, t0_new = _handover_ckpt(tmp_path, epoch_delta=5)
+    fresh = _swm(t0_new, 1)
+    with pytest.raises(ValueError) as ei:
+        restore_sharded_state(fresh, path)
+    msg = str(ei.value)
+    assert "epoch 6" in msg and "epoch 1" in msg
+    fresh.close()
+
+
+def test_old_owner_restoring_its_own_handover_checkpoint_refused(tmp_path):
+    """The host that RELEASED a group must not restore the handover
+    barrier it wrote — that would resurrect the group while its new
+    owner serves it (split-brain over one key-hash range)."""
+    path, _ = _handover_ckpt(tmp_path)
+    t1 = MeshTopology.standalone(1, 2, n_groups=2, devices_per_group=1)
+    back = _swm(t1, 1)
+    with pytest.raises(ValueError, match="transferred group 1 to process 0"):
+        restore_sharded_state(back, path)
+    back.close()
+
+
+def test_manifest_handover_restores_and_preserves_totals(tmp_path):
+    path, t0_new = _handover_ckpt(tmp_path)
+    fresh = _swm(t0_new, 1)
+    restore_sharded_state(fresh, path)
+    assert fresh.total_docs_in == 64  # counters continue across owners
+    _feed(fresh, t=1_700_000_001, seed=4)
+    assert fresh.total_docs_in == 128
+    fresh.close()
+
+
+def test_manifest_to_other_process_refused(tmp_path):
+    t1 = MeshTopology.standalone(1, 3, n_groups=3, devices_per_group=1)
+    old = _swm(t1, 1)
+    _feed(old)
+    path = tmp_path / "h.ckpt"
+    save_sharded_state(
+        old, path, extra_meta=plan_move(t1, 1, 2).manifest_meta()
+    )
+    old.close()
+    hijacker = MeshTopology.standalone(
+        0, 3, n_groups=3, devices_per_group=1
+    ).rebalanced(1, 0)
+    fresh = _swm(hijacker, 1)
+    with pytest.raises(ValueError, match="to process 2"):
+        restore_sharded_state(fresh, path)
+    fresh.close()
+
+
+# ---------------------------------------------------------------------------
+# receiver epoch-flip hold buffer
+
+
+def _frame(agent_id: int, org_id: int = 1) -> bytes:
+    from deepflow_tpu.feeder import encode_flowbatch_frames
+    from deepflow_tpu.ingest.replay import SyntheticFlowGen
+
+    fb = SyntheticFlowGen(num_tuples=8, seed=9).flow_batch(4, 1_700_000_000)
+    (raw,) = encode_flowbatch_frames(fb, agent_id=agent_id, org_id=org_id)
+    return raw
+
+
+def test_receiver_holds_and_redelivers_across_epoch_flip():
+    from deepflow_tpu.ingest.framing import HEADER_LEN, FlowHeader, MessageType
+    from deepflow_tpu.ingest.queues import PyOverwriteQueue
+    from deepflow_tpu.ingest.receiver import Receiver
+    from deepflow_tpu.parallel.topology import key_shard_group
+
+    rx = Receiver(held_frames_cap=2)
+    # an agent whose key-hash group is 1 of 2
+    agent = next(
+        a for a in range(64) if key_shard_group(1, a, 2) == 1
+    )
+    raw = _frame(agent)
+    header = FlowHeader.parse(raw[:HEADER_LEN])
+    topo = MeshTopology.standalone(0, 2, n_groups=2, devices_per_group=1)
+    q0 = [PyOverwriteQueue(16)]
+    rx.attach_topology(topo, handoff=None)
+    rx.register_handler(MessageType.TAGGEDFLOW, q0, shard_group=0)
+    # pre-flip: group 1 is remote — counted misroute (no handoff → drop)
+    rx._dispatch(header, raw, ("t", 0))
+    assert rx.counters["frames_misrouted"] == 1
+    # flip: this process now owns group 1, but its handler is still
+    # mid-restore — frames HOLD instead of misrouting
+    rx.attach_topology(topo.rebalanced(1, 0), handoff=None)
+    for _ in range(3):  # cap is 2: the third sheds the oldest, counted
+        rx._dispatch(header, raw, ("t", 0))
+    assert rx.counters["frames_held"] == 3
+    assert rx.counters["frames_held_dropped"] == 1
+    assert rx.counters["frames_misrouted"] == 1  # unchanged
+    # registration redelivers the held frames, in order, into the queue
+    q1 = [PyOverwriteQueue(16)]
+    rx.register_handler(MessageType.TAGGEDFLOW, q1, shard_group=1)
+    assert rx.counters["frames_redelivered"] == 2
+    assert len(q1[0]) == 2 and len(q0[0]) == 0
+
+
+def test_receiver_flip_away_forwards_previously_held_frames():
+    """A held frame whose group flips AWAY on the next epoch leaves
+    through the handoff, not the hold."""
+    from deepflow_tpu.ingest.framing import HEADER_LEN, FlowHeader, MessageType
+    from deepflow_tpu.ingest.queues import PyOverwriteQueue
+    from deepflow_tpu.ingest.receiver import Receiver
+    from deepflow_tpu.parallel.topology import key_shard_group
+
+    rx = Receiver()
+    agent = next(a for a in range(64) if key_shard_group(1, a, 2) == 1)
+    raw = _frame(agent)
+    header = FlowHeader.parse(raw[:HEADER_LEN])
+    base = MeshTopology.standalone(0, 2, n_groups=2, devices_per_group=1)
+    rx.register_handler(
+        MessageType.TAGGEDFLOW, [PyOverwriteQueue(16)], shard_group=0
+    )
+    forwarded = []
+    rx.attach_topology(base.rebalanced(1, 0), handoff=forwarded.append)
+    rx._dispatch(header, raw, ("t", 0))
+    assert rx.counters["frames_held"] == 1
+    # the move reverses: group 1 goes home — the held frame must follow
+    rx.attach_topology(
+        base.rebalanced(1, 0).rebalanced(1, 1),
+        handoff=lambda g, f: forwarded.append((g, len(f))),
+    )
+    assert rx.counters["frames_redelivered"] == 1
+    assert rx.counters["frames_misrouted"] == 1
+    assert forwarded == [(1, len(raw))]
+
+
+# ---------------------------------------------------------------------------
+# controller planning
+
+
+def test_shard_group_planner_moves_dead_hosts_groups():
+    pl = ShardGroupPlanner(dead_after_s=10)
+    pl.heartbeat(0, [0], now=0.0)
+    pl.heartbeat(1, [1, 2], now=0.0)
+    pl.heartbeat(2, [3], now=0.0)
+    assert pl.plan_moves(now=1.0) == []
+    # host 1 dies: its two groups spread least-loaded-first
+    pl.heartbeat(0, [0], now=20.0)
+    pl.heartbeat(2, [3], now=20.0)
+    moves = pl.plan_moves(now=21.0)
+    assert moves == [(1, 0), (2, 2)]
+    assert pl.counters["moves_planned"] == 2
+    # level-triggered, not edge-triggered: until an owner claims them,
+    # the same stranded groups keep being planned (a failed execution
+    # loses only intent)...
+    assert pl.plan_moves(now=21.0) == [(1, 0), (2, 2)]
+    # ...and once live owners heartbeat them, the rescue is DONE — no
+    # re-planning, no bouncing the group between hosts forever
+    pl.heartbeat(0, [0, 1], now=22.0)
+    pl.heartbeat(2, [3, 2], now=22.0)
+    assert pl.plan_moves(now=23.0) == []
+    # maintenance drain of a LIVE host empties it onto the others
+    drains = pl.plan_drain(2, now=23.0)
+    assert drains == [(2, 0), (3, 0)]
+
+
+def test_shard_group_planner_dedupes_group_listed_by_two_dead_hosts():
+    """Owner died, rescuer adopted, then the rescuer died before any
+    planning tick pruned the first record: the group sits in TWO dead
+    records and must still be planned exactly once — two adopters for
+    one key range is the split-brain the manifest validation guards."""
+    pl = ShardGroupPlanner(dead_after_s=10)
+    pl.heartbeat(0, [0], now=0.0)
+    pl.heartbeat(1, [5], now=0.0)     # original owner…
+    pl.heartbeat(2, [5], now=5.0)     # …rescuer adopted 5, then
+    pl.heartbeat(0, [0], now=30.0)    # both went silent
+    moves = pl.plan_moves(now=31.0)
+    assert moves == [(5, 0)]
+    assert pl.counters["moves_planned"] == 1
