@@ -16,10 +16,16 @@ one measured fetch subtracted):
   merge_span  _merge_fold_impl hi — span-bounded advance fold (~1/4 of
                                     the acc's windows close)
 
+A second section (ISSUE 17) A/Bs the SKETCH-plane fold in isolation:
+`sketch_plane_step` with the per-hash-row multi-sort oracle vs the
+one-pass shared sort, at FOLDBENCH_PLANE_ROWS row counts — emitted as
+a separate `plane_rows` list so fold-row parsers are untouched.
+
 Knobs: FOLDBENCH_SHAPES="S:A,S:A,..." (default
 65536:8192,65536:65536,262144:8192,262144:65536,589824:8192,589824:65536,
 2097152:8192,2097152:65536 — the ISSUE 5 grid), FOLDBENCH_ITERS (4),
-DEEPFLOW_MERGE_SCATTER=1 for the scatter merged-order A/B (on-chip).
+FOLDBENCH_PLANE_ROWS (65536,262144), DEEPFLOW_MERGE_SCATTER=1 for the
+scatter merged-order A/B (on-chip).
 
 Prints ONE JSON line {"rows": [...]}; on failure a partial-but-
 parseable record (bench.py convention). Full production schema
@@ -143,6 +149,71 @@ def run_shape(s_rows: int, a_rows: int, iters: int) -> dict:
     }
 
 
+def run_plane_shape(n_rows: int, iters: int) -> dict:
+    """Shared-sort A/B of the sketch-plane fold itself (ISSUE 17): the
+    SAME batch through `sketch_plane_step` with the multi-sort oracle
+    (shared_sort=False, one keyed sort per top-K hash row × phase) vs
+    the one-pass rewrite (shared_sort=True, one sort total). This is
+    the plane in isolation — bench/sortbench.py times it embedded in
+    the full windowed ingest."""
+    from deepflow_tpu.aggregator.sketchplane import (
+        SketchConfig,
+        sketch_init,
+        sketch_plane_step,
+    )
+    from deepflow_tpu.ops.histogram import LogHistSpec
+
+    cfg = SketchConfig(
+        num_groups=8, hll_precision=14, cms_depth=4, cms_width=1 << 16,
+        hist=LogHistSpec(bins=128, vmin=1.0, gamma=1.1),
+        topk_rows=2, topk_cols=1024, pending=8,
+    )
+    rng = np.random.default_rng(9)
+    base_w, close_w = jnp.uint32(10), jnp.uint32(11)
+    keys = rng.integers(0, 1 << 20, n_rows).astype(np.uint64)
+    lanes = dict(
+        window=jnp.asarray(rng.integers(10, 12, n_rows).astype(np.uint32)),
+        valid=jnp.asarray(np.ones(n_rows, bool)),
+        group=jnp.asarray((keys % 8).astype(np.uint32)),
+        client_hi=jnp.asarray((keys * np.uint64(2654435761)
+                               >> np.uint64(13)).astype(np.uint32)),
+        client_lo=jnp.asarray((keys * np.uint64(40503)).astype(np.uint32)),
+        key_hi=jnp.asarray((keys >> np.uint64(1)).astype(np.uint32)),
+        key_lo=jnp.asarray(keys.astype(np.uint32)),
+        weight=jnp.asarray(
+            rng.integers(1, 500, n_rows).astype(np.float32)),
+        rtt=jnp.asarray(np.full(n_rows, 10.0, np.float32)),
+        rtt_valid=jnp.asarray(np.ones(n_rows, bool)),
+        id_a=jnp.asarray((keys ^ np.uint64(0x9E3779B9)).astype(np.uint32)),
+        id_b=jnp.asarray((keys + np.uint64(7)).astype(np.uint32)),
+    )
+
+    def mk(shared: bool):
+        def f(sk, **kw):
+            return sketch_plane_step(
+                sk, cfg.hist, base_w=base_w, close_w=close_w,
+                shared_sort=shared, fused_sketch=False, **kw,
+            )
+        return jax.jit(f)
+
+    row = {"plane_rows": n_rows, "iters": iters}
+    for name, shared in (("plane_multisort", False), ("plane_onepass", True)):
+        fn = mk(shared)
+        sk = fn(sketch_init(cfg, 4), **lanes)
+        _ = np.asarray(sk.rows)  # compile + settle
+        t0 = time.perf_counter()
+        for _i in range(iters):
+            sk = fn(sk, **lanes)
+        _ = np.asarray(sk.rows)
+        ms = (time.perf_counter() - t0) / iters * 1e3
+        row[f"{name}_ms"] = round(ms, 3)
+        print(f"  {name:16s} steady {ms:9.2f} ms", file=sys.stderr,
+              flush=True)
+    row["speedup_multisort_vs_onepass"] = round(
+        row["plane_multisort_ms"] / max(row["plane_onepass_ms"], 1e-9), 3)
+    return row
+
+
 def main():
     default = (
         "65536:8192,65536:65536,262144:8192,262144:65536,"
@@ -154,14 +225,28 @@ def main():
         if part
     ]
     iters = int(os.environ.get("FOLDBENCH_ITERS", 4))
+    plane_shapes = [
+        int(v)
+        for v in os.environ.get("FOLDBENCH_PLANE_ROWS", "65536,262144").split(",")
+        if v
+    ]
     rows = []
+    plane_rows = []
     try:
         for s_rows, a_rows in shapes:
             rows.append(run_shape(s_rows, a_rows, iters))
-        print(json.dumps({"rows": rows, "device": str(jax.devices()[0])}), flush=True)
+        for n_rows in plane_shapes:
+            plane_rows.append(run_plane_shape(n_rows, iters))
+            print(json.dumps(plane_rows[-1]), file=sys.stderr, flush=True)
+        print(
+            json.dumps({"rows": rows, "plane_rows": plane_rows,
+                        "device": str(jax.devices()[0])}),
+            flush=True,
+        )
     except Exception as e:  # parseable partial record, never a traceback
         print(
-            json.dumps({"rows": rows, "partial": True, "error": repr(e)}),
+            json.dumps({"rows": rows, "plane_rows": plane_rows,
+                        "partial": True, "error": repr(e)}),
             flush=True,
         )
 

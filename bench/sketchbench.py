@@ -1,7 +1,11 @@
 """Sketch-tier A/B bench (ISSUE 8): exact-only vs +sketch-plane vs
 +top-K through the windowed raw-doc ingest path, under a
 high-cardinality generator (Zipf heavy flows + a uniform scan sweep —
-the DDoS/scan shape that overflows the exact stash).
+the DDoS/scan shape that overflows the exact stash). A fourth
+"topk_multisort" row (ISSUE 17) reruns the +top-K plane with
+DEEPFLOW_SHARED_SORT=0, so every shape carries a shared-sort A/B
+(`shared_sort_speedup` on the "topk" row; bench/sortbench.py is the
+dedicated driver).
 
 Measures, per (batch, stash) shape:
   * rec/s for the three variants (the sketch tax on steady ingest);
@@ -94,13 +98,20 @@ def _doc_batch(keys: np.ndarray, t: int):
 
 def _run_variant(variant, batch, stash, batches, n_keys, zipf_s, k_top,
                  precision):
+    # "topk_multisort" is the ISSUE 17 A/B control: the same +top-K
+    # plane with DEEPFLOW_SHARED_SORT=0 (the knob is read at dispatch
+    # time, so flipping it between variants is honest within one
+    # process). Everything else about the row is the "topk" protocol.
+    plane = "topk" if variant.startswith("topk") else variant
+    os.environ["DEEPFLOW_SHARED_SORT"] = (
+        "0" if variant == "topk_multisort" else "1")
     sk = None
-    if variant != "exact":
+    if plane != "exact":
         sk = SketchConfig(
             num_groups=8, hll_precision=precision, cms_depth=4,
             cms_width=1 << 16,
             hist=LogHistSpec(bins=128, vmin=1.0, gamma=1.1),
-            topk_rows=2 if variant == "topk" else 0,
+            topk_rows=2 if plane == "topk" else 0,
             topk_cols=max(64, 1 << (max(k_top, 1) - 1).bit_length() + 3),
             pending=8,
         )
@@ -143,7 +154,7 @@ def _run_variant(variant, batch, stash, batches, n_keys, zipf_s, k_top,
         est = blk.distinct()
         rec["hll_estimate"] = est
         rec["cardinality_error"] = abs(est - true_distinct) / true_distinct
-        if variant == "topk":
+        if plane == "topk":
             uniq, counts = np.unique(all_keys, return_counts=True)
             order = np.argsort(-counts, kind="stable")
             true_top = uniq[order[:k_top]]
@@ -176,12 +187,19 @@ def main():
     err = None
     try:
         for batch, stash in _shapes():
-            for variant in ("exact", "sketch", "topk"):
+            recs = {}
+            for variant in ("exact", "sketch", "topk", "topk_multisort"):
                 r = _run_variant(variant, batch, stash, batches, n_keys,
                                  zipf_s, k_top, precision)
                 r.update(batch=batch, stash=stash)
+                recs[variant] = r
                 rows.append(r)
                 print(json.dumps(r), file=sys.stderr)
+            # shared-sort A/B (ISSUE 17): one-pass topk vs the same
+            # plane under the multi-sort oracle, same stream
+            recs["topk"]["shared_sort_speedup"] = round(
+                recs["topk"]["rec_s"]
+                / max(recs["topk_multisort"]["rec_s"], 1e-9), 3)
     except Exception as e:  # partial-JSON convention (bench.py stance)
         err = repr(e)
     out = {

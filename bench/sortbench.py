@@ -1,0 +1,199 @@
+#!/usr/bin/env python
+"""Shared-sort A/B bench (ISSUE 17): the multi-sort oracle vs the
+one-pass shared sort through the windowed raw-doc ingest path, at the
+PERF.md §17 +top-K shape where the extra sorts dominate.
+
+Per shape, the SAME seeded high-cardinality stream (the sketchbench
+Zipf + scan generator) runs through a top-K-enabled WindowManager
+twice — DEEPFLOW_SHARED_SORT=0 then =1 (the knob is read at dispatch
+time, so one process can A/B honestly) — and the row records both
+rates, the speedup, and a bit-parity digest of the first flushed
+window's sketch block (the A/B is only meaningful if the outputs are
+identical). Census-attributed sorts/dispatch for each mode ride along
+from a small L4Pipeline probe (`telemetry()["profile"]["census"]` —
+the r16 face), so the JSON embeds the sort counts the rewrite claims.
+
+DEEPFLOW_FUSED_SKETCH stays OFF by default here: on CPU the kernel
+runs in interpret mode — a parity artifact, not a perf path — and its
+on-chip columns are reserved in PERF.md §25. SORTBENCH_FUSED=1 adds
+the fused rows anyway (expect interpret-mode rates far below both XLA
+modes on CPU).
+
+Knobs: SORTBENCH_SHAPES="batch:stash,...", SORTBENCH_BATCHES,
+SORTBENCH_KEYS, SORTBENCH_TOPK, SORTBENCH_FUSED. Emits one JSON record
+on the last stdout line (bench_all.py c17 re-emits it); per-row records
+stream to stderr."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from sketchbench import _KeyGen, _doc_batch  # noqa: E402
+from deepflow_tpu.aggregator.sketchplane import SketchConfig  # noqa: E402
+from deepflow_tpu.aggregator.window import WindowConfig, WindowManager  # noqa: E402
+from deepflow_tpu.ops.histogram import LogHistSpec  # noqa: E402
+
+T0 = 1_700_000_000
+
+MODES = {"multisort": "0", "onepass": "1"}
+
+
+def _shapes() -> list[tuple[int, int]]:
+    env = os.environ.get("SORTBENCH_SHAPES")
+    if env:
+        return [tuple(int(x) for x in s.split(":")) for s in env.split(",")]
+    # the §17 +topk shapes where the per-hash-row sorts dominate
+    return [(1 << 16, 1 << 13), (1 << 18, 1 << 13)]
+
+
+def _sketch_config(k_top: int) -> SketchConfig:
+    return SketchConfig(
+        num_groups=8, hll_precision=14, cms_depth=4, cms_width=1 << 16,
+        hist=LogHistSpec(bins=128, vmin=1.0, gamma=1.1),
+        topk_rows=2,
+        topk_cols=max(64, 1 << (max(k_top, 1) - 1).bit_length() + 3),
+        pending=8,
+    )
+
+
+def _block_digest(flushed) -> str:
+    """Stable digest of the first flushed window's exact rows + sketch
+    block — the A/B's bit-parity cross-check."""
+    import hashlib
+
+    f0 = next((f for f in flushed if f.window_idx == T0), None)
+    if f0 is None:
+        return "no-window"
+    h = hashlib.sha256()
+    h.update(np.asarray(f0.key_hi).tobytes())
+    if f0.sketches is not None:
+        for lane in ("hll", "cms", "hist", "tk_votes", "tk_hi", "tk_lo"):
+            h.update(np.asarray(getattr(f0.sketches, lane)).tobytes())
+    return h.hexdigest()[:16]
+
+
+def _run_mode(mode: str, batch: int, stash: int, batches: int,
+              n_keys: int, k_top: int) -> dict:
+    os.environ["DEEPFLOW_SHARED_SORT"] = MODES[mode]
+    wm = WindowManager(WindowConfig(
+        capacity=stash, delay=2, sketch=_sketch_config(k_top),
+    ))
+    gen = _KeyGen(np.random.default_rng(7), n_keys, 1.1)
+    # warmup compiles the fused step outside the timed loop
+    wk = _KeyGen(np.random.default_rng(1), n_keys, 1.1).batch(
+        min(batch, 1 << 14))
+    wm.ingest(*_doc_batch(wk, T0 - 100))
+    wm.flush_all()
+
+    flushed = []
+    t_ingest = 0.0
+    for _ in range(batches):
+        b = _doc_batch(gen.batch(batch), T0)
+        t0 = time.perf_counter()
+        flushed += wm.ingest(*b)
+        jax.block_until_ready(wm.acc.slot)
+        t_ingest += time.perf_counter() - t0
+    flushed += wm.flush_all()
+    return {
+        "mode": mode,
+        "rec_s": batch * batches / t_ingest if t_ingest else 0.0,
+        "digest": _block_digest(flushed),
+        "sketch_rows": wm.get_counters()["sketch_rows"],
+    }
+
+
+def _census_sorts(k_top: int) -> dict:
+    """Sorts/dispatch per mode from the census face on a small
+    L4Pipeline probe — static jaxpr attribution, seconds of work."""
+    from deepflow_tpu.aggregator.pipeline import L4Pipeline, PipelineConfig
+    from deepflow_tpu.datamodel.batch import FlowBatch
+    from deepflow_tpu.ingest.replay import SyntheticFlowGen
+
+    out = {}
+    for mode, env in MODES.items():
+        os.environ["DEEPFLOW_SHARED_SORT"] = env
+        pipe = L4Pipeline(PipelineConfig(
+            window=WindowConfig(
+                capacity=1 << 12,
+                sketch=SketchConfig(
+                    num_groups=4, hll_precision=7, cms_depth=2,
+                    cms_width=256,
+                    hist=LogHistSpec(bins=32, vmin=1.0, gamma=1.3),
+                    topk_rows=2, topk_cols=64, pending=8,
+                ),
+            ),
+            batch_size=256,
+        ))
+        gen = SyntheticFlowGen(num_tuples=100, seed=17)
+        pipe.ingest(FlowBatch.from_records(gen.records(128, T0)))
+        rows = [r for r in pipe.telemetry()["profile"]["census"]
+                if r["step"] == "fused_step" and "sorts" in r]
+        out[mode] = max((r["sorts"] for r in rows), default=None)
+    return out
+
+
+def main():
+    batches = int(os.environ.get("SORTBENCH_BATCHES", "4"))
+    n_keys = int(os.environ.get("SORTBENCH_KEYS", str(1 << 20)))
+    k_top = int(os.environ.get("SORTBENCH_TOPK", "128"))
+    with_fused = os.environ.get("SORTBENCH_FUSED", "0") == "1"
+    rows = []
+    err = None
+    sorts = {}
+    try:
+        sorts = _census_sorts(k_top)
+        modes = list(MODES)
+        if with_fused:
+            MODES["fused"] = "1"
+            modes.append("fused")
+        for batch, stash in _shapes():
+            recs = {}
+            for mode in modes:
+                if mode == "fused":
+                    os.environ["DEEPFLOW_FUSED_SKETCH"] = "1"
+                r = _run_mode(mode, batch, stash, batches, n_keys, k_top)
+                os.environ["DEEPFLOW_FUSED_SKETCH"] = "0"
+                r.update(batch=batch, stash=stash,
+                         sorts_per_dispatch=sorts.get(mode))
+                recs[mode] = r
+                print(json.dumps(r), file=sys.stderr, flush=True)
+            speedup = recs["onepass"]["rec_s"] / max(
+                recs["multisort"]["rec_s"], 1e-9)
+            parity = recs["onepass"]["digest"] == recs["multisort"]["digest"]
+            for r in recs.values():
+                r["speedup_vs_multisort"] = round(
+                    r["rec_s"] / max(recs["multisort"]["rec_s"], 1e-9), 3)
+                r["bit_parity"] = parity
+            rows.extend(recs.values())
+            print(json.dumps({"batch": batch, "stash": stash,
+                              "speedup": round(speedup, 3),
+                              "bit_parity": parity}),
+                  file=sys.stderr, flush=True)
+    except Exception as e:  # partial-JSON convention (bench.py stance)
+        err = repr(e)
+    out = {
+        "bench": "sortbench", "rows": rows,
+        "sorts_per_dispatch": sorts,
+        "n_keys": n_keys, "k_top": k_top, "batches_per_mode": batches,
+        "backend": jax.default_backend(),
+    }
+    if err:
+        out["partial"] = True
+        out["error"] = err
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

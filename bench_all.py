@@ -699,6 +699,39 @@ def config16(quick: bool):
          first_pump_ms=last["first_pump_ms"], rows=rows)
 
 
+def config17(quick: bool):
+    """One-pass shared sort (ISSUE 17): bench/sortbench.py A/Bs the
+    multi-sort oracle vs the shared-sort rewrite through the +top-K
+    windowed ingest at the §17 shapes, with census-attributed
+    sorts/dispatch and a bit-parity digest embedded (protocol +
+    committed CPU numbers: PERF.md §25, SORTBENCH_r01.json; acceptance:
+    ≥1.2× on the +topk shape with bit_parity true). The headline value
+    is the last shape's one-pass rate; vs_baseline is its speedup over
+    the multi-sort oracle on the same stream."""
+    import os
+    import subprocess
+
+    env = {**os.environ}
+    if quick:
+        env["SORTBENCH_SHAPES"] = "65536:8192"
+        env["SORTBENCH_BATCHES"] = "2"
+    out = subprocess.run(
+        [sys.executable, "bench/sortbench.py"],
+        capture_output=True, text=True, timeout=3600, env=env,
+    )
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    ones = [r for r in rec.get("rows", []) if r["mode"] == "onepass"]
+    if rec.get("partial") or not ones:
+        emit("c17_one_pass_sort", 0, "error", 0, error=rec.get("error"))
+        return
+    last = ones[-1]
+    emit("c17_one_pass_sort", last["rec_s"], "records/s",
+         last["speedup_vs_multisort"],
+         batch=last["batch"], stash=last["stash"],
+         bit_parity=last["bit_parity"],
+         sorts_per_dispatch=rec["sorts_per_dispatch"], rows=rec["rows"])
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--cpu", action="store_true")
@@ -706,7 +739,7 @@ def main():
     args = p.parse_args()
     for fn in (config1, config2, config3, config4, config5, config6, config7,
                config8, config9, config10, config11, config12, config13,
-               config14, config15, config16):
+               config14, config15, config16, config17):
         try:
             fn(args.quick)
         except Exception as e:  # one config must not kill the others

@@ -183,6 +183,14 @@ def make_ingest_step(fanout_config: FanoutConfig, interval: int = 1, app: bool =
             return stash, acc
     else:
         meter_ix = meter_schema.index
+        # one-pass knobs captured at BUILD time (ISSUE 17): the caller
+        # jits this closure fresh per plane instance, so capturing here
+        # pins the path for the closure's whole life — a retrace on a
+        # new bucket shape cannot silently flip it mid-stream
+        from ..ops.segment import _use_fused_sketch, _use_shared_sort
+
+        shared_sort = _use_shared_sort()
+        fused_sketch = _use_fused_sketch()
 
         def append(stash, acc, offset, sk, tags, meters, valid, start_window):
             stash, acc, r_tags, r_meters, r_valid = _base_append(
@@ -198,7 +206,8 @@ def make_ingest_step(fanout_config: FanoutConfig, interval: int = 1, app: bool =
             sk = sketch_plane_step(
                 sk, sketch_config.hist,
                 window=ts // jnp.uint32(interval), valid=r_valid,
-                base_w=base_w, close_w=close_w, **inp,
+                base_w=base_w, close_w=close_w,
+                shared_sort=shared_sort, fused_sketch=fused_sketch, **inp,
             )
             return stash, acc, sk
 
@@ -367,6 +376,13 @@ class RollupPipeline:
         sketch_cfg = self.config.window.sketch
         m_ix = m.index
 
+        # one-pass knobs captured at step-BUILD time (ISSUE 17) — same
+        # retrace-stability stance as make_ingest_step's sketch append
+        from ..ops.segment import _use_fused_sketch, _use_shared_sort
+
+        shared_sort = _use_shared_sort()
+        fused_sketch = _use_fused_sketch()
+
         def _sketch(sk, tags, meters, valid, start_window):
             """Per-window plane update from the RAW flow rows (ISSUE 8):
             pre-fanout, so a flow counts once — doc-lane replication
@@ -382,7 +398,8 @@ class RollupPipeline:
             return sketch_plane_step(
                 sk, sketch_cfg.hist,
                 window=ts // jnp.uint32(interval), valid=valid,
-                base_w=base_w, close_w=close_w, **inp,
+                base_w=base_w, close_w=close_w,
+                shared_sort=shared_sort, fused_sketch=fused_sketch, **inp,
             )
 
         def step(acc, offset, start_window, stash_valid, stash_evict,

@@ -49,8 +49,15 @@ import numpy as np
 from ..ops.cms import row_slots
 from ..ops.hll import clz32, hll_estimate_np
 from ..ops.histogram import LogHistSpec, loghist_bin
+from ..ops.segment import _use_fused_sketch, _use_shared_sort
 from ..ops.tdigest import tdigest_compress, tdigest_quantile
-from ..ops.topk import topk_candidates, topk_select, topk_update
+from ..ops.topk import (
+    _apply_challengers,
+    topk_candidates,
+    topk_challengers_presorted,
+    topk_select,
+    topk_update,
+)
 
 _U32_MAX = np.uint32(0xFFFFFFFF)
 SENTINEL_WIN = _U32_MAX
@@ -271,11 +278,27 @@ def _scatter_rows(
     rtt_valid,
     id_a,
     id_b,
+    presorted=None,
+    fused_sketch: bool = False,
 ) -> SketchState:
     """Fold one phase's rows into their ring slots (claiming empties).
     Callers guarantee the phase's window span is < R wide, so slots are
     collision-free by construction (consecutive windows ≡ distinct
-    mod R)."""
+    mod R).
+
+    With `presorted` (the batch's ONE shared (window, key_hi, key_lo)
+    sort from `sketch_plane_step` — ISSUE 17), the count-min and top-K
+    lanes consume the shared order instead of sorting again: per-(window,
+    key) run weights are summed once and reused as the count-min
+    run-dedup weights (one add per run head instead of per row — adds
+    commute, totals bit-identical) AND as the top-K challenger weights
+    (`topk_challengers_presorted`, zero fresh sorts). The per-row lanes
+    whose folds are idempotent or count-shaped (win claim, count, HLL
+    register max, histogram) stay on the original row order — a run
+    spans one flow key, not one client, so they cannot ride the run
+    dedup. `fused_sketch` additionally routes HLL + count-min + the
+    challenger scan through the single-pass Pallas kernel
+    (ops/sketch_pallas.py) when the shapes support it."""
     r = sk.ring
     g, m = sk.hll.shape[1], sk.hll.shape[2]
     d_cms, w_cms = sk.cms.shape[1], sk.cms.shape[2]
@@ -289,32 +312,99 @@ def _scatter_rows(
 
     reg = (jnp.asarray(client_lo, jnp.uint32) & jnp.uint32(m - 1)).astype(jnp.int32)
     rho = (clz32(client_hi) + 1).astype(jnp.int32)
-    hll = sk.hll.at[gslot, gid, reg].max(rho, mode="drop")
 
     w = jnp.where(mask, jnp.asarray(weight).astype(jnp.int32), 0)
-    rs = row_slots(key_hi, key_lo, d_cms, w_cms)  # [D, N] in [0, D*W)
-    flat = gslot[None, :].astype(jnp.int32) * (d_cms * w_cms) + rs
-    cms = (
-        sk.cms.reshape(-1)
-        .at[flat.reshape(-1)]
-        .add(jnp.broadcast_to(w[None, :], flat.shape).reshape(-1), mode="drop")
-        .reshape(r, d_cms, w_cms)
-    )
 
     b = loghist_bin(rtt, spec)
     hslot = jnp.where(mask & rtt_valid, slot, r)
     hist = sk.hist.at[hslot, gid, b].add(1, mode="drop")
 
-    if sk.tk_votes.shape[1]:
-        tkv, tkh, tkl, tia, tib = topk_update(
-            (sk.tk_votes, sk.tk_hi, sk.tk_lo, sk.tk_ida, sk.tk_idb),
-            slot, key_hi, key_lo, id_a, id_b, weight, mask,
+    lanes = (sk.tk_votes, sk.tk_hi, sk.tk_lo, sk.tk_ida, sk.tk_idb)
+    d_tk = sk.tk_votes.shape[1]
+
+    if presorted is None:
+        # multi-sort oracle: per-row CMS scatter + a fresh 3-key sort
+        # per top-K hash row (topk_update)
+        hll = sk.hll.at[gslot, gid, reg].max(rho, mode="drop")
+        rs = row_slots(key_hi, key_lo, d_cms, w_cms)  # [D, N] in [0, D*W)
+        flat = gslot[None, :].astype(jnp.int32) * (d_cms * w_cms) + rs
+        cms = (
+            sk.cms.reshape(-1)
+            .at[flat.reshape(-1)]
+            .add(jnp.broadcast_to(w[None, :], flat.shape).reshape(-1), mode="drop")
+            .reshape(r, d_cms, w_cms)
         )
-    else:
-        tkv, tkh, tkl, tia, tib = (
-            sk.tk_votes, sk.tk_hi, sk.tk_lo, sk.tk_ida, sk.tk_idb,
+        if d_tk:
+            tkv, tkh, tkl, tia, tib = topk_update(
+                lanes, slot, key_hi, key_lo, id_a, id_b, weight, mask,
+            )
+        else:
+            tkv, tkh, tkl, tia, tib = lanes
+        return dataclasses.replace(
+            sk, win=win, count=count, hll=hll, cms=cms, hist=hist,
+            tk_votes=tkv, tk_hi=tkh, tk_lo=tkl, tk_ida=tia, tk_idb=tib,
         )
 
+    # -- shared-sort path (ISSUE 17) ------------------------------------
+    n = window.shape[0]
+    s_win, s_hi, s_lo, s_pos, head, run_id = presorted
+    s_slot = (s_win % jnp.uint32(r)).astype(jnp.int32)
+    s_mask = mask[s_pos]
+    s_w = w[s_pos]
+    # per-(window, key) run weight under THIS phase's mask — shared by
+    # the count-min head adds and every top-K hash row
+    run_w = jax.ops.segment_sum(s_w, run_id, num_segments=n)
+    rw = run_w[run_id]
+    w_head = jnp.where(head, rw, 0)
+    s_ia = jnp.asarray(id_a, jnp.uint32)[s_pos]
+    s_ib = jnp.asarray(id_b, jnp.uint32)[s_pos]
+    rs = row_slots(s_hi, s_lo, d_cms, w_cms)  # [D, N] in [0, D*W)
+
+    fused_done = False
+    if fused_sketch:
+        from ..ops.sketch_pallas import fused_sketch_guard, sketch_update_fused
+
+        ok = fused_sketch_guard(
+            n, r, g, m, d_cms, w_cms, d_tk, sk.tk_votes.shape[2]
+        )
+        if ok:
+            hll, cms, challengers = sketch_update_fused(
+                sk.hll, sk.cms, tk_shape=(d_tk, sk.tk_votes.shape[2]),
+                s_slot=s_slot, s_gid=gid[s_pos], s_reg=reg[s_pos],
+                s_rho=rho[s_pos], s_mask=s_mask, w_head=w_head, rw=rw,
+                cms_slots=rs, s_hi=s_hi, s_lo=s_lo, s_ia=s_ia, s_ib=s_ib,
+            )
+            fused_done = True
+    if not fused_done:
+        hll = sk.hll.at[gslot, gid, reg].max(rho, mode="drop")
+        # one add per run HEAD (carrying the run's summed weight)
+        # instead of per row: non-head rows add 0 at a live cell — a
+        # no-op — so cell totals stay bit-identical to the per-row
+        # oracle while the scatter's live writes drop to one per
+        # (window, key) run. Head slots are always in-range (window
+        # % R), so no index masking is needed: fully-unmasked runs
+        # carry w_head == 0.
+        flat = s_slot[None, :] * (d_cms * w_cms) + rs
+        cms = (
+            sk.cms.reshape(-1)
+            .at[flat.reshape(-1)]
+            .add(
+                jnp.broadcast_to(w_head[None, :], flat.shape).reshape(-1),
+                mode="drop",
+            )
+            .reshape(r, d_cms, w_cms)
+        )
+        challengers = (
+            topk_challengers_presorted(
+                s_slot, s_hi, s_lo, s_ia, s_ib, rw, s_mask,
+                r, d_tk, sk.tk_votes.shape[2],
+            )
+            if d_tk
+            else []
+        )
+    tkv, tkh, tkl, tia, tib = (
+        _apply_challengers(lanes, challengers) if d_tk else lanes
+    )
     return dataclasses.replace(
         sk, win=win, count=count, hll=hll, cms=cms, hist=hist,
         tk_votes=tkv, tk_hi=tkh, tk_lo=tkl, tk_ida=tia, tk_idb=tib,
@@ -339,6 +429,8 @@ def sketch_plane_step(
     rtt_valid,
     id_a,
     id_b,
+    shared_sort: bool | None = None,
+    fused_sketch: bool | None = None,
 ) -> SketchState:
     """One batch through the plane, in window order (traced):
 
@@ -359,7 +451,24 @@ def sketch_plane_step(
     mod R into an older occupied slot and silently merge two windows'
     sketches. Rows in the mid-gap [anchor + R, close_w) — only
     possible when one batch spans more than R windows below its close
-    bound — are counted into `shed` instead (module docstring)."""
+    bound — are counted into `shed` instead (module docstring).
+
+    One-pass fold (ISSUE 17). With `shared_sort` (default: the
+    DEEPFLOW_SHARED_SORT knob, ON) and the top-K lane enabled, the
+    batch's (window, key_hi, key_lo) stable sort runs ONCE here and
+    both phases consume it — the per-hash-row fresh sorts inside
+    `topk_update` (2 phases × topk_rows sorts) collapse into this one,
+    and the count-min scatter dedups to run heads. Bit-exact vs the
+    multi-sort path (tests/test_sketch_onepass.py). `fused_sketch`
+    (default: DEEPFLOW_FUSED_SKETCH, OFF until on-chip numbers) further
+    collapses the sorted-order folds into the single-pass Pallas
+    kernel. Both knobs resolve at TRACE time — callers whose jitted
+    step outlives an env flip must thread them as static arguments
+    (aggregator/window.py does)."""
+    if shared_sort is None:
+        shared_sort = _use_shared_sort()
+    if fused_sketch is None:
+        fused_sketch = _use_fused_sketch()
     r = sk.ring
     window = jnp.asarray(window, jnp.uint32)
     base_w = jnp.asarray(base_w, jnp.uint32)
@@ -376,11 +485,36 @@ def sketch_plane_step(
         & (window < close_w)
     )
 
+    presorted = None
+    if shared_sort and sk.tk_votes.shape[1]:
+        # THE batch sort: stable 3-key over the raw lanes + a position
+        # payload. No sentinel rekey is needed — phase masks ride
+        # through the permutation, and masked-out rows contribute
+        # weight 0 without perturbing the relative order of live rows.
+        n = window.shape[0]
+        iota = jnp.arange(n, dtype=jnp.int32)
+        s_win, s_hi, s_lo, s_pos = jax.lax.sort(
+            (window, jnp.asarray(key_hi, jnp.uint32),
+             jnp.asarray(key_lo, jnp.uint32), iota),
+            num_keys=3,
+        )
+        head = jnp.concatenate(
+            [
+                jnp.ones((1,), bool),
+                (s_win[1:] != s_win[:-1])
+                | (s_hi[1:] != s_hi[:-1])
+                | (s_lo[1:] != s_lo[:-1]),
+            ]
+        )
+        run_id = jnp.cumsum(head.astype(jnp.int32)) - 1
+        presorted = (s_win, s_hi, s_lo, s_pos, head, run_id)
+
     args = (group, client_hi, client_lo, key_hi, key_lo, weight, rtt,
             rtt_valid, id_a, id_b)
-    sk = _scatter_rows(sk, spec, in_a, window, *args)
+    kw = dict(presorted=presorted, fused_sketch=fused_sketch)
+    sk = _scatter_rows(sk, spec, in_a, window, *args, **kw)
     sk = sketch_close(sk, close_w)
-    sk = _scatter_rows(sk, spec, in_c, window, *args)
+    sk = _scatter_rows(sk, spec, in_c, window, *args, **kw)
     folded = (jnp.sum(in_a) + jnp.sum(in_c)).astype(jnp.uint32)
     return dataclasses.replace(
         sk,

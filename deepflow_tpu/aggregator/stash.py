@@ -287,7 +287,17 @@ def _merge_fold_impl(state: StashState, acc: AccumState, hi_window, sum_cols_t, 
     empties — same contract as `_fold_impl`). Requires the canonical
     stash layout (see the section comment above); returns
     (new_state, new_acc, fold_rows) where fold_rows counts the acc rows
-    this fold's keyed sort actually touched."""
+    this fold's keyed sort actually touched.
+
+    One-pass scoping note (ISSUE 17): this sort is NOT a candidate for
+    the sketch plane's shared batch sort — it runs once per FOLD (every
+    accum_batches batches, over the acc ring's accumulated rows), not
+    per ingest dispatch, and its key space is the doc fingerprint over
+    post-fanout rows, not the plane's raw-flow key. The per-dispatch
+    sorts the shared-sort rewrite collapses are the sketch plane's
+    (sketchplane.sketch_plane_step); the fold's amortized sort already
+    IS the one sort of its own dispatch bucket (census-attributed in
+    pipeline.telemetry()["profile"])."""
     s = state.capacity
     a = acc.capacity
     hi_window = jnp.asarray(hi_window, dtype=jnp.uint32)

@@ -47,6 +47,7 @@ import numpy as np
 from .. import chaos
 from ..datamodel.schema import FLOW_METER, TAG_SCHEMA, MeterSchema, TagSchema
 from ..ops.hashing import fingerprint64
+from ..ops.segment import _use_fused_sketch, _use_shared_sort
 from .cascade import CascadeConfig, TierCascade, TierFlush
 from .sketchplane import (
     SENTINEL_WIN,
@@ -374,19 +375,27 @@ def sketch_span_bounds(start_window, ts, valid, *, interval: int, delay: int):
 @partial(
     jax.jit,
     donate_argnums=(0, 9),
-    static_argnames=("interval", "delay", "ix", "spec"),
+    static_argnames=("interval", "delay", "ix", "spec", "shared_sort",
+                     "fused_sketch"),
 )
 def _raw_append_step_sk(acc, offset, start_window, stash_valid, stash_evict,
                         feeder_shed, fold_rows, casc_lanes, snap_lanes, sk,
                         timestamp, key_hi, key_lo, tags, meters, valid,
-                        *, interval, delay, ix, spec):
+                        *, interval, delay, ix, spec, shared_sort=True,
+                        fused_sketch=False):
     """`_raw_append_step` with the per-window sketch plane fused in
     (ISSUE 8): the SAME jit dispatch updates HLL/CMS/histogram/top-K
     slots for every accepted row — key identity is the caller's doc
     fingerprint (key_hi/key_lo), client identity re-derives from the
     ip0 tag words — and the counter block grows the v4 sketch lanes.
     Zero new fetches: the plane's closed blocks leave the device via
-    the advance drain, not here."""
+    the advance drain, not here.
+
+    `shared_sort`/`fused_sketch` (ISSUE 17) are STATIC: this step is
+    module-level-jitted, so an env flip after the first trace would be
+    invisible if the plane read the knobs at trace time — the caller
+    (WindowManager.merge_batch) reads them per dispatch instead and a
+    flip recompiles (counted by the jit monitor like any retrace)."""
     ts = jnp.asarray(timestamp, dtype=jnp.uint32)
     valid_b = jnp.asarray(valid)
     base_w, close_w = sketch_span_bounds(
@@ -400,7 +409,8 @@ def _raw_append_step_sk(acc, offset, start_window, stash_valid, stash_evict,
     sk = sketch_plane_step(
         sk, spec,
         window=ts // jnp.uint32(interval), valid=valid_b,
-        base_w=base_w, close_w=close_w, **inp,
+        base_w=base_w, close_w=close_w,
+        shared_sort=shared_sort, fused_sketch=fused_sketch, **inp,
     )
     gated, window, block = batch_counter_block(
         ts, valid_b, start_window, interval,
@@ -1302,6 +1312,11 @@ class WindowManager:
                     timestamp, key_hi, key_lo, tags, meters, valid,
                     interval=interval, delay=self.config.delay,
                     ix=self._sketch_ix, spec=self.config.sketch.hist,
+                    # env knobs read at DISPATCH time (static argnames —
+                    # the step is module-level-jitted, so a flip must
+                    # recompile rather than silently keep the old path)
+                    shared_sort=_use_shared_sort(),
+                    fused_sketch=_use_fused_sketch(),
                 )
         else:
             def dispatch(acc, offset, start_window):

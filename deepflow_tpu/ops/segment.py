@@ -86,6 +86,28 @@ def _use_merge_scatter() -> bool:
     worth measuring."""
     return os.environ.get("DEEPFLOW_MERGE_SCATTER", "0") == "1"
 
+
+def _use_shared_sort() -> bool:
+    """One-pass sketch fold (ISSUE 17): the sketch plane computes the
+    batch's keyed sort permutation ONCE per fused dispatch and threads
+    the sorted lanes through both fold phases, every top-K hash row and
+    the count-min run dedup — 4 sorts/dispatch → 1 with sketch+topk ON.
+    Bit-exact vs the multi-sort oracle (pinned in
+    tests/test_sketch_onepass.py), so it defaults ON.
+    DEEPFLOW_SHARED_SORT=0 restores the per-consumer sorts for A/B."""
+    return os.environ.get("DEEPFLOW_SHARED_SORT", "1") != "0"
+
+
+def _use_fused_sketch() -> bool:
+    """On the shared-sort path, run the HLL/CMS/top-K challenger update
+    as ONE Pallas pass over the sorted batch (ops/sketch_pallas.py)
+    instead of the XLA presorted path. Default OFF until on-chip
+    numbers land (the §15 flip-the-default convention); interpret-mode
+    parity is pinned on CPU either way. DEEPFLOW_FUSED_SKETCH=1
+    enables."""
+    return os.environ.get("DEEPFLOW_FUSED_SKETCH", "0") == "1"
+
+
 _U32_MAX = np.uint32(0xFFFFFFFF)
 
 

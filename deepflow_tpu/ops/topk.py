@@ -72,12 +72,44 @@ def bucket_cols(key_hi, key_lo, row: int, cols: int, xp=jnp):
     return (h & xp.uint32(cols - 1)).astype(xp.int32)
 
 
+def _apply_challengers(lanes, challengers):
+    """Weighted-MJRTY vote epilogue, shared by every update path (the
+    fresh-sort oracle, the shared-sort presorted path, and the fused
+    Pallas kernel — ops/sketch_pallas.py): apply, per hash row, ONE
+    challenger per flat [R*C] bucket. `challengers` is a list of
+    (got, h_hi, h_lo, h_ia, h_ib, hw) tuples, one per hash row, with hw
+    already clamped ≥ 0 and 0 wherever got is False."""
+    votes, l_hi, l_lo, l_ia, l_ib = lanes
+    r_ring, d, c = votes.shape
+    for r, (got, h_hi, h_lo, h_ia, h_ib, hw) in enumerate(challengers):
+        v = votes[:, r, :].reshape(-1)
+        bh = l_hi[:, r, :].reshape(-1)
+        bl = l_lo[:, r, :].reshape(-1)
+        ba = l_ia[:, r, :].reshape(-1)
+        bb = l_ib[:, r, :].reshape(-1)
+        live = v > 0
+        same = live & (bh == h_hi) & (bl == h_lo)
+        challenged = jnp.where(live, v - hw, -hw)
+        take = got & ~same & (challenged < 0)
+        new_v = jnp.where(same, v + hw, jnp.where(take, -challenged, challenged))
+        new_v = jnp.where(got, new_v, v)
+        votes = votes.at[:, r, :].set(new_v.reshape(r_ring, c))
+        l_hi = l_hi.at[:, r, :].set(jnp.where(take, h_hi, bh).reshape(r_ring, c))
+        l_lo = l_lo.at[:, r, :].set(jnp.where(take, h_lo, bl).reshape(r_ring, c))
+        l_ia = l_ia.at[:, r, :].set(jnp.where(take, h_ia, ba).reshape(r_ring, c))
+        l_ib = l_ib.at[:, r, :].set(jnp.where(take, h_ib, bb).reshape(r_ring, c))
+    return votes, l_hi, l_lo, l_ia, l_ib
+
+
 def topk_update(lanes, slot, key_hi, key_lo, id_a, id_b, weight, valid):
     """One batch of weighted observations into the [R, d, C] lanes.
 
     `slot` is the per-row ring index ([N] i32); rows with slot outside
     [0, R) or valid=False are dropped. Traced — callers fuse this into
-    their jitted ingest step."""
+    their jitted ingest step. This is the multi-sort ORACLE: one fresh
+    3-key sort per hash row. The shared-sort hot path
+    (`topk_challengers_presorted`, driven from
+    aggregator/sketchplane.py) is pinned bit-exact against it."""
     votes, l_hi, l_lo, l_ia, l_ib = lanes
     r_ring, d, c = votes.shape
     n = key_hi.shape[0]
@@ -88,6 +120,7 @@ def topk_update(lanes, slot, key_hi, key_lo, id_a, id_b, weight, valid):
     slot = jnp.asarray(slot, jnp.int32)
     ok = valid & (slot >= 0) & (slot < r_ring)
     iota = jnp.arange(n, dtype=jnp.int32)
+    challengers = []
     for r in range(d):
         col = bucket_cols(key_hi, key_lo, r, c)
         seg = jnp.where(ok, slot * c + col, segs)
@@ -118,28 +151,46 @@ def topk_update(lanes, slot, key_hi, key_lo, id_a, id_b, weight, valid):
         )[:segs]
         got = win_row < n
         wr = jnp.clip(win_row, 0, n - 1)
-        h_hi, h_lo = s_hi[wr], s_lo[wr]
-        h_ia, h_ib = s_ia[wr], s_ib[wr]
         hw = jnp.where(got, jnp.maximum(heavy_w, 0), 0)
+        challengers.append((got, s_hi[wr], s_lo[wr], s_ia[wr], s_ib[wr], hw))
+    return _apply_challengers(lanes, challengers)
 
-        # weighted MJRTY per bucket, flat [R*C]
-        v = votes[:, r, :].reshape(-1)
-        bh = l_hi[:, r, :].reshape(-1)
-        bl = l_lo[:, r, :].reshape(-1)
-        ba = l_ia[:, r, :].reshape(-1)
-        bb = l_ib[:, r, :].reshape(-1)
-        live = v > 0
-        same = live & (bh == h_hi) & (bl == h_lo)
-        challenged = jnp.where(live, v - hw, -hw)
-        take = got & ~same & (challenged < 0)
-        new_v = jnp.where(same, v + hw, jnp.where(take, -challenged, challenged))
-        new_v = jnp.where(got, new_v, v)
-        votes = votes.at[:, r, :].set(new_v.reshape(r_ring, c))
-        l_hi = l_hi.at[:, r, :].set(jnp.where(take, h_hi, bh).reshape(r_ring, c))
-        l_lo = l_lo.at[:, r, :].set(jnp.where(take, h_lo, bl).reshape(r_ring, c))
-        l_ia = l_ia.at[:, r, :].set(jnp.where(take, h_ia, ba).reshape(r_ring, c))
-        l_ib = l_ib.at[:, r, :].set(jnp.where(take, h_ib, bb).reshape(r_ring, c))
-    return votes, l_hi, l_lo, l_ia, l_ib
+
+def topk_challengers_presorted(
+    s_slot, s_hi, s_lo, s_ia, s_ib, rw, s_mask, r_ring: int, d: int, c: int
+):
+    """Per-hash-row challenger extraction from an ALREADY SORTED batch —
+    zero sorts (ISSUE 17, shared-sort path).
+
+    Inputs are the batch's lanes gathered through ONE shared
+    (window, key_hi, key_lo)-stable sort permutation (the sketch
+    plane's), with `rw` the per-row (window, key)-run weight sum under
+    the phase mask `s_mask` (computed once upstream, shared with the
+    count-min run dedup). Bit-exactness vs the per-row fresh sort of
+    `topk_update` holds because a bucket only ever receives rows of ONE
+    window (slot ↔ window is bijective within a phase span < R), so the
+    shared order restricted to a bucket is the oracle's
+    (key_hi, key_lo, original-position) order — same heaviest run, same
+    stable first-row tie-break. Returns the `_apply_challengers` input
+    list."""
+    n = s_hi.shape[0]
+    segs = r_ring * c
+    iota = jnp.arange(n, dtype=jnp.int32)
+    challengers = []
+    for r in range(d):
+        col = bucket_cols(s_hi, s_lo, r, c)
+        seg = jnp.where(s_mask, s_slot * c + col, segs)
+        heavy_w = jax.ops.segment_max(rw, seg, num_segments=segs + 1)[:segs]
+        in_seg = seg < segs
+        is_heavy = in_seg & (rw == heavy_w[jnp.clip(seg, 0, segs - 1)])
+        win_row = jax.ops.segment_min(
+            jnp.where(is_heavy, iota, n), seg, num_segments=segs + 1
+        )[:segs]
+        got = win_row < n
+        wr = jnp.clip(win_row, 0, n - 1)
+        hw = jnp.where(got, jnp.maximum(heavy_w, 0), 0)
+        challengers.append((got, s_hi[wr], s_lo[wr], s_ia[wr], s_ib[wr], hw))
+    return challengers
 
 
 def topk_merge(a, b):

@@ -235,6 +235,13 @@ class ShardedPipeline:
         )
         t_idx = TAG_SCHEMA.index
         m_idx = FLOW_METER.index
+        # one-pass knobs captured at step-BUILD time (ISSUE 17): the
+        # sharded twin pins the same path as the single-chip step for
+        # the life of this jitted closure
+        from ..ops.segment import _use_fused_sketch, _use_shared_sort
+
+        shared_sort = _use_shared_sort()
+        fused_sketch = _use_fused_sketch()
 
         def device_step(stash, acc, offset, sk, tag_mat, meters, valid,
                         start_window, close_below):
@@ -265,7 +272,8 @@ class ShardedPipeline:
             new_sk = sketch_plane_step(
                 sk1, c.hist,
                 window=ts // jnp.uint32(c.interval), valid=valid1,
-                base_w=start_window, close_w=close_below, **inp,
+                base_w=start_window, close_w=close_below,
+                shared_sort=shared_sort, fused_sketch=fused_sketch, **inp,
             )
 
             expand = lambda x: x[None]

@@ -47,7 +47,7 @@ def _abstract(tree):
 class _Entry:
     __slots__ = ("service", "step", "bucket", "fn_ref", "abstract_args",
                  "compiles", "compile_wall_s", "first_dispatch_s",
-                 "analysis", "analysis_error")
+                 "analysis", "analysis_error", "sorts", "sorts_error")
 
     def __init__(self, service, step, bucket, fn, abstract_args):
         self.service = service
@@ -60,6 +60,8 @@ class _Entry:
         self.first_dispatch_s = 0.0
         self.analysis: dict | None = None
         self.analysis_error: str | None = None
+        self.sorts: int | None = None
+        self.sorts_error: str | None = None
 
 
 #: the headline cost_analysis keys (XLA also emits per-operand
@@ -84,6 +86,50 @@ def _flatten_cost(cost) -> dict:
             except (TypeError, ValueError):
                 continue
     return out
+
+
+def _count_sort_eqns(jaxpr) -> int:
+    """Recursively count `sort` primitive equations through every
+    sub-jaxpr (pjit bodies, cond branches, scan/while bodies, custom
+    call wrappers) — the static sorts-per-dispatch attribution of
+    ISSUE 17. Conditional branches each count: the census reports the
+    sorts a dispatch CAN pay, which is what the one-pass gate bounds."""
+    import jax
+
+    total = 0
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "sort":
+            total += 1
+        for v in eqn.params.values():
+            for sub in _sub_jaxprs(v):
+                total += _count_sort_eqns(sub)
+    return total
+
+
+def _sub_jaxprs(v):
+    """Yield every Jaxpr held by one eqn param value (handles Jaxpr,
+    ClosedJaxpr, and lists/tuples of either)."""
+    from jax.core import Jaxpr
+
+    if isinstance(v, Jaxpr):
+        yield v
+    elif hasattr(v, "jaxpr") and isinstance(getattr(v, "jaxpr"), Jaxpr):
+        yield v.jaxpr  # ClosedJaxpr
+    elif isinstance(v, (list, tuple)):
+        for x in v:
+            yield from _sub_jaxprs(x)
+
+
+def _trace_sort_count(fn, abstract_args) -> int:
+    """Sorts per dispatch of `fn` at the recorded bucket shapes —
+    STATIC jaxpr inspection only: `jax.make_jaxpr` re-traces abstractly
+    without touching the jit executable cache, so the count can ride
+    the steady-state profile pull without tripping the zero-retrace or
+    fetch-budget gates."""
+    import jax
+
+    jaxpr = jax.make_jaxpr(fn)(*abstract_args)
+    return _count_sort_eqns(jaxpr.jaxpr)
 
 
 class StepCostCensus:
@@ -166,18 +212,37 @@ class StepCostCensus:
         except Exception as err:
             e.analysis_error = repr(err)
 
+    def _count_sorts(self, e: _Entry) -> None:
+        """Lazy per-entry sorts/dispatch attribution (ISSUE 17): a pure
+        abstract re-trace, cached after the first pull. No compile, no
+        fetch — cheap enough for the default (analyze=False) snapshot
+        that telemetry()["profile"] and the bench JSON embeds read."""
+        if e.sorts is not None or e.sorts_error is not None:
+            return
+        fn = e.fn_ref() if e.fn_ref is not None else None
+        if fn is None:
+            e.sorts_error = "callable collected"
+            return
+        try:
+            e.sorts = _trace_sort_count(fn, e.abstract_args)
+        except Exception as err:
+            e.sorts_error = repr(err)
+
     def snapshot(self, *, analyze: bool = False) -> list[dict]:
         """One JSON-able row per (service, step, bucket). With
         `analyze=True` each entry's compiled-module analyses are
         computed (cached after the first pull) — this may COMPILE the
         step for its recorded shapes via the AOT path, so it belongs on
-        the profile pull, never inside ingest."""
+        the profile pull, never inside ingest. The `sorts` column
+        (sorts per dispatch, static jaxpr count) is computed on every
+        pull — trace-only, cached, fetch-free."""
         with self._lock:
             entries = list(self._entries.values())
         rows = []
         for e in sorted(entries, key=lambda e: (e.service, e.step, e.bucket)):
             if analyze:
                 self._analyze(e)
+            self._count_sorts(e)
             row = {
                 "service": e.service,
                 "step": e.step,
@@ -186,6 +251,10 @@ class StepCostCensus:
                 "compile_wall_s": round(e.compile_wall_s, 4),
                 "first_dispatch_s": round(e.first_dispatch_s, 4),
             }
+            if e.sorts is not None:
+                row["sorts"] = e.sorts
+            if e.sorts_error is not None:
+                row["sorts_error"] = e.sorts_error
             if e.analysis is not None:
                 row.update(e.analysis)
             if e.analysis_error is not None:

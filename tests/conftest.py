@@ -14,10 +14,10 @@ import sys
 # Hung-device forensics (ISSUE 6): a wedged dispatch/fetch used to die
 # at the suite timeout with no trace of WHERE it hung. faulthandler
 # dumps every thread's stack to stderr shortly before the tier-1
-# timeout (ROADMAP: 870 s) would kill us, without exiting — the test
+# timeout (ROADMAP: 1500 s) would kill us, without exiting — the test
 # then still fails on its own terms, but the log says which seam hung.
 faulthandler.enable()
-_dump_after = float(os.environ.get("DEEPFLOW_FAULTHANDLER_TIMEOUT_S", "840"))
+_dump_after = float(os.environ.get("DEEPFLOW_FAULTHANDLER_TIMEOUT_S", "1450"))
 if _dump_after > 0:
     faulthandler.dump_traceback_later(_dump_after, exit=False)
 
