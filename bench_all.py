@@ -732,14 +732,53 @@ def config17(quick: bool):
          sorts_per_dispatch=rec["sorts_per_dispatch"], rows=rec["rows"])
 
 
+def config18(quick: bool):
+    """Fleet telemetry plane (ISSUE 18): bench/fleetbench.py A/Bs the
+    §14 feeder workload passive vs with the full fleet export loop
+    (collector tick → frame build/encode → TCP ship → aggregator merge)
+    and sweeps the merged-read cost over hosts and over per-host sample
+    volume (protocol: PERF.md §26; acceptance: ingest overhead within
+    noise — fetch parity is CI-gated — and aggregator cost O(hosts),
+    not O(samples)). The vs line is the ingest overhead percent."""
+    import os
+    import subprocess
+
+    env = {**os.environ}
+    if quick:
+        env.update(FLEETBENCH_ITERS="16", FLEETBENCH_HOSTS="2,4")
+    out = subprocess.run(
+        [sys.executable, "bench/fleetbench.py"],
+        capture_output=True, text=True, timeout=1800, env=env,
+    )
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    if rec.get("partial"):
+        emit("c18_fleet_plane", 0, "error", 0, error=rec.get("error"))
+        return
+    emit("c18_fleet_plane", rec["fleet"]["rec_s"], "records/s",
+         rec["overhead_pct"],
+         frame_bytes_avg=rec["fleet"]["frame_bytes_avg"],
+         hosts_rows=rec["hosts_rows"],
+         per_host_ms_ratio=rec["per_host_ms_ratio"],
+         samples_ratio=rec["samples_ratio"],
+         frame_bytes_ratio=rec["frame_bytes_ratio"],
+         merge_ms_ratio=rec["merge_ms_ratio"],
+         passive=rec["passive"], iters=rec["iters"])
+
+
 def main():
+    from deepflow_tpu.utils.provenance import bench_provenance
+
     p = argparse.ArgumentParser()
     p.add_argument("--cpu", action="store_true")
     p.add_argument("--quick", action="store_true")
     args = p.parse_args()
+    # provenance first (ISSUE 18 satellite): every bench JSON names the
+    # commit, platform, and DEEPFLOW_* knob set it measured
+    prov = bench_provenance()
+    print(json.dumps({"provenance": prov}), flush=True)
     for fn in (config1, config2, config3, config4, config5, config6, config7,
                config8, config9, config10, config11, config12, config13,
-               config14, config15, config16, config17):
+               config14, config15, config16, config17, config18):
         try:
             fn(args.quick)
         except Exception as e:  # one config must not kill the others
@@ -748,7 +787,7 @@ def main():
     # record the docs cite
     out = "PERF_ALL.json" if not (args.quick or args.cpu) else "PERF_ALL_QUICK.json"
     with open(out, "w") as f:
-        json.dump(results, f, indent=1)
+        json.dump({"provenance": prov, "results": results}, f, indent=1)
 
 
 if __name__ == "__main__":

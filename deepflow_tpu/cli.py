@@ -21,7 +21,13 @@ Commands mirror the reference CLI surface that applies to this build:
                                          tracemap, prom, profile)
   dfctl profile --port P device          device profiling plane: HBM
                                          ledger + XLA step census
-                                         (--no-analyze skips compiles)
+                                         (--no-analyze skips compiles;
+                                         --json for machine output)
+  dfctl fleet --port P health|hosts|skew fleet pane (ISSUE 18): merged
+                                         cross-host status, per-host
+                                         roster + staleness, skew
+                                         surfaces (--json for machine
+                                         output)
   dfctl agent-group --port P ...         trisolaris group config/upgrade
   dfctl plugin --dir D list              L7 protocol plugin inventory
   dfctl trace --port P TRACE_ID          assembled trace tree (REST)
@@ -150,9 +156,40 @@ def cmd_trace(args):
     print(json.dumps(json.loads(body), indent=2))
 
 
+def _render_table(rows, columns=None):
+    """Minimal aligned text table over a list of row dicts — the human
+    faces of `dfctl profile`/`dfctl fleet` (pass --json for the
+    machine shape dashboards consume)."""
+    rows = [dict(r) for r in rows]
+    if not rows:
+        return "(no rows)"
+    if columns is None:
+        columns = []
+        for r in rows:
+            for k in r:
+                if k not in columns:
+                    columns.append(k)
+    def cell(r, c):
+        v = r.get(c, "")
+        return json.dumps(v) if isinstance(v, (dict, list)) else str(v)
+    widths = {
+        c: max(len(c), *(len(cell(r, c)) for r in rows)) for c in columns
+    }
+    lines = ["  ".join(c.ljust(widths[c]) for c in columns)]
+    lines.append("  ".join("-" * widths[c] for c in columns))
+    for r in rows:
+        lines.append("  ".join(cell(r, c).ljust(widths[c]) for c in columns))
+    return "\n".join(lines)
+
+
+def _render_kv(d):
+    return "\n".join(f"{k}: {v}" for k, v in d.items())
+
+
 def cmd_profile(args):
     """Device profiling plane (ISSUE 12): `dfctl profile device` pulls
-    the HBM ledger + step census over the controller REST surface."""
+    the HBM ledger + step census over the controller REST surface.
+    Human tables by default; --json emits the raw machine shape."""
     import urllib.request
 
     if args.what != "device":
@@ -161,7 +198,53 @@ def cmd_profile(args):
     with urllib.request.urlopen(
         f"http://{args.host}:{args.port}/v1/profile/device?analyze={analyze}"
     ) as r:
-        print(json.dumps(json.loads(r.read()), indent=2))
+        out = json.loads(r.read())
+    if args.json:
+        print(json.dumps(out, separators=(",", ":"), default=str))
+        return
+    print("# hbm ledger")
+    print(_render_table(out.get("hbm", [])))
+    print("\n# hbm totals")
+    print(_render_kv(out.get("hbm_totals", {})))
+    census = out.get("census", {})
+    entries = census.pop("entries", None) if isinstance(census, dict) else None
+    print("\n# step census")
+    if isinstance(census, dict):
+        print(_render_kv(census))
+    else:
+        print(json.dumps(census, indent=2))
+    if isinstance(entries, list) and entries:
+        print(_render_table(entries))
+
+
+def cmd_fleet(args):
+    """Fleet pane (ISSUE 18): `dfctl fleet health|hosts|skew` pulls the
+    aggregator's merged cross-host views over REST. Human tables by
+    default; --json emits the raw machine shape."""
+    import urllib.request
+
+    url = f"http://{args.host}:{args.port}/v1/fleet/{args.what}"
+    try:
+        with urllib.request.urlopen(url) as r:
+            out = json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        if args.json:
+            print(body.decode())
+        else:
+            sys.exit(f"fleet {args.what}: HTTP {e.code} {body.decode()}")
+        return
+    if args.json:
+        print(json.dumps(out, separators=(",", ":"), default=str))
+        return
+    if args.what == "hosts":
+        print(_render_table(
+            out,
+            columns=["host", "groups", "epoch", "frames", "age_s",
+                     "stale", "hbm_bytes"],
+        ))
+    else:
+        print(_render_kv(out))
 
 
 def cmd_agent_group(args):
@@ -260,7 +343,17 @@ def main(argv=None):
     sp.add_argument("what", choices=["device"])
     sp.add_argument("--no-analyze", action="store_true",
                     help="skip the XLA cost/memory analysis (no compile)")
+    sp.add_argument("--json", action="store_true",
+                    help="machine-readable output (compact JSON)")
     sp.set_defaults(fn=cmd_profile)
+
+    sp = sub.add_parser("fleet")
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--port", type=int, required=True)
+    sp.add_argument("what", choices=["health", "hosts", "skew"])
+    sp.add_argument("--json", action="store_true",
+                    help="machine-readable output (compact JSON)")
+    sp.set_defaults(fn=cmd_fleet)
 
     sp = sub.add_parser("agent-group")
     sp.add_argument("--host", default="127.0.0.1")

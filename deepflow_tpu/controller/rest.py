@@ -26,6 +26,9 @@ the composition root:
   GET    /v1/profile/device              device profiling plane (ISSUE
                                          12): HBM ledger + step census
                                          (?analyze=0 skips XLA analysis)
+  GET    /v1/fleet/health                fleet fan-in status (ISSUE 18)
+  GET    /v1/fleet/hosts                 per-host roster + staleness
+  GET    /v1/fleet/skew                  cross-host imbalance surfaces
   GET    /v1/profile/stacks              all live thread stacks (pprof
                                          goroutine-dump analog)
   GET    /v1/profile/cpu?seconds=N       folded stack samples (pprof
@@ -271,6 +274,21 @@ class RestServer:
                 "hbm_totals": default_ledger.get_counters(),
                 "census": default_census.snapshot(analyze=analyze),
             })
+        elif len(parts) == 3 and parts[:2] == ["v1", "fleet"]:
+            # fleet telemetry pane (ISSUE 18): merged cross-host views
+            # from the in-process FleetAggregator; 404 when the server
+            # runs without the fleet plane enabled
+            agg = getattr(df, "fleet", None)
+            if agg is None:
+                h._json({"error": "fleet plane not enabled"}, 404)
+            elif parts[2] == "health":
+                h._json(agg.health())
+            elif parts[2] == "hosts":
+                h._json(agg.hosts())
+            elif parts[2] == "skew":
+                h._json(agg.skew())
+            else:
+                h._json({"error": "not found"}, 404)
         elif u.path == "/v1/profile/stacks":
             h._json(_thread_stacks())
         elif u.path == "/v1/profile/cpu":

@@ -114,20 +114,27 @@ def system_metric_name(module: str, field: str) -> str:
     return _METRIC_SAN_RE.sub("_", f"{module}_{field}")
 
 
-def points_to_system_columns(points: list[StatsPoint]) -> dict[str, np.ndarray]:
+def points_to_system_columns(
+    points: list[StatsPoint], *, extra_tags: dict | None = None
+) -> dict[str, np.ndarray]:
     """StatsPoints → deepflow_system columns, one row per (point, field).
 
     Values store as f8 — integer counters up to 2^53 round-trip
     bit-exactly (the acceptance test pins this). Non-finite and
-    non-numeric fields are skipped, same stance as points_to_influx."""
+    non-numeric fields are skipped, same stance as points_to_influx.
+
+    `extra_tags` merge into every row's packed labels (winning on
+    collision) — the fleet aggregator stamps `host`/`group` here so
+    per-host attribution is a plain PromQL label selector."""
     from .formats import pack_tags
 
+    extra = {k: str(v) for k, v in (extra_tags or {}).items()}
     time_col: list[int] = []
     metric: list[str] = []
     labels: list[str] = []
     value: list[float] = []
     for p in points:
-        packed = pack_tags({k: str(v) for k, v in p.tags})
+        packed = pack_tags({**{k: str(v) for k, v in p.tags}, **extra})
         for fname, v in p.fields.items():
             if isinstance(v, bool):
                 v = int(v)

@@ -121,6 +121,20 @@ _SEVERITY = {STATE_INACTIVE: 0, STATE_RESOLVED: 1, STATE_PENDING: 2,
              STATE_FIRING: 3}
 
 
+def worst_state(states) -> str:
+    """Worst alert state in `states` under the severity ordering —
+    the fleet aggregator's per-rule cross-host rollup (one firing host
+    makes the fleet rule firing). Unknown states rank below inactive
+    rather than raising: a newer host must not crash an older pane."""
+    worst = STATE_INACTIVE
+    rank = -1
+    for s in states:
+        r = _SEVERITY.get(s, -1)
+        if r > rank:
+            rank, worst = r, s
+    return worst
+
+
 # -- rule persistence (ISSUE 13 satellite / ROADMAP r15 leftover) --------
 #
 # Rules serialize to/from plain mappings so a YAML or JSON config file

@@ -220,6 +220,21 @@ class Server:
             self.store, max_bytes=cfg.storage.max_disk_bytes or None
         )
         self.query = QueryEngine(self.store, translator=self.translator)
+        # fleet telemetry fan-in (opt-in): the aggregator listener lands
+        # per-host frames in THIS server's deepflow_system store, so the
+        # SQL/PromQL/alert planes serve fleet-wide queries with
+        # host/group labels and REST grows /v1/fleet/*
+        self.fleet = None
+        if cfg.fleet.enabled:
+            from ..fleet import FleetAggregator
+
+            self.fleet = FleetAggregator(
+                host=cfg.fleet.listen_host,
+                port=cfg.fleet.listen_port,
+                store=self.store,
+                bus=self.event_bus,
+                expiry_s=cfg.fleet.expiry_s,
+            ).start()
         self.mcp = MCPServer(self)  # LLM tool surface (mcp.go seat)
         self.rest = RestServer(self)  # controller/querier REST + pprof seat
         if self.election:
@@ -352,6 +367,8 @@ class Server:
         self.trace_builder.stop()
         self.mcp.stop()
         self.rest.stop()
+        if self.fleet is not None:
+            self.fleet.stop()
         self.doc_writer.flush()
         self.doc_writer.stop()
         if self.exporter_hub is not None:

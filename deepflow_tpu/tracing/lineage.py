@@ -390,6 +390,24 @@ class FreshnessTracker:
         self._srcs.clear()
 
 
+def merge_hist_dumps(*dumps: dict) -> dict[str, list[list[int]]]:
+    """Sum `hist_dump()` outputs bin-for-bin across trackers/hosts —
+    the summary-domain merge algebra (histograms add; quantile
+    summaries don't). Output is the same sparse sorted shape
+    `hist_dump()` emits, so the merge composes: the fleet pane pins
+    merge(host dumps) bit-exact against the aggregator's view."""
+    acc: dict[str, dict[int, int]] = {}
+    for dump in dumps:
+        for lane, pairs in dump.items():
+            tgt = acc.setdefault(lane, {})
+            for b, c in pairs:
+                tgt[int(b)] = tgt.get(int(b), 0) + int(c)
+    return {
+        lane: [[b, tgt[b]] for b in sorted(tgt)]
+        for lane, tgt in sorted(acc.items())
+    }
+
+
 # ---------------------------------------------------------------------------
 # the tracker
 

@@ -72,12 +72,28 @@ class SketchConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Fleet telemetry fan-in (deepflow_tpu/fleet): when enabled the
+    server runs a FleetAggregator listener and the REST /v1/fleet pane
+    goes live; hosts point their FleetSink at (listen_host,
+    listen_port)."""
+
+    enabled: bool = False
+    listen_host: str = "127.0.0.1"
+    listen_port: int = 0  # 0 = ephemeral (tests); fixed in production
+    # host quiet longer than this is EXPIRED from merged views (counted,
+    # last-seen stamp retained on the hosts pane)
+    expiry_s: float = 60.0
+
+
+@dataclasses.dataclass(frozen=True)
 class ServerConfig:
     receiver: ReceiverConfig = ReceiverConfig()
     ingester: IngesterConfig = IngesterConfig()
     storage: StorageConfig = StorageConfig()
     aggregator: AggregatorConfig = AggregatorConfig()
     sketch: SketchConfig = SketchConfig()
+    fleet: FleetConfig = FleetConfig()
     region_id: int = 0
     log_level: str = "info"
     # exporter sink specs (exporters/config seat): list of mappings,
@@ -128,6 +144,8 @@ def _validate(cfg: ServerConfig) -> None:
         (1 <= cfg.sketch.hll_precision <= 18, "sketch.hll_precision out of range [1,18]"),
         (cfg.sketch.hist_gamma > 1.0, "sketch.hist_gamma must be > 1"),
         (0 <= cfg.receiver.tcp_port <= 65535, "receiver.tcp_port out of range"),
+        (cfg.fleet.expiry_s > 0, "fleet.expiry_s must be > 0"),
+        (0 <= cfg.fleet.listen_port <= 65535, "fleet.listen_port out of range"),
     ]
     for ok, msg in checks:
         if not ok:

@@ -245,6 +245,20 @@ class SpanTracer:
                 }
             return out
 
+    def hist_dump(self) -> dict[str, list[list[int]]]:
+        """stage → nonzero (bin, count) pairs — the same compact shape
+        `FreshnessTracker.hist_dump` emits, so span latency histograms
+        ride the fleet frame and merge bin-for-bin across hosts
+        (histograms add; quantile summaries don't)."""
+        with self._lock:
+            return {
+                name: [
+                    [int(b), int(a.hist[b])]
+                    for b in np.nonzero(a.hist)[0]
+                ]
+                for name, a in sorted(self._agg.items())
+            }
+
     def quantiles(
         self, name: str, qs: tuple[float, ...] = SPAN_QUANTILES
     ) -> np.ndarray | None:

@@ -44,7 +44,7 @@ class CounterSource:
     """One registered countable: weakly held, tagged."""
 
     __slots__ = ("module", "tags", "_ref", "_fn", "failures", "cooldown",
-                 "suppressed")
+                 "suppressed", "lock")
 
     def __init__(self, module: str, tags: dict[str, str], countable):
         self.module = module
@@ -52,6 +52,11 @@ class CounterSource:
         self.failures = 0  # consecutive get_counters() exceptions
         self.cooldown = 0  # ticks to skip before the next re-probe
         self.suppressed = False  # entered backoff (warning already logged)
+        # guards the failure/cooldown/suppressed bookkeeping: the tick
+        # thread and pull-path sample() callers (live queries, the fleet
+        # exporter) race on the same source, and unlocked check-then-act
+        # would lose failure counts or double-count recoveries
+        self.lock = threading.Lock()
         if callable(countable) and not isinstance(countable, Countable):
             # plain closures can't be weakly bound to a component lifetime;
             # hold them strongly (caller owns deregistration)
@@ -156,42 +161,51 @@ class StatsCollector:
             if src.dead():
                 dead.append(src)
                 continue
-            if src.cooldown > 0:  # backing off — skip this round
-                if _advance_backoff:
-                    src.cooldown -= 1
-                continue
+            with src.lock:
+                if src.cooldown > 0:  # backing off — skip this round
+                    if _advance_backoff:
+                        src.cooldown -= 1
+                    continue
             try:
                 fields = src.sample()
             except Exception:
                 with self._lock:
                     self.n_source_errors += 1
-                src.failures += 1
-                if src.failures >= self.MAX_SOURCE_FAILURES:
-                    src.cooldown = min(
-                        1 << (src.failures - self.MAX_SOURCE_FAILURES),
-                        self.MAX_BACKOFF_TICKS,
-                    )
-                    if not src.suppressed:
-                        src.suppressed = True
-                        _log.warning(
-                            "stats source %s%s backing off after %d "
-                            "consecutive sample errors (re-probed with "
-                            "capped exponential spacing)",
-                            src.module, dict(src.tags) or "", src.failures,
-                            exc_info=True,
+                with src.lock:
+                    src.failures += 1
+                    failures = src.failures
+                    entered_backoff = False
+                    if failures >= self.MAX_SOURCE_FAILURES:
+                        src.cooldown = min(
+                            1 << (failures - self.MAX_SOURCE_FAILURES),
+                            self.MAX_BACKOFF_TICKS,
                         )
+                        if not src.suppressed:
+                            src.suppressed = True
+                            entered_backoff = True
+                if entered_backoff:
+                    _log.warning(
+                        "stats source %s%s backing off after %d "
+                        "consecutive sample errors (re-probed with "
+                        "capped exponential spacing)",
+                        src.module, dict(src.tags) or "", failures,
+                        exc_info=True,
+                    )
                 continue
-            if src.suppressed:  # came back from backoff
+            with src.lock:
+                recovered = src.suppressed
+                failures = src.failures
                 src.suppressed = False
+                src.failures = 0
+                src.cooldown = 0
+            if recovered:  # came back from backoff
                 with self._lock:
                     self.n_source_recoveries += 1
                 _log.warning(
                     "stats source %s%s recovered after %d consecutive "
                     "sample errors", src.module, dict(src.tags) or "",
-                    src.failures,
+                    failures,
                 )
-            src.failures = 0
-            src.cooldown = 0
             if fields is None:  # component died → auto-deregister
                 dead.append(src)
                 continue

@@ -320,6 +320,39 @@ class HostRunner:
             out["groups"][str(g)] = rec
         return out
 
+    def fleet_frames(self, host_label: str | None = None,
+                     *, epoch: int = 0) -> list[str]:
+        """One ENCODED fleet frame per live (non-released) group, built
+        from the same faces `results()` dumps — swm counters as the
+        tick's StatsPoint, the freshness tracker as a hist face — and
+        hex-packed so they ride the JSON result file to the parent.
+        The fleet proof replays them through a real FleetAggregator
+        over TCP and pins the merge bit-exact against the per-host
+        dumps in `results()` (same faces, same instant: any codec or
+        merge drift shows as a diff)."""
+        from deepflow_tpu.fleet import FleetExporter
+        from deepflow_tpu.utils.stats import StatsPoint
+
+        host = (host_label if host_label is not None
+                else f"host{self.topology.process_index}")
+        frames = []
+        for g in sorted(self.groups):
+            st = self.groups[g]
+            if st.get("released"):
+                continue
+            c = st["swm"].get_counters()
+            exp = FleetExporter(
+                host, group=str(g), epoch=epoch,
+                hist_faces={f"g{g}": st["tracker"].freshness},
+                clock=lambda: float(T0),
+            )
+            pt = StatsPoint(
+                float(T0), "tpu_mesh_swm", (("group", str(g)),),
+                {k: int(c[k]) for k in _COUNTER_KEYS},
+            )
+            frames.append(exp.encode(points=[pt]).hex())
+        return frames
+
 
 # ---------------------------------------------------------------------------
 # subprocess body
@@ -371,6 +404,10 @@ def run_host(spec: dict) -> None:
 
             res = runner.results()
             res["killed_after"] = i
+            # the dead host's LAST frames — the staleness proof feeds
+            # these, then expires the host and pins the survivor-only
+            # merge
+            res["fleet_frames"] = runner.fleet_frames()
             Path(spec["out"]).write_text(json.dumps(res))
             # a dying host marks done (peers stop waiting) but does NOT
             # wait — it is the process death under test
@@ -380,6 +417,7 @@ def run_host(spec: dict) -> None:
             os._exit(KILL_EXIT)
     runner.finish()
     res = runner.results()
+    res["fleet_frames"] = runner.fleet_frames()
     res["fetch"] = {
         **fetch,
         "n_ingests": runner.n_ingests,

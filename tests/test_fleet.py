@@ -1,0 +1,568 @@
+"""ISSUE 18 acceptance: the fleet telemetry plane.
+
+Units: frame codec roundtrip, the summary-domain merge algebra
+(`merge_hist_dumps`, `worst_state`, `SpanTracer.hist_dump`), guarded
+exporter faces, aggregator staleness with an injected clock (counted
+expiry, last-seen stamp retained, counted recovery — no silent stale
+reads), store rows queryable through the EXISTING SQL + PromQL planes
+with `host`/`group` labels, REST `/v1/fleet/*`, `dfctl fleet`/`profile
+--json`.
+
+Tentpole pin: the REAL 2-process mesh_harness — each subprocess builds
+its fleet frames from its LIVE faces at result time; this module
+replays them through a real `FleetAggregator` TCP listener via a real
+`HandoffSender` and pins merged counters + log-hists BIT-EXACT against
+an oracle computed from the per-host dumps in the same results —
+including the kill-one-host staleness case.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import mesh_harness as mh
+from deepflow_tpu.fleet import (
+    AGGREGATOR_PEER,
+    FleetAggregator,
+    FleetExporter,
+    FleetFrame,
+    FleetSink,
+    decode_fleet_frame,
+    encode_fleet_frame,
+)
+from deepflow_tpu.ingest.framing import FrameReassembler
+from deepflow_tpu.utils.stats import StatsCollector, StatsPoint
+
+
+# ---------------------------------------------------------------------------
+# codec
+
+
+def test_fleet_frame_roundtrip():
+    f = FleetFrame(
+        host="h0", group="1", epoch=3, seq=7, timestamp=123.5,
+        points=((100.0, "tpu_mesh_swm", {"group": "1"},
+                 {"flow_in": 41, "rate": 1.5}),),
+        hists={"g1": {"1s.e2e": [[3, 4], [9, 2]]}},
+        alerts=({"name": "lag", "state": "firing", "value": 2.0,
+                 "transitions": 1},),
+        hbm=({"module": "window", "plane": "ring", "bytes": 1 << 20},),
+        census={"entries": 2, "compiles": 5},
+    )
+    asm = FrameReassembler()
+    [(header, body)] = asm.feed(encode_fleet_frame(f))
+    g = decode_fleet_frame(header, body)
+    assert g == FleetFrame(
+        host=f.host, group=f.group, epoch=f.epoch, seq=f.seq,
+        timestamp=f.timestamp, points=f.points, hists=f.hists,
+        alerts=f.alerts, hbm=f.hbm, census=f.census,
+    )
+    assert asm.bad_frames == 0
+
+
+def test_fleet_frame_rejects_wrong_type_and_version():
+    from deepflow_tpu.ingest.framing import FlowHeader, MessageType, encode_frame
+
+    f = FleetFrame(host="h", group="", epoch=0, seq=0, timestamp=0.0)
+    raw = encode_fleet_frame(f)
+    asm = FrameReassembler()
+    [(header, body)] = asm.feed(raw)
+    bad = FlowHeader(msg_type=int(MessageType.TAGGEDFLOW))
+    with pytest.raises(ValueError):
+        decode_fleet_frame(bad, body)
+    wrong_v = encode_frame(
+        FlowHeader(msg_type=int(MessageType.DFSTATS)),
+        [json.dumps({"v": 99}).encode()],
+    )
+    [(h2, b2)] = FrameReassembler().feed(wrong_v)
+    with pytest.raises(ValueError):
+        decode_fleet_frame(h2, b2)
+
+
+# ---------------------------------------------------------------------------
+# merge algebra
+
+
+def test_merge_hist_dumps_sums_bin_for_bin():
+    from deepflow_tpu.tracing.lineage import merge_hist_dumps
+
+    a = {"1s.e2e": [[1, 2], [5, 3]], "1s.store": [[0, 1]]}
+    b = {"1s.e2e": [[1, 1], [7, 4]]}
+    got = merge_hist_dumps(a, b)
+    assert got == {
+        "1s.e2e": [[1, 3], [5, 3], [7, 4]],
+        "1s.store": [[0, 1]],
+    }
+    # identity + associativity on the empty dump
+    assert merge_hist_dumps(a) == merge_hist_dumps(a, {})
+
+
+def test_worst_state_rollup():
+    from deepflow_tpu.querier.alerts import (
+        STATE_FIRING,
+        STATE_INACTIVE,
+        STATE_PENDING,
+        STATE_RESOLVED,
+        worst_state,
+    )
+
+    assert worst_state([]) == STATE_INACTIVE
+    assert worst_state([STATE_INACTIVE, STATE_RESOLVED]) == STATE_RESOLVED
+    assert worst_state([STATE_PENDING, STATE_RESOLVED]) == STATE_PENDING
+    assert worst_state(
+        [STATE_INACTIVE, STATE_FIRING, STATE_PENDING]
+    ) == STATE_FIRING
+    # unknown states rank below inactive, never raise
+    assert worst_state(["???", STATE_PENDING]) == STATE_PENDING
+
+
+def test_span_tracer_hist_dump_matches_freshness_shape():
+    from deepflow_tpu.tracing.lineage import merge_hist_dumps
+    from deepflow_tpu.utils.spans import SpanTracer
+
+    tr = SpanTracer()
+    for us in (10, 10, 5000):
+        tr.record("fold", us)
+    tr.record("drain", 77)
+    dump = tr.hist_dump()
+    assert set(dump) == {"fold", "drain"}
+    assert sum(c for _b, c in dump["fold"]) == 3
+    assert all(c > 0 for lane in dump.values() for _b, c in lane)
+    # the dump merges with itself through the same fleet algebra
+    doubled = merge_hist_dumps(dump, dump)
+    assert sum(c for _b, c in doubled["fold"]) == 6
+
+
+# ---------------------------------------------------------------------------
+# exporter
+
+
+def test_exporter_builds_guarded_faces():
+    col = StatsCollector()
+
+    class Swm:
+        def get_counters(self):
+            return {"flow_in": 11}
+
+    swm = Swm()
+    col.register("tpu_mesh_swm", swm, group="0")
+
+    class BrokenFace:
+        def hist_dump(self):
+            raise RuntimeError("boom")
+
+    class GoodFace:
+        def hist_dump(self):
+            return {"1s.e2e": [[2, 9]]}
+
+    exp = FleetExporter(
+        "hostA", group="0", epoch=2, collector=col,
+        hist_faces={"bad": BrokenFace(), "g0": GoodFace()},
+        clock=lambda: 500.0,
+    )
+    f1 = exp.build()
+    f2 = exp.build()
+    assert f1.host == "hostA" and f1.epoch == 2
+    assert (f1.seq, f2.seq) == (0, 1)
+    assert f1.hists == {"g0": {"1s.e2e": [[2, 9]]}}  # broken face skipped
+    assert exp.get_counters()["face_errors"] >= 2
+    [pt] = [p for p in f1.points if p[1] == "tpu_mesh_swm"]
+    assert pt[3] == {"flow_in": 11}
+
+
+# ---------------------------------------------------------------------------
+# aggregator: merge + staleness (injected clock)
+
+
+def _frame(host, group, t, fields, hist_pairs, *, seq=0, state="inactive"):
+    return FleetFrame(
+        host=host, group=group, epoch=0, seq=seq, timestamp=float(t),
+        points=((float(t), "tpu_mesh_swm", {"group": group}, dict(fields)),),
+        hists={f"g{group}": {"1s.e2e": [list(p) for p in hist_pairs]}},
+        alerts=({"name": "lag", "state": state, "value": 1.0,
+                 "transitions": 0},),
+    )
+
+
+def test_aggregator_merges_and_expires_staleness_counted():
+    clock = {"t": 1000.0}
+    agg = FleetAggregator(
+        expiry_s=30.0, clock=lambda: clock["t"], autoregister=False
+    )
+    agg.ingest(_frame("h0", "0", 1000, {"flow_in": 10}, [[1, 2]]))
+    agg.ingest(_frame("h1", "0", 1000, {"flow_in": 32}, [[1, 1], [4, 5]],
+                      state="firing"))
+    both = agg.merged_counters()
+    assert both == {"tpu_mesh_swm{group=0}.flow_in": 42}
+    assert isinstance(both["tpu_mesh_swm{group=0}.flow_in"], int)  # bit-exact
+    assert agg.merged_hists() == {"g0.1s.e2e": [[1, 3], [4, 5]]}
+    [rule] = agg.merged_alerts()
+    assert rule["state"] == "firing"  # one firing host fires the fleet
+
+    # h1 goes quiet past expiry_s: EXPIRED from merges, counted, stamped
+    clock["t"] = 1020.0
+    agg.ingest(_frame("h0", "0", 1020, {"flow_in": 15}, [[1, 3]], seq=1))
+    clock["t"] = 1045.0
+    only_h0 = agg.merged_counters()
+    assert only_h0 == {"tpu_mesh_swm{group=0}.flow_in": 15}
+    assert agg.merged_hists() == {"g0.1s.e2e": [[1, 3]]}
+    [rule] = agg.merged_alerts()
+    assert rule["state"] == "inactive"  # the firing host is gone, loudly
+    c = agg.get_counters()
+    assert c["hosts_expired"] == 1
+    assert c["stale_drops"] >= 3  # each read that withheld h1 counted
+    roster = {r["host"]: r for r in agg.hosts()}
+    assert roster["h1"]["stale"] is True
+    assert roster["h1"]["last_seen"] == 1000.0  # stamp retained
+    assert roster["h0"]["stale"] is False
+
+    # a new frame RECOVERS the host (counted) and it rejoins the merge
+    agg.ingest(_frame("h1", "0", 1045, {"flow_in": 40}, [[4, 6]], seq=1))
+    assert agg.merged_counters() == {"tpu_mesh_swm{group=0}.flow_in": 55}
+    assert agg.get_counters()["hosts_recovered"] == 1
+
+
+def test_aggregator_skew_surfaces():
+    clock = {"t": 2000.0}
+    agg = FleetAggregator(
+        expiry_s=300.0, clock=lambda: clock["t"], autoregister=False
+    )
+
+    def freshness_frame(host, lag_ms, hbm_bytes, t, flow_in, seq):
+        return FleetFrame(
+            host=host, group="0", epoch=0, seq=seq, timestamp=float(t),
+            points=(
+                (float(t), "tpu_freshness", {"tier": "1s"},
+                 {"e2e_lag_ms": lag_ms}),
+                (float(t), "tpu_mesh_swm", {"group": "0"},
+                 {"flow_in": flow_in}),
+            ),
+            hbm=({"module": "w", "bytes": hbm_bytes},),
+        )
+
+    # two frames per host so the rate lane has a delta
+    agg.ingest(freshness_frame("h0", 5.0, 100, 2000, 0, 0))
+    agg.ingest(freshness_frame("h1", 25.0, 400, 2000, 0, 0))
+    agg.ingest(freshness_frame("h0", 5.0, 100, 2010, 100, 1))
+    agg.ingest(freshness_frame("h1", 25.0, 400, 2010, 300, 1))
+    sk = agg.skew()
+    assert sk["hosts"] == 2
+    assert sk["freshness_lag_skew_ms"] == 20.0
+    assert sk["hbm_imbalance_bytes"] == 300
+    assert sk["per_host_hbm_bytes"] == {"h0": 100, "h1": 400}
+    # one group summed across hosts: (100+300)/10s = 40/s, no divergence
+    assert sk["per_group_rate"] == {"0": 40.0}
+    # the Countable face carries the same gauges
+    c = agg.get_counters()
+    assert c["freshness_lag_skew_ms"] == 20.0
+    assert c["hbm_imbalance_bytes"] == 300
+
+
+# ---------------------------------------------------------------------------
+# one queryable pane: store rows through the EXISTING SQL/PromQL planes
+
+
+def test_fleet_store_rows_query_with_host_labels():
+    from deepflow_tpu.querier import QueryEngine
+    from deepflow_tpu.querier.promql import query_instant
+    from deepflow_tpu.storage.store import ColumnarStore
+
+    store = ColumnarStore("")
+    agg = FleetAggregator(store=store, autoregister=False,
+                          clock=lambda: 1000.0)
+    agg.ingest(_frame("h0", "0", 1000, {"flow_in": 10}, [[1, 2]]))
+    agg.ingest(_frame("h1", "1", 1000, {"flow_in": 32}, [[1, 1]]))
+    assert agg.counters["store_rows"] == 2
+    # PromQL with a host label selector — the label plane is unchanged
+    out = query_instant(
+        store, 'tpu_mesh_swm_flow_in{host="h1"}', 1000,
+        db="deepflow_system", table="deepflow_system",
+    )
+    assert [s["value"] for s in out] == [32.0]
+    assert out[0]["labels"]["group"] == "1"
+    both = query_instant(store, "tpu_mesh_swm_flow_in", 1000,
+                         db="deepflow_system", table="deepflow_system")
+    assert sorted(s["labels"]["host"] for s in both) == ["h0", "h1"]
+    # SQL over the same table
+    r = QueryEngine(store).execute(
+        "SELECT metric, value FROM deepflow_system.deepflow_system"
+    )
+    rows = r.to_dicts()
+    assert sorted(float(x["value"]) for x in rows) == [10.0, 32.0]
+
+
+# ---------------------------------------------------------------------------
+# REST + dfctl
+
+
+class _StubServer:
+    def __init__(self, fleet):
+        self.fleet = fleet
+
+
+@pytest.fixture()
+def rest_with_fleet():
+    from deepflow_tpu.controller.rest import RestServer
+
+    agg = FleetAggregator(expiry_s=300.0, autoregister=False,
+                          clock=lambda: 1000.0)
+    agg.ingest(_frame("h0", "0", 1000, {"flow_in": 10}, [[1, 2]]))
+    rest = RestServer(_StubServer(agg))
+    yield rest, agg
+    rest.stop()
+
+
+def test_rest_fleet_endpoints(rest_with_fleet):
+    rest, _agg = rest_with_fleet
+
+    def get(path):
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{rest.port}{path}"
+        ) as r:
+            return json.loads(r.read())
+
+    health = get("/v1/fleet/health")
+    assert health["status"] == "ok" and health["hosts"] == 1
+    [host] = get("/v1/fleet/hosts")
+    assert host["host"] == "h0" and host["stale"] is False
+    skew = get("/v1/fleet/skew")
+    assert skew["hosts"] == 1
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        get("/v1/fleet/nope")
+    assert ei.value.code == 404
+
+
+def test_rest_fleet_404_when_disabled():
+    from deepflow_tpu.controller.rest import RestServer
+
+    rest = RestServer(_StubServer(None))
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{rest.port}/v1/fleet/health"
+            )
+        assert ei.value.code == 404
+    finally:
+        rest.stop()
+
+
+def test_dfctl_fleet_json_and_tables(rest_with_fleet, capsys):
+    from deepflow_tpu.cli import main
+
+    rest, _agg = rest_with_fleet
+    main(["fleet", "--port", str(rest.port), "health", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert out["hosts"] == 1  # machine shape parses
+    main(["fleet", "--port", str(rest.port), "hosts"])
+    human = capsys.readouterr().out
+    assert "host" in human and "h0" in human and "{" not in human.split("\n")[0]
+
+
+def test_dfctl_profile_json(capsys):
+    from deepflow_tpu.cli import main
+    from deepflow_tpu.controller.rest import RestServer
+
+    rest = RestServer(_StubServer(None))
+    try:
+        main(["profile", "--port", str(rest.port), "device",
+              "--no-analyze", "--json"])
+        out = json.loads(capsys.readouterr().out)
+        assert "hbm" in out and "census" in out  # machine shape parses
+        main(["profile", "--port", str(rest.port), "device", "--no-analyze"])
+        human = capsys.readouterr().out
+        assert "# hbm ledger" in human
+    finally:
+        rest.stop()
+
+
+# ---------------------------------------------------------------------------
+# config + server wiring
+
+
+def test_fleet_config_overlay_and_validation():
+    from deepflow_tpu.utils.config import ConfigError, load_config
+
+    cfg, unknown = load_config(
+        {"fleet": {"enabled": True, "listen_port": 9999, "expiry_s": 5.0}}
+    )
+    assert unknown == []
+    assert cfg.fleet.enabled and cfg.fleet.listen_port == 9999
+    assert cfg.fleet.expiry_s == 5.0
+    with pytest.raises(ConfigError):
+        load_config({"fleet": {"expiry_s": 0}})
+
+
+def test_server_boots_fleet_plane(tmp_path):
+    from deepflow_tpu.server.main import Server
+    from deepflow_tpu.utils.config import load_config
+
+    cfg, _ = load_config({
+        "receiver": {"tcp_port": 0, "udp_port": 0},
+        "fleet": {"enabled": True, "listen_port": 0, "expiry_s": 120.0},
+    })
+    srv = Server(cfg).start()
+    try:
+        assert srv.fleet is not None
+        host, port = srv.fleet.endpoint()
+        assert port > 0
+        # a real host-side sink delivers into the server's store
+        col = StatsCollector()
+
+        class C:
+            def get_counters(self):
+                return {"flow_in": 9}
+
+        c = C()
+        col.register("tpu_mesh_swm", c, group="0")
+        exp = FleetExporter("hX", group="0", collector=col,
+                            clock=lambda: 1000.0)
+        sink = FleetSink((host, port), exp)
+        try:
+            col.add_sink(sink)
+            col.tick(1000.0)
+            assert sink.flush(10)
+            deadline = time.time() + 5
+            while (srv.fleet.counters["frames_rx"] < 1
+                   and time.time() < deadline):
+                time.sleep(0.01)
+            assert srv.fleet.counters["frames_rx"] >= 1
+            # one pane: the SERVER's PromQL plane sees the host's counter
+            from deepflow_tpu.querier.promql import query_instant
+
+            out = query_instant(
+                srv.store, 'tpu_mesh_swm_flow_in{host="hX"}', 1000,
+                db="deepflow_system", table="deepflow_system",
+            )
+            assert [s["value"] for s in out] == [9.0]
+            # REST serves the fleet pane off the live server
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.rest.port}/v1/fleet/health"
+            ) as r:
+                assert json.loads(r.read())["hosts"] == 1
+        finally:
+            sink.close()
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# tentpole pin: the REAL 2-process mesh, frames replayed over real TCP
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _prewarm():
+    mh.prewarm_async()
+
+
+def _replay_host_frames(agg, frames_by_host):
+    """Ship each host's raw frames through a REAL HandoffSender (the
+    exact transport FleetSink uses) into the aggregator's listener."""
+    from deepflow_tpu.ingest.handoff import HandoffSender
+
+    total = sum(len(v) for v in frames_by_host.values())
+    for _host, frames in sorted(frames_by_host.items()):
+        sender = HandoffSender({AGGREGATOR_PEER: agg.endpoint()})
+        try:
+            for hexframe in frames:
+                sender.send(AGGREGATOR_PEER, bytes.fromhex(hexframe))
+            assert sender.flush(30)
+        finally:
+            sender.close()
+    deadline = time.time() + 30
+    while agg.counters["frames_rx"] < total and time.time() < deadline:
+        time.sleep(0.01)
+    assert agg.counters["frames_rx"] == total, agg.counters
+
+
+def _oracle_from_results(results):
+    """The per-host-dump oracle: counters summed per group, hist dumps
+    merged via the r12/r16 algebra — straight from `results()`, which
+    reads the SAME faces the subprocess froze into its fleet frames."""
+    from deepflow_tpu.tracing.lineage import merge_hist_dumps
+
+    counters: dict[str, int] = {}
+    dumps = []
+    for res in results:
+        for g, rec in res["groups"].items():
+            if rec.get("released"):
+                continue
+            for k, v in rec["counters"].items():
+                key = f"tpu_mesh_swm{{group={g}}}.{k}"
+                counters[key] = counters.get(key, 0) + int(v)
+            dumps.append(
+                {f"g{g}.{lane}": pairs
+                 for lane, pairs in rec["fresh_hist"].items()}
+            )
+    return counters, merge_hist_dumps(*dumps)
+
+
+def test_mesh2_fleet_merge_bitexact_vs_per_host_dump_oracle():
+    procs = mh.mesh2_result()
+    assert len(procs) == 2
+    agg = FleetAggregator(expiry_s=3600.0, autoregister=False,
+                          clock=time.time)
+    agg.start()
+    try:
+        _replay_host_frames(
+            agg, {f"host{i}": res["fleet_frames"]
+                  for i, res in enumerate(procs)}
+        )
+        want_counters, want_hists = _oracle_from_results(procs)
+        assert agg.merged_counters() == want_counters
+        assert agg.merged_hists() == want_hists
+        assert agg.counters["decode_errors"] == 0
+        assert agg.counters["bad_frames"] == 0
+        # both hosts on the roster, every shard group covered
+        roster = agg.hosts()
+        assert sorted(r["host"] for r in roster) == ["host0", "host1"]
+        groups = {g for r in roster for g in r["groups"]}
+        assert len(groups) == mh.N_GROUPS
+    finally:
+        agg.stop()
+
+
+def test_mesh2_kill_fleet_staleness_counted_expiry():
+    """The dead host's LAST frames merge while fresh; once expired the
+    merged views equal the survivor-only oracle, the expiry is COUNTED,
+    and the last-seen stamp still serves — no silent stale reads."""
+    kill = mh.mesh2_kill_result()
+    p0, p1 = kill["p0"], kill["p1_gen1"]
+    clock = {"t": 5000.0}
+    agg = FleetAggregator(expiry_s=60.0, autoregister=False,
+                          clock=lambda: clock["t"])
+    agg.start()
+    try:
+        _replay_host_frames(
+            agg, {"host0": p0["fleet_frames"], "host1": p1["fleet_frames"]}
+        )
+        # both live: merged == both-host oracle (the dead host's faces
+        # at its kill point are exactly what its frames froze)
+        want_counters, want_hists = _oracle_from_results([p0, p1])
+        assert agg.merged_counters() == want_counters
+        assert agg.merged_hists() == want_hists
+
+        # host1 dies (no more frames); the clock passes expiry_s while
+        # the survivor keeps ticking — re-deliver host0's (cumulative,
+        # idempotent) frames at the new time so only host1 goes stale
+        clock["t"] = 5100.0
+        asm = FrameReassembler()
+        for hexframe in p0["fleet_frames"]:
+            for header, body in asm.feed(bytes.fromhex(hexframe)):
+                agg.ingest(decode_fleet_frame(header, body))
+        want_counters0, want_hists0 = _oracle_from_results([p0])
+        assert agg.merged_counters() == want_counters0
+        assert agg.merged_hists() == want_hists0
+        c = agg.get_counters()
+        assert c["hosts_expired"] == 1
+        assert c["stale_drops"] >= 2  # each withholding read counted
+        roster = {r["host"]: r for r in agg.hosts()}
+        assert roster["host1"]["stale"] is True
+        assert roster["host1"]["last_seen"] == 5000.0  # stamp retained
+        assert roster["host0"]["stale"] is False
+        assert agg.health()["stale"] == 1
+    finally:
+        agg.stop()

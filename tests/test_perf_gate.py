@@ -1353,3 +1353,130 @@ def test_rebalance_budget():
     # its own receiver never misroutes and nothing rotted in the hold
     assert r["p0"]["receiver"]["frames_misrouted"] == 0
     assert r["p0"]["receiver"]["frames_held_dropped"] == 0
+
+
+def test_fleet_export_budget(monkeypatch):
+    """ISSUE 18 gate: the fleet wire sink is HOST-SIDE ONLY — a
+    §14-shaped feeder run with the pipeline registered on a collector
+    whose tick drives a live FleetSink → FleetAggregator TCP loop every
+    batch spends EXACTLY the same ingest-attributable host fetches as
+    the passive twin, produces a bit-identical flushed stream, and
+    never retraces the fused step. Frame assembly + encode + send all
+    read already-maintained host state (the r14/r16 gate convention)."""
+    import deepflow_tpu.aggregator.window as window_mod
+    from deepflow_tpu.aggregator.pipeline import L4Pipeline, PipelineConfig
+    from deepflow_tpu.aggregator.window import WindowConfig
+    from deepflow_tpu.feeder import (
+        FeederConfig,
+        FeederRuntime,
+        PipelineFeedSink,
+        encode_flowbatch_frames,
+    )
+    from deepflow_tpu.fleet import FleetAggregator, FleetExporter, FleetSink
+    from deepflow_tpu.ingest.queues import PyOverwriteQueue
+    from deepflow_tpu.tracing.lineage import FreshnessTracker
+    from deepflow_tpu.utils.stats import StatsCollector
+
+    counts = {"n": 0}
+    real_fetch = window_mod.host_fetch
+
+    def counting_fetch(x):
+        counts["n"] += 1
+        return real_fetch(x)
+
+    monkeypatch.setattr(window_mod, "host_fetch", counting_fetch)
+
+    def build(name):
+        pipe = L4Pipeline(PipelineConfig(
+            window=WindowConfig(capacity=1 << 12, stats_ring=4),
+            batch_size=256, bucket_sizes=(64, 128, 256),
+        ))
+        q = PyOverwriteQueue(1 << 10)
+        feeder = FeederRuntime(
+            [q], PipelineFeedSink(pipe), FeederConfig(frames_per_queue=8),
+            name=name,
+        )
+        return pipe, q, feeder
+
+    pipe_b, q_b, feeder_b = build("fleet_base")
+    pipe_t, q_t, feeder_t = build("fleet_traced")
+
+    # the instrumented twin's full export loop: pipeline + freshness
+    # registered on a PRIVATE collector, ticked every batch into a
+    # FleetSink wired to a real aggregator listener over TCP
+    agg = FleetAggregator(expiry_s=3600.0, autoregister=False)
+    agg.start()
+    col = StatsCollector()
+    fresh = FreshnessTracker(autoregister=False)
+    col.register("tpu_pipeline", pipe_t, group="0")
+    exporter = FleetExporter(
+        "gate-host", group="0", collector=col,
+        hist_faces={"fresh": fresh},
+    )
+    sink = FleetSink(agg.endpoint(), exporter)
+    col.add_sink(sink)
+
+    gen_a = SyntheticFlowGen(num_tuples=200, seed=47)
+    gen_b = SyntheticFlowGen(num_tuples=200, seed=47)
+    t0 = 1_700_000_000
+
+    def feed(gen, q, feeder, t):
+        fb = gen.flow_batch(128, t)
+        for fr in encode_flowbatch_frames(fb, max_rows_per_frame=64):
+            q.put(fr)
+        return feeder.pump()
+
+    try:
+        for t in (t0, t0 + 1):  # warmup outside the measurement
+            feed(gen_b, q_b, feeder_b, t)
+            feed(gen_a, q_t, feeder_t, t)
+
+        B = 16
+        fetches = {"base": 0, "traced": 0}
+        out = {"base": [], "traced": []}
+        for i in range(B):
+            t = t0 + 2 + i // 4
+            before = counts["n"]
+            out["base"] += [
+                d.tags.tobytes() for d in feed(gen_b, q_b, feeder_b, t)
+            ]
+            fetches["base"] += counts["n"] - before
+            before = counts["n"]
+            out["traced"] += [
+                d.tags.tobytes() for d in feed(gen_a, q_t, feeder_t, t)
+            ]
+            fetches["traced"] += counts["n"] - before
+            # the export tick: sample the pipeline face, build + encode
+            # + queue one wire frame — ZERO device fetches
+            before = counts["n"]
+            col.tick(float(t))
+            assert counts["n"] == before, "fleet export performed a fetch"
+        before = counts["n"]
+        out["base"] += [d.tags.tobytes() for d in feeder_b.flush()]
+        fetches["base"] += counts["n"] - before
+        before = counts["n"]
+        out["traced"] += [d.tags.tobytes() for d in feeder_t.flush()]
+        fetches["traced"] += counts["n"] - before
+
+        # THE acceptance: fetch parity with the fleet sink live,
+        # bit-identical stream, zero fused-step retraces
+        assert fetches["traced"] == fetches["base"], fetches
+        assert out["traced"] == out["base"]
+        for pipe in (pipe_b, pipe_t):
+            assert pipe.get_counters()["jit_retraces"] == 0
+        assert col.n_source_errors == 0 and col.n_sink_errors == 0
+
+        # the loop really exported: every tick shipped one frame and
+        # the aggregator merged the pipeline's counters fleet-side
+        assert sink.flush(30)
+        sc = sink.get_counters()
+        assert sc["frames_sent"] == B and sc["send_errors"] == 0
+        deadline = time.time() + 30
+        while agg.counters["frames_rx"] < B and time.time() < deadline:
+            time.sleep(0.01)
+        assert agg.counters["frames_rx"] == B
+        merged = agg.merged_counters()
+        assert any(k.startswith("tpu_pipeline{") for k in merged), merged
+    finally:
+        sink.close()
+        agg.stop()

@@ -481,3 +481,81 @@ def test_system_table_labels_not_truncated():
     assert len(out) == 1
     assert out[0]["labels"]["big"] == long_val
     assert out[0]["value"] == 1.0
+
+
+def test_concurrent_sample_pulls_race_tick_thread():
+    """ISSUE 18 satellite: the fleet sink makes pull-path `sample()` a
+    SECOND consumer of the same counter faces the tick thread reads.
+    Hammer both concurrently over healthy, flapping, and broken
+    sources: no exception escapes, per-source failure/recovery
+    bookkeeping stays consistent (the per-source lock — unlocked
+    check-then-act would double-count recoveries or lose failure
+    counts), backoff still advances ONLY on ticks, and a healthy
+    source's fields are never dropped from a tick snapshot."""
+    import threading
+
+    col = StatsCollector()
+    calls = {"healthy": 0, "flaky": 0}
+    flaky_fail = {"on": False}
+
+    def healthy():
+        calls["healthy"] += 1
+        return {"v": calls["healthy"]}
+
+    def flaky():
+        calls["flaky"] += 1
+        if flaky_fail["on"]:
+            raise RuntimeError("flap")
+        return {"v": 1}
+
+    def broken():
+        raise RuntimeError("always")
+
+    col.register("healthy", healthy)
+    flaky_src = col.register("flaky", flaky)
+    broken_src = col.register("broken", broken)
+
+    stop = threading.Event()
+    errors: list[BaseException] = []
+
+    def puller():
+        # the fleet exporter's consumption shape: bare sample() pulls
+        while not stop.is_set():
+            try:
+                pts = col.sample(1000.0)
+                mods = [p.module for p in pts]
+                assert "healthy" in mods
+            except BaseException as e:  # pragma: no cover
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=puller) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for i in range(60):
+            # flap the flaky source on and off while ticks race pulls
+            flaky_fail["on"] = (i // 10) % 2 == 1
+            pts = col.tick(1000.0 + i)
+            assert any(p.module == "healthy" for p in pts)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+    assert not errors, errors
+
+    # bookkeeping consistency under the race: counts are sane (no
+    # negative/garbled state), the broken source sits in backoff with
+    # a bounded cooldown, and the flaky source ended recovered
+    assert col.n_source_errors >= 3  # broken alone guarantees this
+    assert 0 <= broken_src.cooldown <= col.MAX_BACKOFF_TICKS
+    assert broken_src.failures >= col.MAX_SOURCE_FAILURES
+    assert broken_src.suppressed
+    flaky_fail["on"] = False
+    for i in range(col.MAX_BACKOFF_TICKS + 1):
+        col.tick(2000.0 + i)
+    assert flaky_src.failures == 0 and not flaky_src.suppressed
+    # recoveries never exceed the number of suppression entries — a
+    # double-counted recovery is exactly what the per-source lock
+    # prevents
+    assert col.n_source_recoveries <= col.n_source_errors
