@@ -765,6 +765,43 @@ def config18(quick: bool):
          passive=rec["passive"], iters=rec["iters"])
 
 
+def config19(quick: bool):
+    """Wire delivery plane (ISSUE 19): bench/wirebench.py fans merged
+    eval envelopes from H socketed host publishers through the
+    FleetSubscriptionRouter to W wire clients over a watchers × rules ×
+    hosts grid (protocol: PERF.md §27; acceptance: publish→all-watchers
+    latency FLAT in W — ONE upstream eval per event batch per query,
+    fan-out is W bounded-queue appends — with per-host rows pinned
+    bit-exact vs each host's own evaluation). The headline value is the
+    largest cell's deliveries/s; the vs line is the worst
+    max-W-over-W=1 latency ratio (1.0 == perfectly flat)."""
+    import os
+    import subprocess
+
+    env = {**os.environ}
+    if quick:
+        env.update(WIREBENCH_EVENTS="8", WIREBENCH_WATCHERS="1,10",
+                   WIREBENCH_HOSTS="1", WIREBENCH_RULES="0")
+    out = subprocess.run(
+        [sys.executable, "bench/wirebench.py"],
+        capture_output=True, text=True, timeout=1800, env=env,
+    )
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    if rec.get("partial"):
+        emit("c19_wire_fanout", 0, "error", 0, error=rec.get("error"))
+        return
+    big = max(rec["rows"], key=lambda r: r["watchers"] * r["hosts"])
+    emit("c19_wire_fanout", big["deliveries_per_s"], "deliveries/s",
+         max(rec["latency_ratio_wmax_over_w1"].values()),
+         latency_ratio_wmax_over_w1=rec["latency_ratio_wmax_over_w1"],
+         publish_to_all_watchers_ms_mean=big[
+             "publish_to_all_watchers_ms_mean"],
+         pinned_bit_exact=all(r["pinned_bit_exact"] for r in rec["rows"]),
+         drops=sum(r["drops"] for r in rec["rows"]),
+         upstream_subs=max(r["upstream_subs"] for r in rec["rows"]),
+         rows=rec["rows"])
+
+
 def main():
     from deepflow_tpu.utils.provenance import bench_provenance
 
@@ -778,7 +815,8 @@ def main():
     print(json.dumps({"provenance": prov}), flush=True)
     for fn in (config1, config2, config3, config4, config5, config6, config7,
                config8, config9, config10, config11, config12, config13,
-               config14, config15, config16, config17, config18):
+               config14, config15, config16, config17, config18,
+               config19):
         try:
             fn(args.quick)
         except Exception as e:  # one config must not kill the others

@@ -69,6 +69,7 @@ SITE_CHECKPOINT_IO = "checkpoint.io"  # window-state snapshot write
 SITE_JOURNAL_IO = "journal.io"  # frame-journal append/rotate
 SITE_HANDOFF_SEND = "handoff.send"  # misroute-handoff transport write
 SITE_REBALANCE_STEP = "rebalance.step"  # shard-group handover protocol step
+SITE_WIRE_SEND = "wire.send"  # DFPUSH publisher result/alert upload write
 
 FAULT_SITES = (
     SITE_DISPATCH,
@@ -78,6 +79,7 @@ FAULT_SITES = (
     SITE_CHECKPOINT_IO,
     SITE_JOURNAL_IO,
     SITE_HANDOFF_SEND,
+    SITE_WIRE_SEND,
     SITE_REBALANCE_STEP,
 )
 

@@ -28,6 +28,13 @@ Commands mirror the reference CLI surface that applies to this build:
                                          roster + staleness, skew
                                          surfaces (--json for machine
                                          output)
+  dfctl watch --port P QUERY             wire delivery lane (ISSUE 19):
+                                         stream push-plane results over
+                                         GET /v1/watch as they arrive
+                                         (--sql for SQL, --alerts for
+                                         the notification topic, --json
+                                         for raw events; reconnects
+                                         with capped backoff)
   dfctl agent-group --port P ...         trisolaris group config/upgrade
   dfctl plugin --dir D list              L7 protocol plugin inventory
   dfctl trace --port P TRACE_ID          assembled trace tree (REST)
@@ -247,6 +254,109 @@ def cmd_fleet(args):
         print(_render_kv(out))
 
 
+def _watch_render(event) -> list[str]:
+    """Human lines for one wire event: one line per series row for
+    local PromQL payloads, merged per-host rows for fleet envelopes,
+    a one-liner for alerts/staleness — anything else prints compact."""
+    if isinstance(event, list):  # local promql lane: list of series
+        out = []
+        for s in event:
+            if not isinstance(s, dict):
+                out.append(json.dumps(s, default=str))
+                continue
+            metric = s.get("metric", {})
+            values = s.get("values", [])
+            t, v = (values[-1] if values else ("-", "-"))
+            flag = " partial" if s.get("partial") else ""
+            out.append(f"{json.dumps(metric, sort_keys=True)} t={t} v={v}{flag}")
+        return out or ["(empty result)"]
+    if isinstance(event, dict):
+        kind = event.get("type") or ("alert" if "rule" in event else None)
+        if kind == "result":  # fleet merged envelope
+            out = []
+            for s in event.get("merged", []):
+                metric = s.get("metric", {}) if isinstance(s, dict) else {}
+                values = s.get("values", []) if isinstance(s, dict) else []
+                t, v = (values[-1] if values else ("-", "-"))
+                flag = " partial" if isinstance(s, dict) and s.get("partial") else ""
+                out.append(
+                    f"{json.dumps(metric, sort_keys=True)} t={t} v={v}{flag}"
+                )
+            stale = [
+                h for h, hs in event.get("hosts", {}).items() if hs.get("stale")
+            ]
+            if stale:
+                out.append(f"! stale hosts: {', '.join(sorted(stale))}")
+            return out or ["(empty merged result)"]
+        if kind == "staleness":
+            return [f"! host {event.get('host')} went stale"]
+        if kind == "alert" or "rule" in event:
+            return [
+                f"ALERT {event.get('state')} rule={event.get('rule')} "
+                f"value={event.get('value')} host={event.get('host', 'local')}"
+            ]
+    return [json.dumps(event, default=str)]
+
+
+def cmd_watch(args):
+    """Streaming client for the wire lane: connects to /v1/watch,
+    prints rows as they arrive, reconnects with capped backoff when
+    the stream drops (server restart, network blip) — a dashboard in
+    40 lines of stdlib."""
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    q: dict[str, str] = {}
+    if args.alerts:
+        q["alerts"] = "1"
+    elif args.sql:
+        q["sql"] = args.query
+    else:
+        q["promql"] = args.query
+    q["span_s"] = str(args.span)
+    q["step"] = str(args.step)
+    q["db"] = args.db
+    q["table"] = args.table
+    if args.scope:
+        q["scope"] = args.scope
+    if args.max_events:
+        q["max_events"] = str(args.max_events)
+    url = (f"http://{args.host}:{args.port}/v1/watch?"
+           + urllib.parse.urlencode(q))
+    backoff, seen = 0.5, 0
+    while True:
+        try:
+            with urllib.request.urlopen(url, timeout=args.timeout) as r:
+                backoff = 0.5  # a live stream resets the backoff
+                for raw in r:
+                    if not raw.startswith(b"data: "):
+                        continue  # heartbeat / comment lines
+                    event = json.loads(raw[6:])
+                    if args.json:
+                        print(json.dumps(event, separators=(",", ":"),
+                                         default=str), flush=True)
+                    else:
+                        for line in _watch_render(event):
+                            print(line, flush=True)
+                    seen += 1
+                    if args.max_events and seen >= args.max_events:
+                        return
+            if args.max_events and seen >= args.max_events:
+                return
+        except KeyboardInterrupt:
+            return
+        except urllib.error.HTTPError as e:
+            # 4xx = the query itself is bad — retrying won't fix it
+            sys.exit(f"watch: HTTP {e.code} {e.read().decode()}")
+        except (urllib.error.URLError, OSError, TimeoutError):
+            pass
+        print(f"# stream dropped; reconnecting in {backoff:.1f}s",
+              file=sys.stderr, flush=True)
+        time.sleep(backoff)
+        backoff = min(backoff * 2, args.max_backoff)
+
+
 def cmd_agent_group(args):
     """Trisolaris group management over the sync socket (line-JSON):
     the deepflow-ctl agent-group/agent-group-config seat."""
@@ -354,6 +464,35 @@ def main(argv=None):
     sp.add_argument("--json", action="store_true",
                     help="machine-readable output (compact JSON)")
     sp.set_defaults(fn=cmd_fleet)
+
+    sp = sub.add_parser("watch")
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--port", type=int, required=True)
+    sp.add_argument("query", nargs="?", default="",
+                    help="PromQL expression (or SQL with --sql); "
+                         "omit with --alerts")
+    sp.add_argument("--sql", action="store_true",
+                    help="treat QUERY as SQL instead of PromQL")
+    sp.add_argument("--alerts", action="store_true",
+                    help="stream alert notifications instead of a query")
+    sp.add_argument("--json", action="store_true",
+                    help="raw event JSON, one object per line")
+    sp.add_argument("--span", type=int, default=60,
+                    help="range span seconds (default 60)")
+    sp.add_argument("--step", type=int, default=1,
+                    help="range step seconds (default 1)")
+    sp.add_argument("--db", default="deepflow_system")
+    sp.add_argument("--table", default="deepflow_system")
+    sp.add_argument("--scope", default="",
+                    choices=["", "local", "fleet"],
+                    help="local store or fleet router (default auto)")
+    sp.add_argument("--max-events", type=int, default=0,
+                    help="exit after N events (0 = stream forever)")
+    sp.add_argument("--timeout", type=float, default=300.0,
+                    help="socket timeout seconds (default 300)")
+    sp.add_argument("--max-backoff", type=float, default=30.0,
+                    help="reconnect backoff cap seconds (default 30)")
+    sp.set_defaults(fn=cmd_watch)
 
     sp = sub.add_parser("agent-group")
     sp.add_argument("--host", default="127.0.0.1")

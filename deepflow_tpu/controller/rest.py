@@ -29,6 +29,14 @@ the composition root:
   GET    /v1/fleet/health                fleet fan-in status (ISSUE 18)
   GET    /v1/fleet/hosts                 per-host roster + staleness
   GET    /v1/fleet/skew                  cross-host imbalance surfaces
+  GET    /v1/watch?promql=|sql=|alerts=1 wire delivery lane (ISSUE 19):
+                                         SSE stream off the push plane,
+                                         one bounded watcher queue per
+                                         connection (?span_s=&step=&db=
+                                         &table=&scope=local|fleet&
+                                         maxlen=&lease_s=&max_events=)
+  GET    /v1/wire                        wire counters + live
+                                         per-connection rows
   GET    /v1/profile/stacks              all live thread stacks (pprof
                                          goroutine-dump analog)
   GET    /v1/profile/cpu?seconds=N       folded stack samples (pprof
@@ -289,6 +297,30 @@ class RestServer:
                 h._json(agg.skew())
             else:
                 h._json({"error": "not found"}, 404)
+        elif u.path == "/v1/watch":
+            # wire delivery lane (ISSUE 19): the hub owns the whole
+            # SSE exchange — headers, per-result writes, heartbeats,
+            # disconnect containment — on THIS handler thread
+            hub = getattr(df, "wire", None)
+            if hub is None:
+                h._json({"error": "wire plane not enabled"}, 404)
+            else:
+                hub.serve_sse(h, q)
+        elif u.path == "/v1/wire":
+            hub = getattr(df, "wire", None)
+            if hub is None:
+                h._json({"error": "wire plane not enabled"}, 404)
+            else:
+                out = {
+                    "counters": hub.get_counters(),
+                    "connections": hub.connections(),
+                }
+                router = getattr(hub, "router", None)
+                if router is not None:
+                    out["router"] = router.get_counters()
+                    out["router_hosts"] = router.hosts()
+                    out["router_entries"] = router.entries()
+                h._json(out)
         elif u.path == "/v1/profile/stacks":
             h._json(_thread_stacks())
         elif u.path == "/v1/profile/cpu":

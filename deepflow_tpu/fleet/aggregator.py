@@ -415,9 +415,26 @@ class FleetAggregator:
             lag = {}
             hbm = {}
             rates: dict[str, float] = {}
+            wire_drops: dict[str, int] = {}
+            wire_deliveries: dict[str, int] = {}
             for st in live:
                 worst = 0.0
+                wd = wdel = 0
                 for _ts, module, _tags, fields in st.points:
+                    if module.startswith("tpu_wire"):
+                        # slow-consumer imbalance (ISSUE 19 satellite):
+                        # every wire face — hub, router, publisher —
+                        # reports drop/delivery lanes; summing them per
+                        # host makes a host whose clients shed visible
+                        # fleet-wide next to the lag/HBM skew lanes
+                        for field, v in fields.items():
+                            if not isinstance(v, (int, float)):
+                                continue
+                            if field in ("drops", "open_dropped",
+                                         "shed_frames", "alerts_dropped"):
+                                wd += int(v)
+                            elif field in ("deliveries", "open_delivered"):
+                                wdel += int(v)
                     if "freshness" not in module:
                         continue
                     for field, v in fields.items():
@@ -426,6 +443,8 @@ class FleetAggregator:
                         ):
                             worst = max(worst, float(v))
                 lag[st.host] = worst
+                wire_drops[st.host] = wd
+                wire_deliveries[st.host] = wdel
                 hbm[st.host] = sum(int(r.get("bytes", 0)) for r in st.hbm)
                 for g, r in st.rates.items():
                     rates[g] = rates.get(g, 0.0) + r
@@ -443,6 +462,9 @@ class FleetAggregator:
             "per_host_hbm_bytes": hbm,
             "rate_divergence": round(spread(rates), 3),
             "per_group_rate": {g: round(r, 3) for g, r in rates.items()},
+            "wire_drop_skew": int(spread(wire_drops)),
+            "per_host_wire_drops": wire_drops,
+            "per_host_wire_deliveries": wire_deliveries,
         }
 
     def health(self, now: float | None = None) -> dict:
@@ -487,4 +509,5 @@ class FleetAggregator:
         out["freshness_lag_skew_ms"] = sk["freshness_lag_skew_ms"]
         out["hbm_imbalance_bytes"] = sk["hbm_imbalance_bytes"]
         out["rate_divergence"] = sk["rate_divergence"]
+        out["wire_drop_skew"] = sk["wire_drop_skew"]
         return out

@@ -52,6 +52,12 @@ class MessageType(enum.IntEnum):
     AGENT_LOG = 18
     SKYWALKING = 19
     DATADOG = 20
+    # DFPUSH is this build's extension (like ENCODER_DEFLATE below): the
+    # wire delivery plane's cross-host push lane — subscription results
+    # and alert notifications routed host → FleetSubscriptionRouter.
+    # The reference registry ends at DATADOG=20, so 21 is the first free
+    # id; the header ABI is unchanged.
+    DFPUSH = 21
 
 
 HEADER_VERSION = 0x8000

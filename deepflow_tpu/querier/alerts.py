@@ -340,6 +340,19 @@ def otlp_notification_sink(exporter, *, table: str = "l7_flow_log"):
     return sink
 
 
+def wire_notification_sink(hub):
+    """→ a sink fanning alert transitions to the wire hub's `alerts=1`
+    watchers (ISSUE 19) — the wire twin of otlp_notification_sink: a
+    firing rule reaches every connected `/v1/watch?alerts=1` stream
+    (and, through the hub's bus hook, any in-process AlertFired
+    consumer) without polling."""
+
+    def sink(event: dict) -> None:
+        hub.deliver_alert(dict(event))
+
+    return sink
+
+
 class _Sink:
     __slots__ = ("fn", "name", "failures", "detached")
 

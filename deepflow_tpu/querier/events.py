@@ -119,8 +119,25 @@ class ProfileSnapshot:
     time: int | None = None
 
 
+@dataclasses.dataclass(frozen=True)
+class AlertFired:
+    """An alert rule transitioned (ISSUE 19): published by the wire
+    hub's alert sink so in-process consumers can ride the same moment
+    remote dashboards see. Deliberately carries NO `db` attribute —
+    subscription/alert routing keys on (db, table), and an alert
+    transition must not re-trigger query evaluation (that way lies a
+    feedback loop: eval → alert → event → eval)."""
+
+    rule: str
+    state: str
+    value: float
+    labels: tuple = ()
+    time: int | None = None
+
+
 QUERY_EVENT_TYPES = (
-    WindowClosed, TierClosed, SnapshotAdvanced, StoreMutation, ProfileSnapshot
+    WindowClosed, TierClosed, SnapshotAdvanced, StoreMutation,
+    ProfileSnapshot, AlertFired,
 )
 
 

@@ -122,11 +122,17 @@ class Watcher:
         self.delivered += 1
         return True
 
-    def poll(self):
+    def poll(self, *, renew: bool = True):
         """Queue mode: pop the oldest pending result (None = empty).
-        Polling renews the lease — an actively-draining client is by
-        definition alive."""
-        self.renew()
+        Polling renews the lease by default — an actively-draining
+        client is by definition alive. The WIRE plane passes
+        `renew=False`: there the server-side delivery loop polls on the
+        client's behalf, so the pop itself proves nothing about the
+        client — only a successful socket write does, and the wire lane
+        calls `renew()` explicitly after one (a disconnected client's
+        lease must lapse even while the server keeps polling)."""
+        if renew:
+            self.renew()
         if self.queue is None or not self.queue:
             return None
         return self.queue.popleft()

@@ -235,6 +235,32 @@ class Server:
                 bus=self.event_bus,
                 expiry_s=cfg.fleet.expiry_s,
             ).start()
+        # wire delivery plane (ISSUE 19): the SSE lane off the REST
+        # server maps each /v1/watch connection onto a bounded watcher
+        # queue; the optional router turns this process into the fleet
+        # fan-out aggregator (pipeline hosts' WirePublishers dial in),
+        # and the optional TCP listener serves the framed variant
+        self.wire = None
+        self.wire_router = None
+        self.wire_tcp = None
+        if cfg.wire.enabled:
+            from ..wire import FleetSubscriptionRouter, WireHub, WireListener
+
+            if cfg.wire.router_enabled:
+                self.wire_router = FleetSubscriptionRouter(
+                    host=cfg.wire.router_host, port=cfg.wire.router_port,
+                ).start()
+            self.wire = WireHub(
+                self.subscriptions, alerts=self.alerts,
+                router=self.wire_router, bus=self.event_bus,
+                lease_s=cfg.wire.lease_s, maxlen=cfg.wire.queue_maxlen,
+                name="server",
+            )
+            if cfg.wire.tcp_enabled:
+                self.wire_tcp = WireListener(
+                    self.wire, host=cfg.wire.tcp_host,
+                    port=cfg.wire.tcp_port,
+                ).start()
         self.mcp = MCPServer(self)  # LLM tool surface (mcp.go seat)
         self.rest = RestServer(self)  # controller/querier REST + pprof seat
         if self.election:
@@ -267,6 +293,10 @@ class Server:
         # the tick as well as on event batches — a quiet store must not
         # keep dead clients' queues alive forever (ISSUE 12 satellite)
         self.subscriptions.reap()
+        # ...and the wire plane's own topics (alert watchers, fleet
+        # router entries, stream records) sweep on the same cadence
+        if self.wire is not None:
+            self.wire.reap()
         # this process IS the local analyzer — its liveness follows the
         # tick, every node (remote analyzers heartbeat via their own sync)
         self.balancer.heartbeat(self._analyzer_ip)
@@ -366,6 +396,15 @@ class Server:
         self.events.stop()
         self.trace_builder.stop()
         self.mcp.stop()
+        # wire teardown BEFORE rest.stop(): close() flips the hub's
+        # closing flag so in-flight SSE handler threads end their
+        # streams instead of spinning on heartbeats into dead sockets
+        if self.wire is not None:
+            self.wire.close()
+        if self.wire_tcp is not None:
+            self.wire_tcp.stop()
+        if self.wire_router is not None:
+            self.wire_router.stop()
         self.rest.stop()
         if self.fleet is not None:
             self.fleet.stop()

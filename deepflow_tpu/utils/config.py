@@ -87,6 +87,25 @@ class FleetConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class WireConfig:
+    """Wire delivery plane (deepflow_tpu/wire, ISSUE 19). The SSE lane
+    (`GET /v1/watch`) is always on when `enabled` — it rides the
+    existing RestServer. `tcp_*` gates the framed-TCP variant listener;
+    `router_*` gates the aggregator-side FleetSubscriptionRouter that
+    pipeline hosts' WirePublishers dial into."""
+
+    enabled: bool = True
+    lease_s: float = 30.0  # default watcher lease for wire clients
+    queue_maxlen: int = 64  # default per-client bounded queue
+    tcp_enabled: bool = False
+    tcp_host: str = "127.0.0.1"
+    tcp_port: int = 0  # 0 = ephemeral (tests); fixed in production
+    router_enabled: bool = False
+    router_host: str = "127.0.0.1"
+    router_port: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
 class ServerConfig:
     receiver: ReceiverConfig = ReceiverConfig()
     ingester: IngesterConfig = IngesterConfig()
@@ -94,6 +113,7 @@ class ServerConfig:
     aggregator: AggregatorConfig = AggregatorConfig()
     sketch: SketchConfig = SketchConfig()
     fleet: FleetConfig = FleetConfig()
+    wire: WireConfig = WireConfig()
     region_id: int = 0
     log_level: str = "info"
     # exporter sink specs (exporters/config seat): list of mappings,
@@ -146,6 +166,10 @@ def _validate(cfg: ServerConfig) -> None:
         (0 <= cfg.receiver.tcp_port <= 65535, "receiver.tcp_port out of range"),
         (cfg.fleet.expiry_s > 0, "fleet.expiry_s must be > 0"),
         (0 <= cfg.fleet.listen_port <= 65535, "fleet.listen_port out of range"),
+        (cfg.wire.lease_s > 0, "wire.lease_s must be > 0"),
+        (cfg.wire.queue_maxlen >= 1, "wire.queue_maxlen must be >= 1"),
+        (0 <= cfg.wire.tcp_port <= 65535, "wire.tcp_port out of range"),
+        (0 <= cfg.wire.router_port <= 65535, "wire.router_port out of range"),
     ]
     for ok, msg in checks:
         if not ok:

@@ -1480,3 +1480,206 @@ def test_fleet_export_budget(monkeypatch):
     finally:
         sink.close()
         agg.stop()
+
+
+def test_wire_fanout_budget(monkeypatch):
+    """ISSUE 19 gate: with 100 LIVE wire watchers (plus one real SSE
+    client streaming off the RestServer), ingest-attributable host
+    fetches are IDENTICAL to the passive baseline — wire fan-out is
+    queue pops off the ONE shared evaluation, never extra device (or
+    even store) reads — the flushed stream stays bit-identical, the
+    fused step never retraces, and the evaluation count equals EVENT
+    BATCHES, not watchers."""
+    import threading
+    import urllib.request
+    from types import SimpleNamespace
+
+    import deepflow_tpu.aggregator.window as window_mod
+    from deepflow_tpu.aggregator.pipeline import L4Pipeline, PipelineConfig
+    from deepflow_tpu.aggregator.window import WindowConfig
+    from deepflow_tpu.controller.rest import RestServer
+    from deepflow_tpu.feeder import (
+        FeederConfig,
+        FeederRuntime,
+        PipelineFeedSink,
+        encode_flowbatch_frames,
+    )
+    from deepflow_tpu.ingest.queues import PyOverwriteQueue
+    from deepflow_tpu.integration.dfstats import (
+        DEEPFLOW_SYSTEM_DB,
+        DEEPFLOW_SYSTEM_TABLE,
+        LIVE_METRIC_FLOW_BYTES,
+        PipelineLiveSource,
+        ensure_system_table,
+    )
+    from deepflow_tpu.querier.events import QueryEventBus, WindowClosed
+    from deepflow_tpu.querier.live import LiveRegistry, QueryResultCache
+    from deepflow_tpu.querier.promql import query_range
+    from deepflow_tpu.querier.subscribe import SubscriptionManager
+    from deepflow_tpu.storage.store import ColumnarStore
+    from deepflow_tpu.wire import WireHub
+
+    counts = {"n": 0}
+    real_fetch = window_mod.host_fetch
+
+    def counting_fetch(x):
+        counts["n"] += 1
+        return real_fetch(x)
+
+    monkeypatch.setattr(window_mod, "host_fetch", counting_fetch)
+
+    def build(name, bus):
+        pipe = L4Pipeline(PipelineConfig(
+            window=WindowConfig(capacity=1 << 12, stats_ring=4,
+                                min_snapshot_interval=3600.0),
+            batch_size=256, bucket_sizes=(64, 128, 256),
+        ))
+        q = PyOverwriteQueue(1 << 10)
+        feeder = FeederRuntime(
+            [q], PipelineFeedSink(pipe),
+            FeederConfig(frames_per_queue=8, snapshot_interval_pumps=4),
+            name=name, event_bus=bus,
+        )
+        return pipe, q, feeder
+
+    bus = QueryEventBus(name="wgate")
+    pipe_b, q_b, feeder_b = build("wgate_base", None)
+    pipe_w, q_w, feeder_w = build("wgate_wire", bus)
+
+    store = ColumnarStore()
+    ensure_system_table(store)
+    reg = LiveRegistry()
+    reg.register(DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE,
+                 PipelineLiveSource(pipe_w))
+    cache = QueryResultCache(max_entries=64)
+    cache.attach_bus(bus)
+    subs = SubscriptionManager(store, live=reg, cache=cache, bus=bus,
+                               name="wgate")
+    hub = WireHub(subs, name="wgate")
+    rest = RestServer(SimpleNamespace(wire=hub))
+
+    N = 100
+    SPAN, STEP = 8, 1
+    conns = [
+        hub.open_stream(promql=LIVE_METRIC_FLOW_BYTES, span_s=SPAN,
+                        step=STEP, db=DEEPFLOW_SYSTEM_DB,
+                        table=DEEPFLOW_SYSTEM_TABLE, maxlen=256)
+        for _ in range(N)
+    ]
+    # ...and one REAL streaming client, through the actual HTTP lane
+    sse_events: list = []
+
+    def sse():
+        url = (f"http://127.0.0.1:{rest.port}/v1/watch?"
+               f"promql={LIVE_METRIC_FLOW_BYTES}&span_s={SPAN}"
+               f"&db={DEEPFLOW_SYSTEM_DB}&table={DEEPFLOW_SYSTEM_TABLE}"
+               f"&heartbeat_s=0.2")
+        try:
+            with urllib.request.urlopen(url, timeout=60) as r:
+                for raw in r:
+                    if raw.startswith(b"data: "):
+                        sse_events.append(__import__("json").loads(raw[6:]))
+        except OSError:
+            pass
+
+    sse_thread = threading.Thread(target=sse, daemon=True)
+    sse_thread.start()
+    deadline = time.time() + 30
+    while (hub.get_counters()["connections_open"] < N + 1
+           and time.time() < deadline):
+        time.sleep(0.01)
+    assert hub.get_counters()["sse_connections"] == 1
+    # 101 watchers, ONE query → ONE subscription
+    assert len(subs.list_subscriptions()) == 1
+
+    table_batches = {"n": 0}
+    bus.subscribe(
+        lambda evs: table_batches.__setitem__(
+            "n", table_batches["n"] + int(any(
+                getattr(e, "table", None) == DEEPFLOW_SYSTEM_TABLE
+                for e in evs
+            ))
+        ),
+        name="counter",
+    )
+
+    from deepflow_tpu.ingest.replay import SyntheticFlowGen
+
+    gen_a = SyntheticFlowGen(num_tuples=200, seed=43)
+    gen_b = SyntheticFlowGen(num_tuples=200, seed=43)
+    t0 = 1_700_000_000
+
+    def feed(gen, q, feeder, t):
+        fb = gen.flow_batch(128, t)
+        for fr in encode_flowbatch_frames(fb, max_rows_per_frame=64):
+            q.put(fr)
+        return feeder.pump()
+
+    for t in (t0, t0 + 1):
+        feed(gen_b, q_b, feeder_b, t)
+        feed(gen_a, q_w, feeder_w, t)
+    pipe_b.snapshot_open(force=True)
+    pipe_w.snapshot_open(force=True)
+
+    B = 16
+    fetches = {"base": 0, "wire": 0}
+    out = {"base": [], "wire": []}
+    for i in range(B):
+        t = t0 + 2 + i // 4
+        before = counts["n"]
+        out["base"] += [d.tags.tobytes() for d in feed(gen_b, q_b, feeder_b, t)]
+        fetches["base"] += counts["n"] - before
+        before = counts["n"]
+        out["wire"] += [d.tags.tobytes() for d in feed(gen_a, q_w, feeder_w, t)]
+        fetches["wire"] += counts["n"] - before
+    before = counts["n"]
+    out["base"] += [d.tags.tobytes() for d in feeder_b.flush()]
+    fetches["base"] += counts["n"] - before
+    before = counts["n"]
+    out["wire"] += [d.tags.tobytes() for d in feeder_w.flush()]
+    fetches["wire"] += counts["n"] - before
+
+    # THE acceptance: 101 live wire clients cost the ingest path ZERO
+    assert fetches["wire"] == fetches["base"], fetches
+    assert out["wire"] == out["base"]
+    for pipe in (pipe_b, pipe_w):
+        assert pipe.get_counters()["jit_retraces"] == 0
+
+    # evals == event batches — NOT 101× (per watcher), NOT per event
+    sc = subs.get_counters()
+    assert sc["evals"] == table_batches["n"] > 0, (sc, table_batches)
+    assert sc["deliveries"] == sc["evals"] * (N + 1)
+    assert sc["eval_errors"] == 0 and sc["watcher_errors"] == 0
+
+    # post-run, outside the budget: the final close event reaches every
+    # lane bit-exact — in-process queues AND the real SSE stream
+    pipe_w.snapshot_open(force=True)
+    t_last = t0 + 2 + (B - 1) // 4
+    bus.publish(WindowClosed(DEEPFLOW_SYSTEM_DB, DEEPFLOW_SYSTEM_TABLE,
+                             t_last))
+    now = t_last + 1
+    fresh = query_range(
+        store, LIVE_METRIC_FLOW_BYTES, now - SPAN, now, STEP,
+        db=DEEPFLOW_SYSTEM_DB, table=DEEPFLOW_SYSTEM_TABLE, live=reg,
+        cache=False,
+    )
+    assert fresh, "open windows invisible — nothing was actually served"
+    import json as _json
+
+    norm = _json.loads(_json.dumps(fresh, default=str))
+    for conn in conns:
+        last = item = conn.poll()
+        while item is not None:
+            last, item = item, conn.poll()
+        assert _json.loads(_json.dumps(last, default=str)) == norm
+        assert conn.watcher.dropped == 0
+    deadline = time.time() + 30
+    while not sse_events and time.time() < deadline:
+        time.sleep(0.01)
+    assert sse_events and sse_events[-1] == norm
+    for conn in conns:
+        hub.close_conn(conn)
+    hub.close()
+    rest.stop()
+    subs.close()
+    sse_thread.join(timeout=10)

@@ -1088,3 +1088,107 @@ def test_callback_watcher_lease_renews_on_delivery():
     assert subs.reap() == 1
     assert bad not in sub.watchers and served in sub.watchers
     subs.close()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 19: the wire lane rides the SAME watcher-lease machinery
+
+
+def test_wire_disconnected_client_reaped_after_lease():
+    """A wire client that stops draining (vanished transport, no
+    mid-write error to catch) stops renewing; one lease later the hub
+    sweep closes the record AND the standing eval behind it."""
+    from deepflow_tpu.wire import WireHub
+
+    store = ColumnarStore()
+    ensure_system_table(store)
+    bus = QueryEventBus(name="wire_lease")
+    subs = SubscriptionManager(store, live=LiveRegistry(), cache=False,
+                               bus=bus, name="wire_lease")
+    hub = WireHub(subs, lease_s=30.0, name="wire_lease")
+    try:
+        conn = hub.open_stream(promql="m", span_s=4)
+        assert len(subs.list_subscriptions()) == 1
+        # the serve loop's poll(renew=False) proves nothing: only a
+        # successful write renews — a dead client never writes
+        conn.watcher.last_renew -= 60.0
+        assert hub.reap() == 1
+        assert conn.closed
+        assert hub.get_counters()["reaps"] == 1
+        assert hub.get_counters()["connections_open"] == 0
+        # lease lapse tears the whole chain down: no orphaned queue,
+        # no orphaned subscription evaluating for nobody
+        assert subs.list_subscriptions() == []
+    finally:
+        hub.close()
+        subs.close()
+
+
+def test_wire_actively_draining_client_never_reaped():
+    """Delivery IS the heartbeat: a client whose writes succeed renews
+    on every one and outlives any number of sweeps, while a silent
+    sibling on the SAME query lapses alone."""
+    from deepflow_tpu.wire import WireHub
+
+    store = ColumnarStore()
+    ensure_system_table(store)
+    bus = QueryEventBus(name="wire_drain")
+    subs = SubscriptionManager(store, live=LiveRegistry(), cache=False,
+                               bus=bus, name="wire_drain")
+    hub = WireHub(subs, lease_s=30.0, name="wire_drain")
+    try:
+        active = hub.open_stream(promql="m", span_s=4)
+        silent = hub.open_stream(promql="m", span_s=4)
+        for k in range(3):
+            _samples_insert(store, T0 + k, "m", float(k))
+            bus.publish(WindowClosed(DEEPFLOW_SYSTEM_DB,
+                                     DEEPFLOW_SYSTEM_TABLE, T0 + k))
+            # the serve loop: pop without renewing, write, THEN renew
+            assert active.poll() is not None
+            active.renew()
+            silent.watcher.last_renew -= 60.0  # the sibling went dark
+            assert hub.reap() <= 1
+        assert not active.closed and silent.closed
+        assert hub.get_counters()["reaps"] == 1
+        # the shared subscription survives for the live client
+        assert len(subs.list_subscriptions()) == 1
+    finally:
+        hub.close()
+        subs.close()
+
+
+def test_wire_queue_memory_freed_after_reap():
+    """Reap releases the queue CONTENTS, not just the connection row:
+    nothing in the hub or manager keeps a reaped client's undelivered
+    results alive (a million-watcher plane cannot leak per-client
+    queues)."""
+    import gc
+    import weakref
+
+    from deepflow_tpu.wire import WireHub
+
+    class _Payload:  # weakref-able stand-in for a queued result
+        pass
+
+    store = ColumnarStore()
+    ensure_system_table(store)
+    bus = QueryEventBus(name="wire_mem")
+    subs = SubscriptionManager(store, live=LiveRegistry(), cache=False,
+                               bus=bus, name="wire_mem")
+    hub = WireHub(subs, lease_s=30.0, name="wire_mem")
+    try:
+        conn = hub.open_stream(promql="m", span_s=4)
+        payload = _Payload()
+        conn.watcher.deliver(payload, None)  # parked, never drained
+        ref = weakref.ref(payload)
+        del payload
+        assert ref() is not None, "still parked in the bounded queue"
+        conn.watcher.last_renew -= 60.0
+        assert hub.reap() == 1
+        assert subs.list_subscriptions() == []
+        del conn  # the transport record was the last external holder
+        gc.collect()
+        assert ref() is None, "reaped queue must release its contents"
+    finally:
+        hub.close()
+        subs.close()
