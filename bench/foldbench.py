@@ -214,6 +214,62 @@ def run_plane_shape(n_rows: int, iters: int) -> dict:
     return row
 
 
+def run_tier_shape(s_rows: int, a_rows: int, iters: int) -> dict:
+    """Cascade tier-ring-fold A/B (ISSUE 20): `_ring_fold_impl` with
+    the full [S+A] keyed sort (shared_sort=False, the pre-r20 shipped
+    path) vs the shared-sort rank-merge that reuses the tier stash's
+    dispatch-owned canonical order (shared_sort=True — sorts only the
+    [A] ring). Both run over the SAME canonical tier stash + ring;
+    the first iteration cross-checks bit-exactness before timing."""
+    from deepflow_tpu.aggregator.cascade import _ring_fold_impl
+
+    rng = np.random.default_rng(11)
+    t_cols = TAG_SCHEMA.num_fields
+    m_cols = FLOW_METER.num_fields
+    live = int(s_rows * 0.85)
+    tier = stash_init(s_rows, TAG_SCHEMA, FLOW_METER)
+    seed_acc = _synthetic_acc(
+        rng, live, live, key_space=live * 4, t_cols=t_cols, m_cols=m_cols
+    )
+    tier, _ = stash_fold(tier, seed_acc, FLOW_METER)  # canonical
+    acc = _synthetic_acc(
+        rng, a_rows, a_rows, key_space=live * 4, t_cols=t_cols, m_cols=m_cols
+    )
+    lanes = jnp.zeros((2,), jnp.uint32)
+
+    def mk(shared: bool):
+        return jax.jit(
+            lambda st, ac: _ring_fold_impl(
+                st, ac, lanes, SUM_COLS, MAX_COLS, shared_sort=shared
+            )[0]
+        )
+
+    full_fn, shared_fn = mk(False), mk(True)
+    a_state = full_fn(tier, acc)
+    b_state = shared_fn(tier, acc)
+    for f in ("slot", "key_hi", "key_lo", "tags", "meters", "valid"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a_state, f)), np.asarray(getattr(b_state, f)),
+            err_msg=f"tier ring fold shared-sort mismatch in {f}",
+        )
+
+    print(f"tier stash={s_rows} ring={a_rows}", file=sys.stderr, flush=True)
+    full_ms = _chained("tier_full", full_fn, tier, acc, iters)
+    shared_ms = _chained("tier_shared", shared_fn, tier, acc, iters)
+    return {
+        "tier_stash_rows": s_rows,
+        "tier_ring_rows": a_rows,
+        "iters": iters,
+        "tier_full_ms": round(full_ms, 3),
+        "tier_shared_ms": round(shared_ms, 3),
+        "speedup_tier_full_vs_shared": round(
+            full_ms / max(shared_ms, 1e-9), 3
+        ),
+        "shared_sort_default": os.environ.get(
+            "DEEPFLOW_SHARED_SORT", "1") != "0",
+    }
+
+
 def main():
     default = (
         "65536:8192,65536:65536,262144:8192,262144:65536,"
@@ -230,22 +286,34 @@ def main():
         for v in os.environ.get("FOLDBENCH_PLANE_ROWS", "65536,262144").split(",")
         if v
     ]
+    tier_shapes = [
+        tuple(int(v) for v in part.split(":"))
+        for part in os.environ.get(
+            "FOLDBENCH_TIER_SHAPES", "65536:8192,262144:65536").split(",")
+        if part
+    ]
     rows = []
     plane_rows = []
+    tier_rows = []
     try:
         for s_rows, a_rows in shapes:
             rows.append(run_shape(s_rows, a_rows, iters))
         for n_rows in plane_shapes:
             plane_rows.append(run_plane_shape(n_rows, iters))
             print(json.dumps(plane_rows[-1]), file=sys.stderr, flush=True)
+        for s_rows, a_rows in tier_shapes:
+            tier_rows.append(run_tier_shape(s_rows, a_rows, iters))
+            print(json.dumps(tier_rows[-1]), file=sys.stderr, flush=True)
         print(
             json.dumps({"rows": rows, "plane_rows": plane_rows,
+                        "tier_rows": tier_rows,
                         "device": str(jax.devices()[0])}),
             flush=True,
         )
     except Exception as e:  # parseable partial record, never a traceback
         print(
             json.dumps({"rows": rows, "plane_rows": plane_rows,
+                        "tier_rows": tier_rows,
                         "partial": True, "error": repr(e)}),
             flush=True,
         )

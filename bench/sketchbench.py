@@ -5,22 +5,30 @@ the DDoS/scan shape that overflows the exact stash). A fourth
 "topk_multisort" row (ISSUE 17) reruns the +top-K plane with
 DEEPFLOW_SHARED_SORT=0, so every shape carries a shared-sort A/B
 (`shared_sort_speedup` on the "topk" row; bench/sortbench.py is the
-dedicated driver).
+dedicated driver). A fifth "pool" row (ISSUE 20) reruns the +top-K
+plane with the disaggregated sketch-memory pool ON — same accuracy
+protocol, compared on resident HBM sketch bytes.
 
 Measures, per (batch, stash) shape:
-  * rec/s for the three variants (the sketch tax on steady ingest);
+  * rec/s for the variants (the sketch tax on steady ingest);
   * HLL cardinality error of the closed window vs the true distinct
     count (acceptance: <1% at ≥1M distinct keys, hll_precision=14);
   * top-K heavy-hitter recall vs the true by-bytes top-K
     (acceptance: ≥0.95 at K=128, Zipf s=1.1);
   * exact-tier coverage (flushed rows / distinct keys) — the shed the
-    sketch tier papers over.
+    sketch tier papers over;
+  * `hbm_sketch_bytes` — the sketch tier's RESIDENT device bytes,
+    read from live DeviceMemoryLedger rows (profiling/ledger.py), and
+    `hbm_bytes_per_1pct_card` = bytes × cardinality-error-% (the cost
+    of a percentage point of cardinality accuracy; lower is better).
+    The "pool" row carries `density_vs_slab` = slab bytes / pool bytes
+    at the same accuracy protocol (ISSUE 20 headline: ≥4×).
 
-Protocol + committed CPU numbers: PERF.md §17 (on-chip columns
-reserved). Knobs: SKETCHBENCH_SHAPES="batch:stash,...",
-SKETCHBENCH_BATCHES, SKETCHBENCH_KEYS, SKETCHBENCH_TOPK,
-SKETCHBENCH_PRECISION. Emits one JSON record on the last stdout line
-(bench_all.py c9 re-emits it)."""
+Protocol + committed CPU numbers: PERF.md §17 and §28 (on-chip
+columns reserved; SKETCHBENCH_r02.json is the pooled run). Knobs:
+SKETCHBENCH_SHAPES="batch:stash,...", SKETCHBENCH_BATCHES,
+SKETCHBENCH_KEYS, SKETCHBENCH_TOPK, SKETCHBENCH_PRECISION. Emits one
+JSON record on the last stdout line (bench_all.py c9 re-emits it)."""
 
 from __future__ import annotations
 
@@ -37,7 +45,7 @@ import numpy as np  # noqa: E402
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from deepflow_tpu.aggregator.sketchplane import SketchConfig  # noqa: E402
+from deepflow_tpu.aggregator.sketchplane import PoolConfig, SketchConfig  # noqa: E402
 from deepflow_tpu.aggregator.window import WindowConfig, WindowManager  # noqa: E402
 from deepflow_tpu.datamodel.schema import FLOW_METER, TAG_SCHEMA  # noqa: E402
 from deepflow_tpu.ops.histogram import LogHistSpec  # noqa: E402
@@ -101,8 +109,12 @@ def _run_variant(variant, batch, stash, batches, n_keys, zipf_s, k_top,
     # "topk_multisort" is the ISSUE 17 A/B control: the same +top-K
     # plane with DEEPFLOW_SHARED_SORT=0 (the knob is read at dispatch
     # time, so flipping it between variants is honest within one
-    # process). Everything else about the row is the "topk" protocol.
-    plane = "topk" if variant.startswith("topk") else variant
+    # process). "pool" (ISSUE 20) is the same +top-K plane drawing from
+    # the disaggregated sketch-memory pool — identical accuracy
+    # protocol, compared on resident HBM bytes. Everything else about
+    # those rows is the "topk" protocol.
+    plane = "topk" if variant in ("topk", "topk_multisort", "pool") \
+        else variant
     os.environ["DEEPFLOW_SHARED_SORT"] = (
         "0" if variant == "topk_multisort" else "1")
     sk = None
@@ -114,6 +126,10 @@ def _run_variant(variant, batch, stash, batches, n_keys, zipf_s, k_top,
             topk_rows=2 if plane == "topk" else 0,
             topk_cols=max(64, 1 << (max(k_top, 1) - 1).bit_length() + 3),
             pending=8,
+            # topk_factor=2: the top-K lanes are a rounding error of
+            # the arena (CMS/HLL dominate), so halving instead of
+            # quartering them buys pre-promotion recall for free
+            pool=PoolConfig(topk_factor=2) if variant == "pool" else None,
         )
     wm = WindowManager(WindowConfig(capacity=stash, delay=2, sketch=sk))
     gen = _KeyGen(np.random.default_rng(7), n_keys, zipf_s)
@@ -174,6 +190,26 @@ def _run_variant(variant, batch, stash, batches, n_keys, zipf_s, k_top,
     counters = wm.get_counters()
     rec["sketch_rows"] = counters["sketch_rows"]
     rec["sketch_shed"] = counters["sketch_shed"]
+    if sk is not None:
+        # resident sketch HBM from LIVE ledger rows (ISSUE 20): the
+        # manager's device_planes() enumerate the actual buffers — the
+        # pooled plane reports as sketch_pool_hot/_wide/_pending/_meta,
+        # the slab plane as one "sketch" row; nothing is estimated
+        from deepflow_tpu.profiling.ledger import DeviceMemoryLedger
+
+        led = DeviceMemoryLedger()
+        led.register("wm", wm)
+        rec["hbm_sketch_bytes"] = sum(
+            r["bytes"] for r in led.snapshot()
+            if r["plane"].startswith("sketch")
+        )
+        if "cardinality_error" in rec:
+            rec["hbm_bytes_per_1pct_card"] = round(
+                rec["hbm_sketch_bytes"]
+                * max(rec["cardinality_error"] * 100.0, 1e-3), 1)
+        if sk.pool is not None:
+            rec["pool_spill"] = counters["sketch_pool_spill"]
+            rec["pool_promotions"] = counters["sketch_promotions"]
     return rec
 
 
@@ -188,7 +224,8 @@ def main():
     try:
         for batch, stash in _shapes():
             recs = {}
-            for variant in ("exact", "sketch", "topk", "topk_multisort"):
+            for variant in ("exact", "sketch", "topk", "topk_multisort",
+                            "pool"):
                 r = _run_variant(variant, batch, stash, batches, n_keys,
                                  zipf_s, k_top, precision)
                 r.update(batch=batch, stash=stash)
@@ -200,6 +237,18 @@ def main():
             recs["topk"]["shared_sort_speedup"] = round(
                 recs["topk"]["rec_s"]
                 / max(recs["topk_multisort"]["rec_s"], 1e-9), 3)
+            # pooled-memory density (ISSUE 20): resident sketch HBM of
+            # the slab +top-K plane over the pooled one, same accuracy
+            # protocol — the ≥4× headline, from live ledger rows
+            slab_b = recs["topk"].get("hbm_sketch_bytes", 0)
+            pool_b = recs["pool"].get("hbm_sketch_bytes", 0)
+            if pool_b:
+                recs["pool"]["density_vs_slab"] = round(slab_b / pool_b, 3)
+                if "hbm_bytes_per_1pct_card" in recs["pool"]:
+                    recs["pool"]["density_per_1pct_vs_slab"] = round(
+                        recs["topk"].get("hbm_bytes_per_1pct_card", 0.0)
+                        / max(recs["pool"]["hbm_bytes_per_1pct_card"],
+                              1e-9), 3)
     except Exception as e:  # partial-JSON convention (bench.py stance)
         err = repr(e)
     out = {

@@ -450,12 +450,14 @@ def config8(quick: bool):
 def config9(quick: bool):
     """Sketch tier A/B (ISSUE 8): exact-only vs +sketch-plane vs +top-K
     through the windowed raw-doc path under Zipf+scan traffic, via
-    bench/sketchbench.py (protocol + committed numbers: PERF.md §17).
-    The vs line is the top-K variant's heavy-hitter recall at the
-    largest shape run; cardinality error and the exact tier's shed
-    coverage ride the detail rows. Quick mode trims to one small shape;
-    the acceptance grid (1M-row batches, ≥1M distinct keys, K=128,
-    Zipf s=1.1) is the standalone default."""
+    bench/sketchbench.py (protocol + committed numbers: PERF.md §17;
+    the pooled-memory run is §28 / SKETCHBENCH_r02.json). The vs line
+    is the top-K variant's heavy-hitter recall at the largest shape
+    run; cardinality error, the exact tier's shed coverage and the
+    ISSUE 20 pooled-memory density (`density_vs_slab` on the "pool"
+    row, from live HBM ledger bytes) ride the detail rows. Quick mode
+    trims to one small shape; the acceptance grid (1M-row batches,
+    ≥1M distinct keys, K=128, Zipf s=1.1) is the standalone default."""
     import os
     import subprocess
 
@@ -474,10 +476,15 @@ def config9(quick: bool):
         return
     topk_rows = [r for r in rows if r["variant"] == "topk"]
     last = topk_rows[-1] if topk_rows else rows[-1]
+    pool_rows = [r for r in rows if r["variant"] == "pool"]
     emit("c9_sketch_tier", last["rec_s"], "records/s",
          last.get("topk_recall", 0.0), rows=rows,
          cardinality_error=last.get("cardinality_error"),
          exact_coverage=last.get("exact_coverage"),
+         pool_density_vs_slab=(
+             pool_rows[-1].get("density_vs_slab") if pool_rows else None),
+         pool_topk_recall=(
+             pool_rows[-1].get("topk_recall") if pool_rows else None),
          n_keys=rec["n_keys"], zipf_s=rec["zipf_s"], k_top=rec["k_top"],
          partial=rec.get("partial", False), error=rec.get("error"))
 

@@ -58,13 +58,14 @@ import numpy as np
 from jax import lax
 
 from ..datamodel.schema import MeterSchema, TagSchema
-from ..ops.segment import SENTINEL_SLOT
+from ..ops.segment import SENTINEL_SLOT, _use_shared_sort
 from .sketchplane import WindowSketchBlock
 from .stash import (
     AccumState,
     StashState,
     _append_impl,
     _merge_impl,
+    _sorted_merge_reduce,
     accum_init,
     stash_flush_range,
     stash_init,
@@ -137,15 +138,38 @@ def _acc_valid(acc) -> jnp.ndarray:
     return acc.slot != jnp.uint32(SENTINEL_SLOT)
 
 
-def _ring_fold_impl(tier: StashState, acc, lanes, sum_cols_t, max_cols_t):
-    """Merge the tier accumulator ring into the tier stash (one sort +
-    segment-reduce — the amortized cost) and reset it. Overflow sheds
-    count into lanes[1] (CB_CASCADE_SHED)."""
+def _ring_fold_impl(tier: StashState, acc, lanes, sum_cols_t, max_cols_t,
+                    shared_sort: bool = False):
+    """Merge the tier accumulator ring into the tier stash and reset
+    it. Overflow sheds count into lanes[1] (CB_CASCADE_SHED).
+
+    With `shared_sort` (static; the DEEPFLOW_SHARED_SORT knob, ISSUE
+    20) the fold reuses the dispatch-owned order: the tier stash is
+    ALREADY (slot, key)-sorted — every producer keeps the canonical
+    layout (groupby reduces, compact=True tier flushes) — so only the
+    ring's [A] rows sort and rank-merge against the standing run
+    (stash._sorted_merge_reduce, the merge-fold body) instead of a
+    second full [S+A] 3-key sort. Bit-exact vs the full-sort path
+    (same reduce, same overflow stance); A/B'd in bench/foldbench.py."""
     prev_dropped = tier.dropped_overflow
-    new_tier = _merge_impl(
-        tier, acc.slot, acc.key_hi, acc.key_lo, acc.tags, acc.meters,
-        _acc_valid(acc), sum_cols_t, max_cols_t,
-    )
+    if shared_sort:
+        valid = _acc_valid(acc)
+        na_sl = jnp.where(valid, acc.slot, jnp.uint32(SENTINEL_SLOT))
+        na_hi = jnp.where(valid, acc.key_hi, _U32_MAX)
+        na_lo = jnp.where(valid, acc.key_lo, _U32_MAX)
+        a_iota = jnp.arange(acc.capacity, dtype=jnp.int32)
+        a_sl, a_hi, a_lo, a_perm = lax.sort(
+            (na_sl, na_hi, na_lo, a_iota), num_keys=3
+        )
+        new_tier = _sorted_merge_reduce(
+            tier, na_sl, na_hi, na_lo, a_sl, a_hi, a_lo, a_perm,
+            acc.tags, acc.meters, sum_cols_t, max_cols_t,
+        )
+    else:
+        new_tier = _merge_impl(
+            tier, acc.slot, acc.key_hi, acc.key_lo, acc.tags, acc.meters,
+            _acc_valid(acc), sum_cols_t, max_cols_t,
+        )
     new_acc = dataclasses.replace(
         acc, slot=jnp.full((acc.capacity,), SENTINEL_SLOT, dtype=jnp.uint32)
     )
@@ -155,14 +179,14 @@ def _ring_fold_impl(tier: StashState, acc, lanes, sum_cols_t, max_cols_t):
 
 tier_ring_fold = partial(
     jax.jit,
-    static_argnames=("sum_cols_t", "max_cols_t"),
+    static_argnames=("sum_cols_t", "max_cols_t", "shared_sort"),
     donate_argnums=(0, 1, 2),
 )(_ring_fold_impl)
 
 
 def _tier_step_impl(tier: StashState, acc, fill, lanes, packed, total, hi,
                     *, ratio: int, num_tags: int, sum_cols_t, max_cols_t,
-                    prefix: int):
+                    prefix: int, shared_sort: bool = False):
     """One advance's closed rows into the tier — tier 0's own
     append/amortize architecture one level up.
 
@@ -208,7 +232,8 @@ def _tier_step_impl(tier: StashState, acc, fill, lanes, packed, total, hi,
 
     def fold_then_append(tier, acc, fill, lanes):
         tier, acc, lanes = _ring_fold_impl(
-            tier, acc, lanes, sum_cols_t, max_cols_t
+            tier, acc, lanes, sum_cols_t, max_cols_t,
+            shared_sort=shared_sort,
         )
         return append(tier, acc, jnp.int32(0), lanes)
 
@@ -252,7 +277,7 @@ def _tier_step_impl(tier: StashState, acc, fill, lanes, packed, total, hi,
 tier_step = partial(
     jax.jit,
     static_argnames=("ratio", "num_tags", "sum_cols_t", "max_cols_t",
-                     "prefix"),
+                     "prefix", "shared_sort"),
     donate_argnums=(0, 1, 3),
 )(_tier_step_impl)
 
@@ -357,6 +382,9 @@ class TierCascade:
         must land there too."""
         out: list[TierFlush] = []
         src, src_total, src_hi = packed, total, int(hi)
+        # per-dispatch knob capture, the single-chip convention (the
+        # sharded twin captures at build time)
+        shared_sort = _use_shared_sort()
         for i, ratio in enumerate(self.ratios):
             child_rows = src.shape[0]
             ring_rows = tier_ring_rows(child_rows)
@@ -367,6 +395,7 @@ class TierCascade:
                     self.tiers[i], _old, self.lanes_dev = tier_ring_fold(
                         self.tiers[i], self.accs[i], self.lanes_dev,
                         sum_cols_t=self.sum_cols, max_cols_t=self.max_cols,
+                        shared_sort=shared_sort,
                     )
                 self.accs[i] = accum_init(
                     ring_rows, self.tag_schema, self.meter_schema
@@ -379,6 +408,7 @@ class TierCascade:
                     ratio=ratio, num_tags=self.num_tags,
                     sum_cols_t=self.sum_cols, max_cols_t=self.max_cols,
                     prefix=tier_prefix(child_rows),
+                    shared_sort=shared_sort,
                 )
             )
             hi_t = src_hi // ratio
@@ -389,11 +419,18 @@ class TierCascade:
             self.tiers[i], self.accs[i], self.lanes_dev = tier_ring_fold(
                 self.tiers[i], self.accs[i], self.lanes_dev,
                 sum_cols_t=self.sum_cols, max_cols_t=self.max_cols,
+                shared_sort=shared_sort,
             )
             self.fills[i] = jnp.zeros((), jnp.int32)
             lo_t = self.watermarks[i]
+            # compact=True UNCONDITIONALLY (ISSUE 20): the tier stash
+            # must keep the canonical sorted-prefix layout the
+            # shared-sort ring fold rank-merges against. Safe — the
+            # watermark protocol guarantees lo_t ≤ every live parent
+            # slot, and the flushed output is identical either way.
             self.tiers[i], t_packed, t_total = stash_flush_range(
-                self.tiers[i], np.uint32(lo_t), np.uint32(hi_t)
+                self.tiers[i], np.uint32(lo_t), np.uint32(hi_t),
+                compact=True,
             )
             out.append(TierFlush(
                 tier=i, interval=self.config.intervals[i],
@@ -478,6 +515,7 @@ class TierCascade:
                 self.tiers[i], self.accs[i], self.lanes_dev = tier_ring_fold(
                     self.tiers[i], self.accs[i], self.lanes_dev,
                     sum_cols_t=self.sum_cols, max_cols_t=self.max_cols,
+                    shared_sort=_use_shared_sort(),
                 )
                 self.fills[i] = jnp.zeros((), jnp.int32)
 

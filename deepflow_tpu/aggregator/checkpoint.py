@@ -63,7 +63,16 @@ from .window import WindowConfig, WindowManager
 # files load with the tiers re-initialized + a LOUD log (open tier
 # windows' partial aggregates restart; the journal replay rebuilds them
 # where it covers the span).
-_VERSION = 5
+# v6 (ISSUE 20): + pooled sketch-memory lanes — the compact arena,
+# the slot routing table, the wide close/count lanes and the
+# spill/promotion scalars ride alongside the classic lanes (zero-size
+# arrays in slab mode, so slab checkpoints cost nothing). v5 files
+# restore into a pool-CONFIGURED manager with the sketch tier
+# re-initialized + a LOUD log (pooled arenas cannot be re-seated from
+# slab planes); `promote_fill` is deliberately NOT serialized — it
+# re-derives from the manager's PoolConfig at restore, so a knob change
+# takes effect without invalidating checkpoints.
+_VERSION = 6
 _MIN_READ_VERSION = 2  # v2 = pre-digest layout, still loadable
 
 _log = logging.getLogger(__name__)
@@ -79,9 +88,19 @@ _SKETCH_LANES = (
     "pend", "pend_win",
 )
 
+# pooled sketch-memory lanes (v6, ISSUE 20): zero-size in slab mode —
+# they serialize (and hash into the digest) at no cost either way
+_POOL_LANES = (
+    "slot_of", "p_hll", "p_cms", "p_hist", "p_tkv", "p_tkh", "p_tkl",
+    "p_tia", "p_tib", "wide_close", "wide_count",
+)
+
 
 def _sketch_arrays(sk: SketchState, prefix: str = "sk_") -> dict:
-    return {prefix + name: np.asarray(getattr(sk, name)) for name in _SKETCH_LANES}
+    return {
+        prefix + name: np.asarray(getattr(sk, name))
+        for name in _SKETCH_LANES + _POOL_LANES
+    }
 
 
 def _sketch_meta(sk: SketchState, cfg: SketchConfig) -> dict:
@@ -90,6 +109,8 @@ def _sketch_meta(sk: SketchState, cfg: SketchConfig) -> dict:
         "sketch_pend_n": np.asarray(sk.pend_n).tolist(),
         "sketch_rows": np.asarray(sk.rows).tolist(),
         "sketch_shed": np.asarray(sk.shed).tolist(),
+        "sketch_pool_spill": np.asarray(sk.pool_spill).tolist(),
+        "sketch_pool_promos": np.asarray(sk.pool_promos).tolist(),
     }
 
 
@@ -111,19 +132,63 @@ def _restore_sketch(meta: dict, arrays: dict, cfg: SketchConfig,
                 lambda x: jnp.broadcast_to(x[None], (sharded_dim,) + x.shape), sk
             )
         return sk
+    import dataclasses as _dc
+
     saved_cfg = SketchConfig.from_meta(meta["sketch"])
     if saved_cfg != cfg:
+        if _dc.replace(saved_cfg, pool=None) == _dc.replace(cfg, pool=None):
+            # same wide-plane shapes, different pool geometry — incl.
+            # the v5-into-pooled-manager path (v5 meta has no "pool").
+            # Pooled arenas cannot be re-seated from slab planes (or
+            # from differently-factored arenas), so this is the loud
+            # re-init contract, NOT the config-mismatch crash.
+            _log.warning(
+                "checkpoint %s sketch pool geometry %s != manager pool "
+                "geometry %s — pooled arenas cannot be re-seated; "
+                "re-initializing the sketch tier empty (open windows' "
+                "approximate answers restart from this point)",
+                path, saved_cfg.pool, cfg.pool,
+            )
+            sk = sketch_init(cfg, ring)
+            if sharded_dim is not None:
+                sk = jax.tree.map(
+                    lambda x: jnp.broadcast_to(
+                        x[None], (sharded_dim,) + x.shape
+                    ),
+                    sk,
+                )
+            return sk
         raise ValueError(
             f"checkpoint {path} sketch config {saved_cfg} != manager "
             f"sketch config {cfg} — plane shapes/error knobs disagree"
         )
     kw = {name: jnp.asarray(arrays["sk_" + name]) for name in _SKETCH_LANES}
     scal = lambda v, dt: jnp.asarray(np.asarray(v), dt)
+    # pooled lanes (v6): absent from pre-v6 files — by the config gate
+    # above that only happens with pool=None, where the fields are
+    # zero-size; synthesize them from a fresh init so v5 slab files
+    # keep loading bit-exact. promote_fill is never serialized: it
+    # re-derives from the manager's PoolConfig here.
+    fresh = sketch_init(cfg, ring)
+    for name in _POOL_LANES:
+        if "sk_" + name in arrays:
+            kw[name] = jnp.asarray(arrays["sk_" + name])
+        else:
+            f = np.asarray(getattr(fresh, name))
+            shape = f.shape if sharded_dim is None else (sharded_dim,) + f.shape
+            kw[name] = jnp.zeros(shape, f.dtype)
+    pf = jnp.asarray(fresh.promote_fill)
+    if sharded_dim is not None:
+        pf = jnp.broadcast_to(pf[None], (sharded_dim,))
+    zero = 0 if sharded_dim is None else [0] * sharded_dim
     return SketchState(
         **kw,
         pend_n=scal(meta["sketch_pend_n"], jnp.int32),
         rows=scal(meta["sketch_rows"], jnp.uint32),
         shed=scal(meta["sketch_shed"], jnp.uint32),
+        pool_spill=scal(meta.get("sketch_pool_spill", zero), jnp.uint32),
+        pool_promos=scal(meta.get("sketch_pool_promos", zero), jnp.uint32),
+        promote_fill=pf,
     )
 
 
@@ -177,18 +242,27 @@ def _restore_cascade_tiers(meta: dict, arrays: dict, config: CascadeConfig,
             f"checkpoint {path} cascade config {saved} != manager cascade "
             f"config {config} — tier shapes/intervals disagree"
         )
+    from .stash import stash_canonicalize
+
     tiers = []
     for i in range(len(config.intervals)):
         mat = jnp.asarray(arrays[f"casc_t{i}_packed"])
         if sharded:
-            tiers.append(_unpack_stash_sharded(
+            t = _unpack_stash_sharded(
                 mat, jnp.asarray(arrays[f"casc_t{i}_dropped"], jnp.int32),
                 num_tags=num_tags,
-            ))
+            )
+            t = jax.vmap(stash_canonicalize)(t)
         else:
-            tiers.append(_unpack_stash(
+            t = _unpack_stash(
                 mat, np.int32(meta["cascade_dropped"][i]), num_tags=num_tags,
-            ))
+            )
+            t = stash_canonicalize(t)
+        # one restore-time sort per tier: pre-v6 files hold tier
+        # stashes with mid-prefix holes (their flushes never
+        # compacted), and the shared-sort ring fold (ISSUE 20)
+        # rank-merges against the standing canonical order
+        tiers.append(t)
     lanes = jnp.asarray(np.asarray(meta["cascade_lanes"], np.uint32))
     pending: list[dict] = [{} for _ in config.intervals]
     if meta.get("cascade_pending"):

@@ -276,6 +276,93 @@ def stash_fold_counted(
     return collector_fold_counted(state, acc, sum_cols, max_cols)
 
 
+@jax.jit
+def stash_canonicalize(state: StashState) -> StashState:
+    """Re-establish the canonical layout (live rows = (slot, key)-
+    ascending positional prefix; dead rows sentinel-keyed behind) with
+    ONE 3-key sort, preserving every live row's content bit-for-bit.
+    Restore-time only (ISSUE 20): pre-v6 checkpoints could hold
+    cascade tier stashes with mid-prefix holes — their tier flushes
+    never compacted — and the shared-sort ring fold rank-merges
+    against the standing order, so a restored tier must be re-sorted
+    once before it re-enters the fold path."""
+    sl = jnp.where(state.valid, state.slot, jnp.uint32(SENTINEL_SLOT))
+    hi = jnp.where(state.valid, state.key_hi, jnp.uint32(_U32_MAX))
+    lo = jnp.where(state.valid, state.key_lo, jnp.uint32(_U32_MAX))
+    iota = jnp.arange(state.capacity, dtype=jnp.int32)
+    _, _, _, order = lax.sort((sl, hi, lo, iota), num_keys=3)
+    return StashState(
+        slot=jnp.take(sl, order),
+        key_hi=jnp.take(state.key_hi, order),
+        key_lo=jnp.take(state.key_lo, order),
+        tags=jnp.take(state.tags, order, axis=1),
+        meters=jnp.take(state.meters, order, axis=1),
+        valid=jnp.take(state.valid, order),
+        dropped_overflow=state.dropped_overflow,
+    )
+
+
+def _sorted_merge_reduce(state: StashState, na_sl, na_hi, na_lo,
+                         a_sl, a_hi, a_lo, a_perm, acc_tags, acc_meters,
+                         sum_cols_t, max_cols_t) -> StashState:
+    """Rank-merge one SORTED normalized run against the canonical
+    (sorted-prefix) stash and segment-reduce the merged order — the
+    shared body of the incremental merge-fold AND the cascade's
+    shared-sort ring fold (ISSUE 20). `na_*` are the run's normalized
+    lanes in ORIGINAL (unsorted) position — invalid rows re-keyed to
+    SENTINEL/U32_MAX; `a_sl/a_hi/a_lo/a_perm` the same lanes sorted
+    with their permutation. Payload lanes (`acc_tags` [T, A],
+    `acc_meters` [M, A]) stay column-major and unsorted — the merged
+    order routes through `a_perm`. Requires the canonical stash layout
+    (live rows = (slot, key)-ascending positional prefix)."""
+    s = state.capacity
+
+    # normalized stash keys — already sorted by the canonical invariant
+    ns_sl = jnp.where(state.valid, state.slot, jnp.uint32(SENTINEL_SLOT))
+    ns_hi = jnp.where(state.valid, state.key_hi, jnp.uint32(_U32_MAX))
+    ns_lo = jnp.where(state.valid, state.key_lo, jnp.uint32(_U32_MAX))
+
+    rank_s, rank_a = merge_ranks((ns_sl, ns_hi, ns_lo), (a_sl, a_hi, a_lo))
+    # order maps merged position → concat([stash, acc]) row; the acc
+    # payload routes through a_perm so downstream gathers hit original
+    # ring rows (the reduce's tag/meter payloads are never pre-sorted)
+    order = merge_order(
+        rank_s, rank_a, jnp.arange(s, dtype=jnp.int32), s + a_perm
+    )
+
+    cat_sl = jnp.concatenate([ns_sl, na_sl])
+    cat_hi = jnp.concatenate([ns_hi, na_hi])
+    cat_lo = jnp.concatenate([ns_lo, na_lo])
+    cat_tags = jnp.concatenate([state.tags, acc_tags], axis=1)
+    # same transpose-at-fold stance as _merge_impl (module layout note)
+    cat_meters = jnp.transpose(
+        jnp.concatenate([state.meters, acc_meters], axis=1)
+    )
+
+    g = groupby_reduce_sorted(
+        jnp.take(cat_sl, order),
+        jnp.take(cat_hi, order),
+        jnp.take(cat_lo, order),
+        order,
+        cat_tags,
+        cat_meters,
+        np.asarray(sum_cols_t, dtype=np.int32),
+        np.asarray(max_cols_t, dtype=np.int32),
+        out_capacity=s,
+    )
+
+    dropped = jnp.maximum(g.num_segments - s, 0)
+    return StashState(
+        slot=g.slot,
+        key_hi=g.key_hi,
+        key_lo=g.key_lo,
+        tags=g.tags,
+        meters=g.meters,
+        valid=g.seg_valid,
+        dropped_overflow=state.dropped_overflow + dropped,
+    )
+
+
 def _merge_fold_impl(state: StashState, acc: AccumState, hi_window, sum_cols_t, max_cols_t):
     """Rank-merge fold: sort [A], merge against the sorted [S] stash,
     reduce the merged run — no full keyed re-sort of the stash lanes.
@@ -298,7 +385,6 @@ def _merge_fold_impl(state: StashState, acc: AccumState, hi_window, sum_cols_t, 
     (sketchplane.sketch_plane_step); the fold's amortized sort already
     IS the one sort of its own dispatch bucket (census-attributed in
     pipeline.telemetry()["profile"])."""
-    s = state.capacity
     a = acc.capacity
     hi_window = jnp.asarray(hi_window, dtype=jnp.uint32)
 
@@ -311,47 +397,9 @@ def _merge_fold_impl(state: StashState, acc: AccumState, hi_window, sum_cols_t, 
     a_iota = jnp.arange(a, dtype=jnp.int32)
     a_sl, a_hi, a_lo, a_perm = lax.sort((na_sl, na_hi, na_lo, a_iota), num_keys=3)
 
-    # normalized stash keys — already sorted by the canonical invariant
-    ns_sl = jnp.where(state.valid, state.slot, jnp.uint32(SENTINEL_SLOT))
-    ns_hi = jnp.where(state.valid, state.key_hi, jnp.uint32(_U32_MAX))
-    ns_lo = jnp.where(state.valid, state.key_lo, jnp.uint32(_U32_MAX))
-
-    rank_s, rank_a = merge_ranks((ns_sl, ns_hi, ns_lo), (a_sl, a_hi, a_lo))
-    # order maps merged position → concat([stash, acc]) row; the acc
-    # payload routes through a_perm so downstream gathers hit original
-    # ring rows (the reduce's tag/meter payloads are never pre-sorted)
-    order = merge_order(
-        rank_s, rank_a, jnp.arange(s, dtype=jnp.int32), s + a_perm
-    )
-
-    cat_sl = jnp.concatenate([ns_sl, na_sl])
-    cat_hi = jnp.concatenate([ns_hi, na_hi])
-    cat_lo = jnp.concatenate([ns_lo, na_lo])
-    cat_tags = jnp.concatenate([state.tags, acc.tags], axis=1)
-    # same transpose-at-fold stance as _merge_impl (module layout note)
-    cat_meters = jnp.transpose(jnp.concatenate([state.meters, acc.meters], axis=1))
-
-    g = groupby_reduce_sorted(
-        jnp.take(cat_sl, order),
-        jnp.take(cat_hi, order),
-        jnp.take(cat_lo, order),
-        order,
-        cat_tags,
-        cat_meters,
-        np.asarray(sum_cols_t, dtype=np.int32),
-        np.asarray(max_cols_t, dtype=np.int32),
-        out_capacity=s,
-    )
-
-    dropped = jnp.maximum(g.num_segments - s, 0)
-    new_state = StashState(
-        slot=g.slot,
-        key_hi=g.key_hi,
-        key_lo=g.key_lo,
-        tags=g.tags,
-        meters=g.meters,
-        valid=g.seg_valid,
-        dropped_overflow=state.dropped_overflow + dropped,
+    new_state = _sorted_merge_reduce(
+        state, na_sl, na_hi, na_lo, a_sl, a_hi, a_lo, a_perm,
+        acc.tags, acc.meters, sum_cols_t, max_cols_t,
     )
     # consumed rows turn sentinel in place; out-of-span rows stay. Their
     # ring slots are reclaimed when the next FULL fold resets the host

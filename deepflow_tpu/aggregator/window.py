@@ -55,6 +55,7 @@ from .sketchplane import (
     SketchState,
     WindowSketchBlock,
     _flatten_open,
+    _pool_mode,
     sketch_drain,
     sketch_init,
     sketch_plane_step,
@@ -131,8 +132,14 @@ def host_fetch(x) -> np.ndarray:
 # dashboard's read pressure is visible in the device counter plane
 # without a new fetch. u32 lanes: bytes wrap mod 2^32 like every other
 # cumulative lane; the host ints stay authoritative.
+# v7 (ISSUE 20): + sketch_pool_spill / sketch_pool_occ /
+# sketch_promotions — the pooled sketch memory's cumulative counted
+# spills (windows that wanted a compact slot when the pool was full),
+# the occupancy gauge (allocated compact slots + closed-pending wide
+# slots at dispatch), and cumulative compact→wide promotions. Zero in
+# slab mode (the pool lanes are zero-size arrays whose sums are 0).
 
-COUNTER_BLOCK_VERSION = 6
+COUNTER_BLOCK_VERSION = 7
 (
     CB_VERSION,  # constant COUNTER_BLOCK_VERSION
     CB_T_MAX,  # max valid timestamp (pre-gate)
@@ -152,13 +159,17 @@ COUNTER_BLOCK_VERSION = 6
     CB_CASCADE_SHED,  # cumulative tier-stash overflow sheds
     CB_SNAPSHOT_READS,  # cumulative live snapshot_open() reads
     CB_SNAPSHOT_BYTES,  # cumulative live snapshot bytes fetched (mod 2^32)
-) = range(18)
-CB_LEN = 18
+    CB_SKETCH_POOL_SPILL,  # cumulative pool-exhaustion counted spills
+    CB_SKETCH_POOL_OCC,  # pool occupancy gauge at dispatch (compact+wide)
+    CB_SKETCH_PROMOTIONS,  # cumulative compact→wide slot promotions
+) = range(21)
+CB_LEN = 21
 CB_FIELDS = (
     "version", "t_max", "t_min", "n_valid", "n_late", "prereduce_shed",
     "excess_word_hits", "stash_occupancy", "stash_evictions", "ring_fill",
     "feeder_shed", "fold_rows", "sketch_rows", "sketch_shed",
     "cascade_rows", "cascade_shed", "snapshot_reads", "snapshot_bytes",
+    "sketch_pool_spill", "sketch_pool_occ", "sketch_promotions",
 )
 
 
@@ -205,6 +216,9 @@ def batch_counter_block(
     cascade_shed=None,
     snapshot_reads=None,
     snapshot_bytes=None,
+    sketch_pool_spill=None,
+    sketch_pool_occ=None,
+    sketch_promotions=None,
 ):
     """`batch_stats` widened into the versioned counter block (traced).
 
@@ -234,7 +248,9 @@ def batch_counter_block(
                        u32(ring_fill), u32(feeder_shed), u32(fold_rows),
                        u32(sketch_rows), u32(sketch_shed),
                        u32(cascade_rows), u32(cascade_shed),
-                       u32(snapshot_reads), u32(snapshot_bytes)]),
+                       u32(snapshot_reads), u32(snapshot_bytes),
+                       u32(sketch_pool_spill), u32(sketch_pool_occ),
+                       u32(sketch_promotions)]),
         ]
     )
     return gated, window, block
@@ -412,6 +428,12 @@ def _raw_append_step_sk(acc, offset, start_window, stash_valid, stash_evict,
         base_w=base_w, close_w=close_w,
         shared_sort=shared_sort, fused_sketch=fused_sketch, **inp,
     )
+    # pool lanes (CB v7): occupancy gauges sum zero-size arrays in slab
+    # mode, so the lanes are 0 there without a mode branch
+    pool_occ = (
+        jnp.sum(sk.slot_of != jnp.int32(-1))
+        + jnp.sum(sk.wide_close != jnp.uint32(SENTINEL_WIN))
+    ).astype(jnp.uint32)
     gated, window, block = batch_counter_block(
         ts, valid_b, start_window, interval,
         stash_valid=stash_valid, stash_evictions=stash_evict, ring_fill=offset,
@@ -419,6 +441,8 @@ def _raw_append_step_sk(acc, offset, start_window, stash_valid, stash_evict,
         sketch_rows=sk.rows, sketch_shed=sk.shed,
         cascade_rows=casc_lanes[0], cascade_shed=casc_lanes[1],
         snapshot_reads=snap_lanes[0], snapshot_bytes=snap_lanes[1],
+        sketch_pool_spill=sk.pool_spill, sketch_pool_occ=pool_occ,
+        sketch_promotions=sk.pool_promos,
     )
     acc = _append_impl(acc, window, key_hi, key_lo, tags, meters, gated, offset)
     return acc, block, sk
@@ -587,6 +611,11 @@ class _FlushEntry:
     pend: jnp.ndarray | None = None  # [P, WIDE] u32 (sketch plane on)
     pend_win: jnp.ndarray | None = None  # [P] u32
     pend_n: jnp.ndarray | None = None  # scalar i32
+    # pooled sketch memory (ISSUE 20): the wide arena's closed slots
+    # drain in place — [Pw, WIDE] rows + [Pw] window ids (SENTINEL_WIN
+    # where the slot holds an open/free window). Zero-size in slab mode.
+    wide_rows: jnp.ndarray | None = None
+    wide_wins: jnp.ndarray | None = None
     tiers: list[TierFlush] = dataclasses.field(default_factory=list)
 
 
@@ -692,6 +721,12 @@ class WindowManager:
         self._sketch_ix: tuple | None = None
         self.sketch_rows = 0
         self.sketch_shed = 0
+        # pooled sketch memory (ISSUE 20, CB v7): device-lane mirrors —
+        # counted spills, the occupancy gauge, and promotions. All zero
+        # in slab mode.
+        self.sketch_pool_spill = 0
+        self.sketch_pool_occ = 0
+        self.sketch_promotions = 0
         # closed blocks fetched but whose window has not flushed yet
         # (K-ring replay can drain blocks ahead of their flush range)
         self._sketch_blocks: dict[int, WindowSketchBlock] = {}
@@ -808,17 +843,34 @@ class WindowManager:
         window ids ‖ tier rows per tier), so the ≤3-fetch budget is
         untouched (tests/test_perf_gate.py)."""
         has_sketch = entry.pend is not None
+        # pooled sketch memory (ISSUE 20): closed WIDE slots ride the
+        # same two transfers. The scalar vector widens by one lane
+        # (closed-wide count, so a drain with none skips the wide bytes
+        # entirely); when any closed, all Pw rows + window ids join the
+        # concatenated fetch and the host filters on SENTINEL_WIN —
+        # Pw is a handful of rows, the filter is cheaper than a device
+        # compaction.
+        has_wide = entry.wide_rows is not None and entry.wide_rows.size > 0
         scalars = [jnp.asarray(entry.total, jnp.int32)]
         if has_sketch:
             scalars.append(jnp.asarray(entry.pend_n, jnp.int32))
+        if has_wide:
+            scalars.append(
+                jnp.sum(entry.wide_wins != jnp.uint32(SENTINEL_WIN)).astype(
+                    jnp.int32
+                )
+            )
         scalars += [jnp.asarray(tf.total, jnp.int32) for tf in entry.tiers]
+        n_wide = 0
         if len(scalars) == 1:
             total, n_blocks, tier_totals = int(self._fetch(scalars[0])), 0, []
         else:
             vec = self._fetch(jnp.stack(scalars))
-            o = 2 if has_sketch else 1
+            o = 1 + int(has_sketch) + int(has_wide)
             total = int(vec[0])
             n_blocks = int(vec[1]) if has_sketch else 0
+            if has_wide:
+                n_wide = int(vec[1 + int(has_sketch)])
             tier_totals = [int(v) for v in vec[o:]]
         if not has_sketch and not entry.tiers and total == 0:
             # pure exact-only drain with nothing flushed. The sketch and
@@ -829,13 +881,15 @@ class WindowManager:
             return []
         row_cols = entry.packed.shape[1]
         wide = entry.pend.shape[1] if has_sketch else 0
-        if total == 0 and n_blocks == 0 and not any(tier_totals):
+        if total == 0 and n_blocks == 0 and n_wide == 0 and not any(tier_totals):
             flat = np.zeros((0,), np.uint32)  # nothing to transfer
         else:
             parts = [entry.packed[:total].reshape(-1)]
             if has_sketch:
                 parts += [entry.pend[:n_blocks].reshape(-1),
                           entry.pend_win[:n_blocks]]
+            if n_wide:
+                parts += [entry.wide_rows.reshape(-1), entry.wide_wins]
             for tf, t in zip(entry.tiers, tier_totals):
                 parts.append(tf.packed[:t].reshape(-1))
             if len(parts) == 1:
@@ -859,6 +913,17 @@ class WindowManager:
             block_rows = take(n_blocks * wide).reshape(n_blocks, wide)
             wins = take(n_blocks)
             for blk in unpack_drained(block_rows, wins, self.config.sketch):
+                have = self._sketch_blocks.get(blk.window)
+                self._sketch_blocks[blk.window] = (
+                    blk if have is None else have.merge(blk)
+                )
+        if n_wide:
+            pw, wide_w = entry.wide_rows.shape
+            w_rows = take(pw * wide_w).reshape(pw, wide_w)
+            w_wins = take(pw)
+            keep = w_wins != np.uint32(SENTINEL_WIN)
+            for blk in unpack_drained(w_rows[keep], w_wins[keep],
+                                      self.config.sketch):
                 have = self._sketch_blocks.get(blk.window)
                 self._sketch_blocks[blk.window] = (
                     blk if have is None else have.merge(blk)
@@ -1203,6 +1268,11 @@ class WindowManager:
             # these are what the device plane carried at that dispatch
             self.device_snapshot_reads = vec[CB_SNAPSHOT_READS]
             self.device_snapshot_bytes = vec[CB_SNAPSHOT_BYTES]
+            # pooled sketch memory (ISSUE 20): spill/promotions are
+            # cumulative device scalars (mirror), occupancy is a gauge
+            self.sketch_pool_spill = vec[CB_SKETCH_POOL_SPILL]
+            self.sketch_pool_occ = vec[CB_SKETCH_POOL_OCC]
+            self.sketch_promotions = vec[CB_SKETCH_PROMOTIONS]
         elif len(vec) == 5:  # legacy [t_max, t_min, n_valid, n_late, aux]
             t_max, t_min, n_valid, n_late, aux = vec
         else:
@@ -1266,7 +1336,8 @@ class WindowManager:
         everything into the existing two transfers)."""
         entry = _FlushEntry(packed=packed, total=total, lo=int(lo), hi=int(hi))
         if self.sk is not None:
-            self.sk, entry.pend, entry.pend_win, entry.pend_n = sketch_drain(
+            (self.sk, entry.pend, entry.pend_win, entry.pend_n,
+             entry.wide_rows, entry.wide_wins) = sketch_drain(
                 self.sk, np.uint32(hi)
             )
         if self.cascade is not None:
@@ -1484,13 +1555,35 @@ class WindowManager:
             # async operation (_FlushEntry/TierFlush are plain
             # dataclasses, not pytrees, so the handles list explicitly)
             "pending_flush": [self._pending_stats] + [
-                [e.packed, e.total, e.pend, e.pend_win, e.pend_n]
+                [e.packed, e.total, e.pend, e.pend_win, e.pend_n,
+                 e.wide_rows, e.wide_wins]
                 + [[tf.packed, tf.total] for tf in e.tiers]
                 for e in self._pending_flush
             ],
         }
         if self.sk is not None:
-            planes["sketch"] = self.sk
+            if _pool_mode(self.sk):
+                # pooled sketch memory (ISSUE 20): split the plane so
+                # the ledger's per-pool HBM rows show where the bytes
+                # live — the compact hot arena, the wide arena, the
+                # pending drain buffer, and the routing/counter meta
+                sk = self.sk
+                planes["sketch_pool_hot"] = [
+                    sk.p_hll, sk.p_cms, sk.p_hist, sk.p_tkv, sk.p_tkh,
+                    sk.p_tkl, sk.p_tia, sk.p_tib,
+                ]
+                planes["sketch_pool_wide"] = [
+                    sk.hll, sk.cms, sk.hist, sk.tk_votes, sk.tk_hi,
+                    sk.tk_lo, sk.tk_ida, sk.tk_idb,
+                ]
+                planes["sketch_pending"] = [sk.pend, sk.pend_win]
+                planes["sketch_meta"] = [
+                    sk.win, sk.count, sk.slot_of, sk.wide_close,
+                    sk.wide_count, sk.rows, sk.shed, sk.pend_n,
+                    sk.pool_spill, sk.pool_promos, sk.promote_fill,
+                ]
+            else:
+                planes["sketch"] = self.sk
         if self.cascade is not None:
             planes["cascade"] = [
                 self.cascade.tiers, self.cascade.accs, self.cascade.fills,
@@ -1584,6 +1677,12 @@ class WindowManager:
             # actually ran inside the fused dispatch
             "sketch_rows": self.sketch_rows,
             "sketch_shed": self.sketch_shed,
+            # pooled sketch memory (ISSUE 20, CB v7): spill > 0 means
+            # windows wanted a compact pool slot when none was free —
+            # counted, never silent; occupancy is the at-dispatch gauge
+            "sketch_pool_spill": self.sketch_pool_spill,
+            "sketch_pool_occ": self.sketch_pool_occ,
+            "sketch_promotions": self.sketch_promotions,
             # rollup-cascade lanes (ISSUE 9, CB v5): cumulative closed
             # child rows the tier folds consumed / tier-stash overflow
             # sheds, as of the last fetched block; plus the host-side

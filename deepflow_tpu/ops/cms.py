@@ -67,6 +67,21 @@ def cms_merge(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return a + b
 
 
+def cms_expand(compact, width: int, xp=jnp):
+    """Up-tile a pooled compact [depth, Wc] plane to [depth, width]
+    (ISSUE 20 promotion/merge-at-pooled-widths). Sound by construction:
+    `row_slots`' column hash is width-independent, so for Wc | width
+    (both powers of two) the compact column of a key is its wide column
+    mod Wc — tiling places every compact counter at EVERY wide column
+    that folds onto it, so wide-column reads see exactly the compact
+    count plus later wide-phase adds. Overestimate-only is preserved
+    (the fold can only add colliders, never drop weight); merge with a
+    wide plane is the ordinary elementwise add."""
+    wc = compact.shape[-1]
+    assert width % wc == 0 and width & (width - 1) == 0, (wc, width)
+    return xp.tile(compact, (1, width // wc))
+
+
 def cms_query_np(state, hash_hi, hash_lo):
     """Host-side point query over a fetched counter plane (np in/out) —
     same row math as `cms_query` via the shared `row_slots`."""

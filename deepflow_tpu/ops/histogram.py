@@ -73,3 +73,35 @@ def loghist_quantiles(state: jnp.ndarray, spec: LogHistSpec, qs: tuple[float, ..
 
 def loghist_merge(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return a + b
+
+
+# ---------------------------------------------------------------------------
+# pooled sub-sketch form (ISSUE 20): a compact pool slot keeps
+# bins//factor geometric bins — equivalent to the same spec with
+# gamma^factor, so the compact relative-error bound widens to
+# (gamma^f - 1)/(gamma^f + 1). The compact bin derives from the ALREADY
+# computed wide bin by integer division (exact — no second float
+# binning that could drift off by one), and expansion re-centers each
+# compact bin at the middle wide bin it covers.
+
+
+def loghist_coarsen_bin(wide_bin, factor: int, xp=jnp):
+    """[N] wide bin ids → compact bin ids (factor wide bins per compact
+    bin). Exact integer correspondence with `loghist_bin` at the wide
+    spec."""
+    return xp.asarray(wide_bin) // factor
+
+
+def loghist_expand(compact, bins: int, xp=jnp):
+    """[..., bins//factor] compact counts → [..., bins], each compact
+    bin's mass placed at the central wide bin it covers (matches the
+    geometric-center estimate `loghist_quantiles`/tdigest read)."""
+    bc = compact.shape[-1]
+    factor = bins // bc
+    assert factor * bc == bins, (bc, bins)
+    out = xp.zeros(compact.shape[:-1] + (bins,), dtype=compact.dtype)
+    centers = xp.arange(bc) * factor + factor // 2
+    if xp is jnp:
+        return out.at[..., centers].set(compact)
+    out[..., centers] = compact
+    return out

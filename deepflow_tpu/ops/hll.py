@@ -93,3 +93,40 @@ def hll_estimate_np(state) -> "np.ndarray":
 
 
 clz32 = _clz32  # per-register rank helper, shared with the window plane
+
+
+# ---------------------------------------------------------------------------
+# pooled sub-sketch form (ISSUE 20). A compact pool slot keeps the FULL
+# m registers — rho is 1..33, so int8 holds a register exactly and the
+# compact HLL is bit-identical to the wide plane (promotion is a cast,
+# merge stays register max). Density comes from the 4× narrower dtype;
+# the packed-u32 form below is the wire/pending-block layout (4
+# registers per word, little-endian byte order).
+
+
+def hll_pack_registers(regs, xp=jnp):
+    """[..., m] i8/i32 registers → [..., m//4] u32 words (4 per word,
+    byte 0 = register 0). m must be divisible by 4 (precision ≥ 2)."""
+    r = xp.asarray(regs).astype(xp.uint32) & xp.uint32(0xFF)
+    b = r.reshape(r.shape[:-1] + (r.shape[-1] // 4, 4))
+    return (
+        b[..., 0]
+        | (b[..., 1] << xp.uint32(8))
+        | (b[..., 2] << xp.uint32(16))
+        | (b[..., 3] << xp.uint32(24))
+    )
+
+
+def hll_unpack_registers_np(words, m: int):
+    """Host inverse of `hll_pack_registers`: [..., m//4] u32 → [..., m]
+    i32 registers (values 0..33 — no sign handling needed)."""
+    import numpy as np
+
+    w = np.asarray(words, dtype=np.uint32)
+    out = np.empty(w.shape[:-1] + (m,), dtype=np.int32)
+    b = out.reshape(w.shape[:-1] + (m // 4, 4))
+    b[..., 0] = w & np.uint32(0xFF)
+    b[..., 1] = (w >> np.uint32(8)) & np.uint32(0xFF)
+    b[..., 2] = (w >> np.uint32(16)) & np.uint32(0xFF)
+    b[..., 3] = (w >> np.uint32(24)) & np.uint32(0xFF)
+    return out

@@ -193,6 +193,23 @@ def topk_challengers_presorted(
     return challengers
 
 
+def topk_tile(lanes, cols: int):
+    """Up-tile one slot's pooled compact lanes ([d, Cc] each) to
+    [d, cols] wide buckets (ISSUE 20 promotion). `bucket_cols`' hash is
+    width-independent, so a key's compact bucket is its wide bucket mod
+    Cc — tiling copies every compact bucket (key bits, ids, votes) into
+    each wide bucket that folds onto it, which keeps the key's own entry
+    present in its true wide bucket. The copies landing in OTHER wide
+    buckets are spurious candidates; they are harmless — each bucket
+    runs its own MJRTY against the keys that actually hash there, and
+    `topk_select` dedupes candidates by key before ranking."""
+    votes, l_hi, l_lo, l_ia, l_ib = lanes
+    cc = votes.shape[-1]
+    assert cols % cc == 0 and cols & (cols - 1) == 0, (cc, cols)
+    t = lambda x: jnp.tile(x, (1, cols // cc))
+    return t(votes), t(l_hi), t(l_lo), t(l_ia), t(l_ib)
+
+
 def topk_merge(a, b):
     """Bucket-wise MJRTY combine of two same-shape lane tuples: same key
     → votes add; different keys → the heavier key survives carrying the

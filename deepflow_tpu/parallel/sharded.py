@@ -38,10 +38,13 @@ from ..aggregator import window as window_mod
 from ..aggregator.fanout import FANOUT_LANES, FanoutConfig
 from ..aggregator.pipeline import make_ingest_step
 from ..aggregator.sketchplane import (
+    PoolConfig,
+    SENTINEL_WIN,
     SketchConfig,
     SketchState,
     _drain_impl as _sketch_drain_impl,
     _flatten_open,
+    _pool_mode,
     hold_blocks,
     sketch_init,
     sketch_plane_step,
@@ -119,6 +122,11 @@ class ShardedConfig:
     topk_rows: int = 2
     topk_cols: int = 1 << 9
     sketch_pending: int = 16
+    # pooled sketch memory (ISSUE 20): when set, each device's sketch
+    # ring allocates from a shared compact/wide slot pool instead of
+    # per-slot slabs — the sharded twin of SketchConfig.pool (same
+    # geometry validation, promotion, and spill accounting per device)
+    sketch_pool: PoolConfig | None = None
     # batches accumulated per device between sort+reduce folds
     # (same amortization as WindowConfig.accum_batches)
     accum_batches: int = 8
@@ -158,6 +166,7 @@ class ShardedConfig:
             topk_rows=self.topk_rows,
             topk_cols=self.topk_cols,
             pending=self.sketch_pending,
+            pool=self.sketch_pool,
         )
 
 
@@ -430,11 +439,14 @@ class ShardedPipeline:
 
         def dr(sk, close_w):
             sk1 = jax.tree.map(lambda x: x[0], sk)
-            new_sk, pend, pend_win, n = _sketch_drain_impl(sk1, close_w)
+            new_sk, pend, pend_win, n, wide_rows, wide_wins = (
+                _sketch_drain_impl(sk1, close_w)
+            )
             expand = lambda x: x[None]
             return (
                 jax.tree.map(expand, new_sk),
                 pend[None], pend_win[None], n[None],
+                wide_rows[None], wide_wins[None],
             )
 
         pspec = P(self.axes)
@@ -442,14 +454,17 @@ class ShardedPipeline:
             dr,
             mesh=self.mesh,
             in_specs=(pspec, P()),
-            out_specs=(pspec, pspec, pspec, pspec),
+            out_specs=(pspec, pspec, pspec, pspec, pspec, pspec),
         )
         return jax.jit(mapped, donate_argnums=(0,))
 
     def sketch_drain(self, sketches, close_below):
         """Close every sketch slot below `close_below` on every device
         and hand back the pending blocks: (sketches, pend [D, P, WIDE],
-        pend_win [D, P], pend_n [D])."""
+        pend_win [D, P], pend_n [D], wide_rows [D, Pw, WIDE],
+        wide_wins [D, Pw]). The wide arrays are zero-size in slab mode;
+        in pool mode they carry each wide pool slot's in-place drained
+        block (win == SENTINEL_WIN rows are dead — host filters)."""
         return self._sketch_drain(sketches, jnp.uint32(close_below))
 
     # -- live read plane (ISSUE 10) --------------------------------------
@@ -608,7 +623,11 @@ class ShardedPipeline:
         step as the single-chip cascade (tier_step), run independently
         per device (exact tiers never merge across devices; cross-shard
         aggregation stays a query-layer concern, the tier-0 stance)."""
-        fn = self._tier_fold_cache.get(("step", ratio))
+        from ..ops.segment import _use_shared_sort
+
+        # build-time knob capture, the sharded convention (_build_step)
+        shared_sort = _use_shared_sort()
+        fn = self._tier_fold_cache.get(("step", ratio, shared_sort))
         if fn is not None:
             return fn
         from ..aggregator.cascade import _tier_step_impl, tier_prefix
@@ -625,6 +644,7 @@ class ShardedPipeline:
                 ratio=ratio, num_tags=nt,
                 sum_cols_t=sum_cols, max_cols_t=max_cols,
                 prefix=tier_prefix(packed.shape[1]),
+                shared_sort=shared_sort,
             )
             expand = lambda x: x[None]
             return (
@@ -641,14 +661,20 @@ class ShardedPipeline:
             out_specs=(pspec, pspec, pspec, pspec),
         )
         fn = jax.jit(mapped, donate_argnums=(0, 1, 3))
-        self._tier_fold_cache[("step", ratio)] = fn
+        self._tier_fold_cache[("step", ratio, shared_sort)] = fn
         return fn
 
     def tier_ring_fold_fn(self):
         """shard_map'd tier ring fold: merge each device's tier
         accumulator into its tier stash (runs before every tier flush
         and at checkpoint — the settle rule)."""
-        fn = self._tier_fold_cache.get("ring_fold")
+        from ..ops.segment import _use_shared_sort
+
+        # build-time knob capture: with shared sort ON the fold
+        # rank-merges the ring against the tier stash's dispatch-owned
+        # canonical order instead of a second full keyed sort (ISSUE 20)
+        shared_sort = _use_shared_sort()
+        fn = self._tier_fold_cache.get(("ring_fold", shared_sort))
         if fn is not None:
             return fn
         from ..aggregator.cascade import _ring_fold_impl
@@ -660,7 +686,8 @@ class ShardedPipeline:
             tier1 = jax.tree.map(lambda x: x[0], tier)
             acc1 = jax.tree.map(lambda x: x[0], acc)
             new_tier, new_acc, new_lanes = _ring_fold_impl(
-                tier1, acc1, lanes[0], sum_cols, max_cols
+                tier1, acc1, lanes[0], sum_cols, max_cols,
+                shared_sort=shared_sort,
             )
             expand = lambda x: x[None]
             return (
@@ -677,7 +704,36 @@ class ShardedPipeline:
             out_specs=(pspec, pspec, pspec),
         )
         fn = jax.jit(mapped, donate_argnums=(0, 1, 2))
-        self._tier_fold_cache["ring_fold"] = fn
+        self._tier_fold_cache[("ring_fold", shared_sort)] = fn
+        return fn
+
+    def tier_flush_range_fn(self):
+        """shard_map'd tier-stash flush — ALWAYS compacting (ISSUE 20):
+        the cascade tier stashes must keep the canonical sorted-prefix
+        layout the shared-sort ring fold rank-merges against, whatever
+        tier 0's fold_mode says. Same output rows as `flush_range`."""
+        fn = self._tier_fold_cache.get("tier_flush")
+        if fn is not None:
+            return fn
+        from ..aggregator.stash import _flush_range_impl
+
+        def fr(stash, lo, hi):
+            stash1 = jax.tree.map(lambda x: x[0], stash)
+            new_state, packed, total = _flush_range_impl(
+                stash1, lo, hi, compact=True
+            )
+            expand = lambda x: x[None]
+            return jax.tree.map(expand, new_state), packed[None], total[None]
+
+        pspec = P(self.axes)
+        mapped = shard_map(
+            fr,
+            mesh=self.mesh,
+            in_specs=(pspec, P(), P()),
+            out_specs=(pspec, pspec, pspec),
+        )
+        fn = jax.jit(mapped, donate_argnums=(0,))
+        self._tier_fold_cache["tier_flush"] = fn
         return fn
 
 
@@ -728,6 +784,12 @@ class ShardedWindowManager:
         self.max_held_sketches = 512
         self.sketch_blocks_closed = 0
         self.sketch_blocks_dropped = 0
+        # pooled sketch memory (ISSUE 20): summed-over-devices spill/
+        # promotion/occupancy mirrors, updated at advance drains via the
+        # bundled scalar fetch (zero when the pool is off)
+        self.sketch_pool_spill = 0
+        self.sketch_promotions = 0
+        self.sketch_pool_occ = 0
         # rollup cascade (ISSUE 9): per-device tier stashes + watermarks
         # + the [D, 2] device counter lanes; host mirrors ride the
         # advance drain's bundled totals fetch
@@ -870,6 +932,12 @@ class ShardedWindowManager:
             "sketch_blocks_closed": self.sketch_blocks_closed,
             "sketch_blocks_held": len(self.closed_sketches),
             "sketch_blocks_dropped": self.sketch_blocks_dropped,
+            # pooled sketch memory (ISSUE 20): cumulative spill +
+            # promotion counts and the occupancy gauge, summed over
+            # devices (all 0 with the pool off)
+            "sketch_pool_spill": self.sketch_pool_spill,
+            "sketch_promotions": self.sketch_promotions,
+            "sketch_pool_occ": self.sketch_pool_occ,
             # rollup-cascade lanes (ISSUE 9): summed-over-devices rows
             # the tier folds consumed / tier-stash sheds (mirrored at
             # advance drains via the bundled totals fetch), plus the
@@ -921,9 +989,29 @@ class ShardedWindowManager:
         planes: dict[str, object] = {
             "stash": self.stash,
             "accumulator": self.acc,  # None until the first batch
-            "sketch": self.sketches,
             "lanes": [self._fold_rows_dev],
         }
+        if _pool_mode(self.sketches):
+            # pooled sketch memory (ISSUE 20): same four-way split as
+            # the single-chip twin — hot pool, wide arena, pending ring,
+            # and routing/meta — so per-pool HBM attribution matches
+            sk = self.sketches
+            planes["sketch_pool_hot"] = [
+                sk.p_hll, sk.p_cms, sk.p_hist, sk.p_tkv,
+                sk.p_tkh, sk.p_tkl, sk.p_tia, sk.p_tib,
+            ]
+            planes["sketch_pool_wide"] = [
+                sk.hll, sk.cms, sk.hist, sk.tk_votes,
+                sk.tk_hi, sk.tk_lo, sk.tk_ida, sk.tk_idb,
+            ]
+            planes["sketch_pending"] = [sk.pend, sk.pend_win]
+            planes["sketch_meta"] = [
+                sk.win, sk.count, sk.slot_of, sk.wide_close,
+                sk.wide_count, sk.rows, sk.shed, sk.pend_n,
+                sk.pool_spill, sk.pool_promos, sk.promote_fill,
+            ]
+        else:
+            planes["sketch"] = self.sketches
         if self._tier_ratios:
             planes["cascade"] = [
                 self.tier_stashes, self.tier_accs, self.tier_fills,
@@ -985,10 +1073,13 @@ class ShardedWindowManager:
         )
         # forced close at `hi`: every device closes the same windows at
         # this drain even if its shard never saw the advancing timestamp
-        self.sketches, pend, pend_win, pend_n = self.pipe.sketch_drain(
-            self.sketches, hi
-        )
+        (self.sketches, pend, pend_win, pend_n,
+         wide_rows, wide_wins) = self.pipe.sketch_drain(self.sketches, hi)
         d = self.pipe.n_devices
+        # pooled wide slots (ISSUE 20): Pw > 0 only in pool mode; their
+        # per-device close counts ride the scalar vector and the (tiny)
+        # [D, Pw] arena joins the row fetch only when something closed
+        has_wide = wide_rows.shape[1] > 0
         # rollup cascade (ISSUE 9): fold this drain's packed flush rows
         # into the per-device tier stashes and flush every tier window
         # that closed — pure dispatches; outputs join the two bundled
@@ -1041,8 +1132,13 @@ class ShardedWindowManager:
                     jnp.zeros_like, self.tier_fills[i]
                 )
                 lo_t = self.tier_watermarks[i]
-                self.tier_stashes[i], t_packed, t_totals = self.pipe.flush_range(
-                    self.tier_stashes[i], np.uint32(lo_t), np.uint32(hi_t)
+                # always-compacting tier flush (ISSUE 20): keeps the
+                # canonical layout the shared-sort ring fold requires
+                self.tier_stashes[i], t_packed, t_totals = (
+                    self.pipe.tier_flush_range_fn()(
+                        self.tier_stashes[i],
+                        jnp.uint32(lo_t), jnp.uint32(hi_t),
+                    )
                 )
                 tier_flushes.append(
                     (i, self._cascade_intervals[i], t_packed, t_totals,
@@ -1058,14 +1154,46 @@ class ShardedWindowManager:
             fr_dev = jnp.zeros((d,), jnp.uint32)
         scal_parts = [totals, fr_dev.astype(jnp.int32),
                       pend_n.astype(jnp.int32)]
+        if has_wide:
+            scal_parts.append(
+                jnp.sum(wide_wins != jnp.uint32(SENTINEL_WIN), axis=1)
+                .astype(jnp.int32)
+            )
         if self._tier_ratios:
             scal_parts.append(self.cascade_lanes.astype(jnp.int32).reshape(-1))
         scal_parts += [tf[3] for tf in tier_flushes]
+        pool_on = _pool_mode(self.sketches)
+        if pool_on:
+            # pool telemetry lanes (ISSUE 20) ride the SAME bundled
+            # vector — the sharded mirror of the single-chip CB v7
+            # spill/occupancy/promotion lanes, fetch-free like the rest
+            occ = (
+                jnp.sum(self.sketches.slot_of != jnp.int32(-1), axis=-1)
+                + jnp.sum(
+                    self.sketches.wide_close != jnp.uint32(SENTINEL_WIN),
+                    axis=-1,
+                )
+            ).astype(jnp.int32)
+            scal_parts += [
+                self.sketches.pool_spill.astype(jnp.int32),
+                self.sketches.pool_promos.astype(jnp.int32),
+                occ,
+            ]
         bundled = self._fetch(jnp.concatenate(scal_parts))
+        if pool_on:
+            self.sketch_pool_spill = int(bundled[-3 * d : -2 * d].sum())
+            self.sketch_promotions = int(bundled[-2 * d : -d].sum())
+            self.sketch_pool_occ = int(bundled[-d:].sum())
         totals_np = bundled[:d]
         self.fold_rows = int(bundled[d : 2 * d].sum())
         pend_np = bundled[2 * d : 3 * d]
         o = 3 * d
+        if has_wide:
+            wide_np = bundled[o : o + d]
+            o += d
+        else:
+            wide_np = np.zeros((d,), np.int64)
+        n_wide = int(wide_np.sum())
         if self._tier_ratios:
             lanes_np = bundled[o : o + 2 * d].reshape(d, 2)
             self.cascade_rows = int(lanes_np[:, 0].sum())
@@ -1076,7 +1204,7 @@ class ShardedWindowManager:
         max_t = int(totals_np.max())
         max_p = int(pend_np.max())
         tier_max = [int(t.max()) for t in tier_totals_np]
-        if max_t == 0 and max_p == 0 and not tier_flushes:
+        if max_t == 0 and max_p == 0 and n_wide == 0 and not tier_flushes:
             # nothing flushed and no tier closed. With tier_flushes
             # non-empty the drain must continue even when every count
             # is zero: the watermarks already advanced, so a tier
@@ -1086,7 +1214,7 @@ class ShardedWindowManager:
             return []
         row_cols = packed.shape[2]
         wide = pend.shape[2]
-        if max_t == 0 and max_p == 0 and not any(tier_max):
+        if max_t == 0 and max_p == 0 and n_wide == 0 and not any(tier_max):
             flat = np.zeros((0,), np.uint32)  # nothing to transfer
         else:
             flat_parts = [
@@ -1094,6 +1222,11 @@ class ShardedWindowManager:
                 pend[:, :max_p].reshape(-1),
                 pend_win[:, :max_p].reshape(-1),
             ]
+            if n_wide:
+                # whole [D, Pw] arena — Pw is tiny, so shipping every
+                # row and filtering SENTINEL wins on host is cheaper
+                # than a device-side compaction dispatch
+                flat_parts += [wide_rows.reshape(-1), wide_wins.reshape(-1)]
             for (_, _, t_packed, _, _, _), tm in zip(tier_flushes, tier_max):
                 flat_parts.append(t_packed[:, :tm].reshape(-1))
             flat = self._fetch(jnp.concatenate(flat_parts))
@@ -1102,8 +1235,15 @@ class ShardedWindowManager:
         block = flat[:nb].reshape(d, max_t, row_cols)
         pend_rows = flat[nb : nb + npend].reshape(d, max_p, wide)
         pend_wins = flat[nb + npend : nb + npend + d * max_p].reshape(d, max_p)
-        tier_blocks = []
         to = nb + npend + d * max_p
+        w_rows = w_wins = None
+        if n_wide:
+            pw, wide_w = wide_rows.shape[1], wide_rows.shape[2]
+            w_rows = flat[to : to + d * pw * wide_w].reshape(d, pw, wide_w)
+            to += d * pw * wide_w
+            w_wins = flat[to : to + d * pw].reshape(d, pw)
+            to += d * pw
+        tier_blocks = []
         for tm in tier_max:
             tier_blocks.append(
                 flat[to : to + d * tm * row_cols].reshape(d, tm, row_cols)
@@ -1117,6 +1257,19 @@ class ShardedWindowManager:
             ):
                 have = merged.get(blk.window)
                 merged[blk.window] = blk if have is None else have.merge(blk)
+        if n_wide:
+            # drained wide pool slots (ISSUE 20): merge into the same
+            # per-window dict — a window promoted on one device and
+            # compact on another unifies here by the r12 algebra
+            for dev in range(d):
+                keep = w_wins[dev] != np.uint32(SENTINEL_WIN)
+                for blk in unpack_drained(
+                    w_rows[dev][keep], w_wins[dev][keep], self._sk_cfg
+                ):
+                    have = merged.get(blk.window)
+                    merged[blk.window] = (
+                        blk if have is None else have.merge(blk)
+                    )
         ordered = [merged[w] for w in sorted(merged)]
         self.sketch_blocks_closed += len(ordered)
         self.sketch_blocks_dropped += hold_blocks(

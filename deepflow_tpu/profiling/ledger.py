@@ -44,6 +44,15 @@ PLANE_STASH = "stash"
 PLANE_ACCUMULATOR = "accumulator"
 PLANE_STATS_RING = "stats_ring"
 PLANE_SKETCH = "sketch"
+# pooled sketch memory (ISSUE 20): with SketchConfig.pool set, the
+# single "sketch" plane splits four ways — compact pool arenas, wide
+# pool arenas, the closed-block pending ring, and routing/meta scalars —
+# so HBM density (bytes per unit cardinality capacity) is attributable
+# per pool, not per slab
+PLANE_SKETCH_POOL_HOT = "sketch_pool_hot"
+PLANE_SKETCH_POOL_WIDE = "sketch_pool_wide"
+PLANE_SKETCH_PENDING = "sketch_pending"
+PLANE_SKETCH_META = "sketch_meta"
 PLANE_CASCADE = "cascade"
 PLANE_LANES = "lanes"  # small CB lane vectors (fold_rows, casc, snap)
 PLANE_STAGED = "staged"  # feeder double-buffer upload (StagedBatch)

@@ -17,7 +17,7 @@ import sys
 # timeout (ROADMAP: 1500 s) would kill us, without exiting — the test
 # then still fails on its own terms, but the log says which seam hung.
 faulthandler.enable()
-_dump_after = float(os.environ.get("DEEPFLOW_FAULTHANDLER_TIMEOUT_S", "1450"))
+_dump_after = float(os.environ.get("DEEPFLOW_FAULTHANDLER_TIMEOUT_S", "1750"))
 if _dump_after > 0:
     faulthandler.dump_traceback_later(_dump_after, exit=False)
 

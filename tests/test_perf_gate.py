@@ -25,6 +25,7 @@ import time
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from deepflow_tpu.aggregator.fanout import FANOUT_LANES, FanoutConfig
 from deepflow_tpu.aggregator.pipeline import make_ingest_step
@@ -471,6 +472,108 @@ def test_sketch_plane_host_sync_budget(monkeypatch):
     c = pipe_k.get_counters()
     assert c["sketch_rows"] > 0
     assert c["jit_retraces"] == 0, c
+
+
+def test_sketch_pool_budget(monkeypatch):
+    """ISSUE 20 gate: the disaggregated sketch-memory pool rides the
+    SAME transfer schedule as the slab plane — ≤3 fetches per batch
+    (pool telemetry lanes travel in the existing counter block, wide
+    rows in the existing drain transfers), K-ring <1 fetch/batch
+    steady-state, zero retraces — while flushed exact rows stay
+    bit-identical to the slab run and the HBM ledger reconciles over
+    the four pooled planes (hot arena / wide arena / pending / meta)."""
+    import deepflow_tpu.aggregator.window as window_mod
+    from deepflow_tpu.aggregator.pipeline import L4Pipeline, PipelineConfig
+    from deepflow_tpu.aggregator.sketchplane import PoolConfig, SketchConfig
+    from deepflow_tpu.aggregator.window import WindowConfig
+    from deepflow_tpu.datamodel.batch import FlowBatch
+    from deepflow_tpu.ops.histogram import LogHistSpec
+    from deepflow_tpu.profiling.ledger import DeviceMemoryLedger, plane_bytes
+
+    counts = {"n": 0}
+    real_fetch = window_mod.host_fetch
+
+    def counting_fetch(x):
+        counts["n"] += 1
+        return real_fetch(x)
+
+    monkeypatch.setattr(window_mod, "host_fetch", counting_fetch)
+
+    pool_cfg = PoolConfig(compact_slots=3, wide_slots=1, cms_factor=4,
+                          topk_factor=2, hist_factor=4)
+
+    def mk_sk(pool):
+        return SketchConfig(
+            num_groups=4, hll_precision=7, cms_depth=2, cms_width=256,
+            hist=LogHistSpec(bins=32, vmin=1.0, gamma=1.3),
+            topk_rows=2, topk_cols=64, pending=8, pool=pool,
+        )
+
+    t0 = 1_700_000_000
+    sched = (t0, t0 + 1, t0 + 4, t0 + 104, t0 + 105)
+
+    # (a) per-batch budget with the pool ON; exact rows bit-identical
+    # to the slab run on byte-identical traffic
+    out = {}
+    for name, pool in (("slab", None), ("pool", pool_cfg)):
+        gen = SyntheticFlowGen(num_tuples=200, seed=23)
+        pipe = L4Pipeline(PipelineConfig(
+            window=WindowConfig(capacity=1 << 12, sketch=mk_sk(pool)),
+            batch_size=256,
+        ))
+        docs = []
+        for t in sched:
+            before = counts["n"]
+            docs += pipe.ingest(FlowBatch.from_records(gen.records(128, t)))
+            if name == "pool":
+                assert counts["n"] - before <= SYNC_BUDGET, t - t0
+        docs += pipe.drain()
+        c = pipe.get_counters()
+        assert c["jit_retraces"] == 0, c
+        if name == "pool":
+            assert c["sketch_rows"] > 0
+            assert pipe.pop_closed_sketches(), "pool closed no blocks"
+            # ledger reconciliation: the pooled plane reports as four
+            # attributable rows whose total equals the live bytes
+            planes = pipe.wm.device_planes()
+            for p in ("sketch_pool_hot", "sketch_pool_wide",
+                      "sketch_pending", "sketch_meta"):
+                assert plane_bytes(planes[p])[0] > 0, p
+            assert "sketch" not in planes
+            led = DeviceMemoryLedger()
+            led.register("pipe", pipe.wm)
+            rows = {r["plane"]: r for r in led.snapshot()}
+            total = sum(plane_bytes(t_)[0] for t_ in planes.values())
+            assert sum(r["bytes"] for r in rows.values()) == total
+            # the compact arena is the resident plane; the worst-case
+            # wide arena no longer scales with the ring (1 slot here)
+            assert rows["sketch_pool_hot"]["bytes"] > 0
+        out[name] = docs
+    assert len(out["slab"]) == len(out["pool"])
+    for a, b in zip(out["slab"], out["pool"]):
+        np.testing.assert_array_equal(a.timestamp, b.timestamp)
+        np.testing.assert_array_equal(a.tags, b.tags)
+        assert a.meters.tobytes() == b.meters.tobytes()
+
+    # (b) K=4 counter ring: <1 stats fetch/batch with the pool ON
+    K, B = 4, 16
+    gen = SyntheticFlowGen(num_tuples=200, seed=23)
+    pipe_k = L4Pipeline(PipelineConfig(
+        window=WindowConfig(capacity=1 << 12, stats_ring=K,
+                            sketch=mk_sk(pool_cfg)),
+        batch_size=256,
+    ))
+    before = counts["n"]
+    for i in range(B):
+        pipe_k.ingest(FlowBatch.from_records(gen.records(128, t0 + i // 4)))
+    fetches = counts["n"] - before
+    advances = pipe_k.get_counters()["window_advances"]
+    assert advances >= 2
+    assert fetches <= -(-B // K) + 2 * advances, (fetches, advances)
+    assert fetches < B, f"{fetches} fetches for {B} batches — ring defeated"
+    c = pipe_k.get_counters()
+    assert c["jit_retraces"] == 0, c
+    assert c["sketch_pool_spill"] == 0, c
 
 
 def test_cascade_host_sync_budget(monkeypatch):

@@ -123,6 +123,9 @@ def test_counter_block_layout_constants():
         CB_FOLD_ROWS,
         CB_SKETCH_ROWS,
         CB_SKETCH_SHED,
+        CB_SKETCH_POOL_OCC,
+        CB_SKETCH_POOL_SPILL,
+        CB_SKETCH_PROMOTIONS,
         CB_SNAPSHOT_BYTES,
         CB_SNAPSHOT_READS,
     )
@@ -133,9 +136,11 @@ def test_counter_block_layout_constants():
     # sketch_rows/sketch_shed plane lanes, ISSUE 8; v5 appended the
     # rollup cascade's cascade_rows/cascade_shed lanes, ISSUE 9; v6
     # appended the live read plane's snapshot_reads/snapshot_bytes
-    # lanes, ISSUE 10)
-    assert CB_VERSION == 0 and CB_LEN == 18
-    assert COUNTER_BLOCK_VERSION == 6
+    # lanes, ISSUE 10; v7 appended the pooled sketch memory's
+    # sketch_pool_spill/sketch_pool_occ/sketch_promotions lanes,
+    # ISSUE 20)
+    assert CB_VERSION == 0 and CB_LEN == 21
+    assert COUNTER_BLOCK_VERSION == 7
     assert CB_STASH_OCCUPANCY == 7
     assert CB_FEEDER_SHED == 10
     assert CB_FOLD_ROWS == 11
@@ -145,6 +150,9 @@ def test_counter_block_layout_constants():
     assert CB_CASCADE_SHED == 15
     assert CB_SNAPSHOT_READS == 16
     assert CB_SNAPSHOT_BYTES == 17
+    assert CB_SKETCH_POOL_SPILL == 18
+    assert CB_SKETCH_POOL_OCC == 19
+    assert CB_SKETCH_PROMOTIONS == 20
     # the documented field-name table mirrors the index constants
     assert len(CB_FIELDS) == CB_LEN
     assert CB_FIELDS[CB_VERSION] == "version"
@@ -158,6 +166,9 @@ def test_counter_block_layout_constants():
     assert CB_FIELDS[CB_CASCADE_SHED] == "cascade_shed"
     assert CB_FIELDS[CB_SNAPSHOT_READS] == "snapshot_reads"
     assert CB_FIELDS[CB_SNAPSHOT_BYTES] == "snapshot_bytes"
+    assert CB_FIELDS[CB_SKETCH_POOL_SPILL] == "sketch_pool_spill"
+    assert CB_FIELDS[CB_SKETCH_POOL_OCC] == "sketch_pool_occ"
+    assert CB_FIELDS[CB_SKETCH_PROMOTIONS] == "sketch_promotions"
 
 
 # ---------------------------------------------------------------------------
